@@ -1,0 +1,1 @@
+"""Shared infrastructure kernel (reference pkg/ + internal/ equivalents)."""

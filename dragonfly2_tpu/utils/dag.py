@@ -1,0 +1,167 @@
+"""Generic thread-safe DAG.
+
+Parity with reference pkg/graph/dag/dag.go:48-78: vertices with typed values,
+edge add with cycle rejection, and *random vertex sampling* — the scheduler's
+candidate-parent filter draws <=40 random peers from the task DAG per round
+(reference scheduler/scheduling/scheduling.go candidate filter).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Generic, Iterator, TypeVar
+
+V = TypeVar("V")
+
+
+class DAGError(Exception):
+    pass
+
+
+class VertexNotFound(DAGError):
+    pass
+
+
+class VertexExists(DAGError):
+    pass
+
+
+class CycleError(DAGError):
+    pass
+
+
+class Vertex(Generic[V]):
+    __slots__ = ("id", "value", "parents", "children")
+
+    def __init__(self, vid: str, value: V):
+        self.id = vid
+        self.value = value
+        self.parents: set[str] = set()
+        self.children: set[str] = set()
+
+    def in_degree(self) -> int:
+        return len(self.parents)
+
+    def out_degree(self) -> int:
+        return len(self.children)
+
+
+class DAG(Generic[V]):
+    def __init__(self) -> None:
+        self._v: dict[str, Vertex[V]] = {}
+        self._lock = threading.RLock()
+
+    def __len__(self) -> int:
+        return len(self._v)
+
+    def __contains__(self, vid: str) -> bool:
+        return vid in self._v
+
+    def add_vertex(self, vid: str, value: V) -> None:
+        with self._lock:
+            if vid in self._v:
+                raise VertexExists(vid)
+            self._v[vid] = Vertex(vid, value)
+
+    def delete_vertex(self, vid: str) -> None:
+        with self._lock:
+            vertex = self._v.pop(vid, None)
+            if vertex is None:
+                return
+            for p in vertex.parents:
+                self._v[p].children.discard(vid)
+            for c in vertex.children:
+                self._v[c].parents.discard(vid)
+
+    def vertex(self, vid: str) -> Vertex[V]:
+        try:
+            return self._v[vid]
+        except KeyError:
+            raise VertexNotFound(vid) from None
+
+    def vertices(self) -> dict[str, Vertex[V]]:
+        with self._lock:
+            return dict(self._v)
+
+    def values(self) -> Iterator[V]:
+        with self._lock:
+            vs = list(self._v.values())
+        return (v.value for v in vs)
+
+    def add_edge(self, from_id: str, to_id: str) -> None:
+        """Add from->to; rejects self-loops and edges that would close a cycle."""
+        with self._lock:
+            if from_id == to_id:
+                raise CycleError(f"self edge {from_id}")
+            src, dst = self.vertex(from_id), self.vertex(to_id)
+            if to_id in src.children:
+                return
+            if self._reachable(to_id, from_id):
+                raise CycleError(f"{from_id}->{to_id} closes a cycle")
+            src.children.add(to_id)
+            dst.parents.add(from_id)
+
+    def delete_edge(self, from_id: str, to_id: str) -> None:
+        with self._lock:
+            if from_id in self._v:
+                self._v[from_id].children.discard(to_id)
+            if to_id in self._v:
+                self._v[to_id].parents.discard(from_id)
+
+    def delete_in_edges(self, vid: str) -> None:
+        with self._lock:
+            vertex = self.vertex(vid)
+            for p in vertex.parents:
+                self._v[p].children.discard(vid)
+            vertex.parents.clear()
+
+    def can_add_edge(self, from_id: str, to_id: str) -> bool:
+        with self._lock:
+            if from_id == to_id or from_id not in self._v or to_id not in self._v:
+                return False
+            if to_id in self._v[from_id].children:
+                return False
+            return not self._reachable(to_id, from_id)
+
+    def _reachable(self, start: str, target: str) -> bool:
+        stack, seen = [start], {start}
+        while stack:
+            cur = stack.pop()
+            if cur == target:
+                return True
+            for c in self._v[cur].children:
+                if c not in seen:
+                    seen.add(c)
+                    stack.append(c)
+        return False
+
+    def lineage(self, vid: str) -> set[str]:
+        """All ancestors + descendants of vid (used by scheduling filters)."""
+        out: set[str] = set()
+        with self._lock:
+            for attr in ("parents", "children"):
+                stack = list(getattr(self.vertex(vid), attr))
+                while stack:
+                    cur = stack.pop()
+                    if cur in out:
+                        continue
+                    out.add(cur)
+                    stack.extend(getattr(self._v[cur], attr))
+        return out
+
+    def random_vertices(self, n: int, rng: random.Random | None = None) -> list[Vertex[V]]:
+        """Sample up to n distinct vertices uniformly (scheduler candidate draw)."""
+        with self._lock:
+            vs = list(self._v.values())
+        if n >= len(vs):
+            return vs
+        return (rng or random).sample(vs, n)
+
+    def source_vertices(self) -> list[Vertex[V]]:
+        with self._lock:
+            return [v for v in self._v.values() if not v.parents]
+
+    def sink_vertices(self) -> list[Vertex[V]]:
+        with self._lock:
+            return [v for v in self._v.values() if not v.children]

@@ -1,0 +1,60 @@
+"""Token-bucket rate limiting.
+
+Parity with reference rate limits: per-peer download 512 MB/s, total
+download/upload 1 GiB/s (client/config/constants.go:45-47) and the 10k QPS /
+20k burst gRPC server limiter (pkg/rpc/scheduler/server/server.go:43-44).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+
+class TokenBucket:
+    """Async token bucket. rate = tokens/sec, burst = bucket capacity."""
+
+    def __init__(self, rate: float, burst: float | None = None):
+        if rate <= 0:
+            raise ValueError("rate must be > 0")
+        self.rate = float(rate)
+        self.burst = float(burst if burst is not None else rate)
+        self._tokens = self.burst
+        self._last = time.monotonic()
+        self._lock = asyncio.Lock()
+
+    def _refill(self) -> None:
+        now = time.monotonic()
+        self._tokens = min(self.burst, self._tokens + (now - self._last) * self.rate)
+        self._last = now
+
+    def try_acquire(self, n: float = 1.0) -> bool:
+        self._refill()
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False
+
+    async def acquire(self, n: float = 1.0) -> None:
+        if n > self.burst:
+            # A request larger than the bucket drains in chunks.
+            remaining = n
+            while remaining > 0:
+                chunk = min(remaining, self.burst)
+                await self.acquire(chunk)
+                remaining -= chunk
+            return
+        async with self._lock:
+            # Loop instead of clamping: tokens taken by try_acquire() during the
+            # sleep must extend the wait, not be forgiven as debt.
+            while True:
+                self._refill()
+                if self._tokens >= n:
+                    self._tokens -= n
+                    return
+                await asyncio.sleep((n - self._tokens) / self.rate)
+
+    @property
+    def available(self) -> float:
+        self._refill()
+        return self._tokens

@@ -1,0 +1,271 @@
+"""Unit tests for the shared-infra kernel (reference pkg/ equivalents)."""
+
+import asyncio
+import io
+import time
+
+import pytest
+
+from dragonfly2_tpu.utils import bitset, dag, digest, fsm, gcreg, idgen, pieces, ratelimit, unit
+
+
+class TestIdgen:
+    def test_task_id_stable(self):
+        a = idgen.task_id("http://x/f", tag="t")
+        assert a == idgen.task_id("http://x/f", tag="t")
+        assert len(a) == 64
+
+    def test_task_id_distinguishes_meta(self):
+        base = idgen.task_id("http://x/f")
+        assert base != idgen.task_id("http://x/f", tag="t")
+        assert base != idgen.task_id("http://x/f", digest="sha256:" + "0" * 64)
+        assert base != idgen.task_id("http://x/g")
+
+    def test_filtered_query(self):
+        a = idgen.task_id("http://x/f?sig=1&p=2", filters=["sig"])
+        b = idgen.task_id("http://x/f?sig=9&p=2", filters=["sig"])
+        c = idgen.task_id("http://x/f?sig=9&p=3", filters=["sig"])
+        assert a == b != c
+
+    def test_noop_filter_preserves_identity(self):
+        url = "http://x/f?q=hello%20world"
+        assert idgen.task_id(url) == idgen.task_id(url, filters=["sig"])
+
+    def test_peer_id(self):
+        pid = idgen.peer_id("1.2.3.4", "host")
+        assert pid.startswith("1.2.3.4-host-")
+        assert not idgen.is_seed_peer_id(pid)
+        assert idgen.is_seed_peer_id(idgen.peer_id("1.2.3.4", "host", seed=True))
+        assert idgen.peer_id("1.2.3.4", "h") != idgen.peer_id("1.2.3.4", "h")
+
+
+class TestDigest:
+    def test_roundtrip(self):
+        d = digest.compute("sha256", [b"hello ", b"world"])
+        assert str(d) == "sha256:" + digest.sha256_bytes(b"hello world")
+        assert digest.parse(str(d)) == d
+        assert d.verify_bytes(b"hello world")
+        assert not d.verify_bytes(b"hello worlds")
+
+    def test_parse_rejects(self):
+        for bad in ["", "sha256", "sha256:", "sha256:zz", "nope:abcd", "md5:" + "a" * 31]:
+            with pytest.raises(digest.InvalidDigestError):
+                digest.parse(bad)
+
+    def test_file_and_crc32(self):
+        f = io.BytesIO(b"x" * 3_000_000)
+        d = digest.compute_file("sha256", f)
+        assert d.encoded == digest.sha256_bytes(b"x" * 3_000_000)
+        assert digest.compute("crc32", [b"abc"]).encoded == "352441c2"
+
+
+class TestDAG:
+    def test_edges_and_cycles(self):
+        g = dag.DAG()
+        for v in "abc":
+            g.add_vertex(v, v.upper())
+        g.add_edge("a", "b")
+        g.add_edge("b", "c")
+        with pytest.raises(dag.CycleError):
+            g.add_edge("c", "a")
+        with pytest.raises(dag.CycleError):
+            g.add_edge("a", "a")
+        assert not g.can_add_edge("c", "a")
+        assert g.can_add_edge("a", "c")
+
+    def test_delete_vertex_cleans_edges(self):
+        g = dag.DAG()
+        for v in "abc":
+            g.add_vertex(v, None)
+        g.add_edge("a", "b")
+        g.add_edge("b", "c")
+        g.delete_vertex("b")
+        assert g.vertex("a").out_degree() == 0
+        assert g.vertex("c").in_degree() == 0
+
+    def test_random_sampling_and_lineage(self):
+        g = dag.DAG()
+        for i in range(100):
+            g.add_vertex(str(i), i)
+        assert len(g.random_vertices(40)) == 40
+        assert len(g.random_vertices(500)) == 100
+        g.add_edge("0", "1")
+        g.add_edge("1", "2")
+        assert g.lineage("1") == {"0", "2"}
+
+    def test_delete_in_edges(self):
+        g = dag.DAG()
+        for v in "ab":
+            g.add_vertex(v, None)
+        g.add_edge("a", "b")
+        g.delete_in_edges("b")
+        assert g.vertex("b").in_degree() == 0
+        assert g.vertex("a").out_degree() == 0
+
+
+class TestBitset:
+    def test_ops(self):
+        b = bitset.Bitset()
+        assert b.set(3) and not b.set(3)
+        b.set(5)
+        assert b.count() == 2 and b.test(3) and not b.test(4)
+        assert list(b.indices()) == [3, 5]
+        assert list(b.missing_until(6)) == [0, 1, 2, 4]
+        other = bitset.Bitset.from_indices([5, 7])
+        assert list(other.difference(b).indices()) == [7]
+        assert list(b.union(other).indices()) == [3, 5, 7]
+        assert list(b.intersection(other).indices()) == [5]
+
+
+class TestFSM:
+    def test_transitions(self):
+        m = fsm.FSM(
+            "pending",
+            [fsm.Event("run", ["pending"], "running"), fsm.Event("done", ["running"], "succeeded")],
+        )
+        assert m.can("run") and not m.can("done")
+        m.fire("run")
+        assert m.current == "running"
+        with pytest.raises(fsm.TransitionError):
+            m.fire("run")
+        m.fire("done")
+        assert m.is_("succeeded")
+
+    def test_callback(self):
+        seen = []
+        m = fsm.FSM(
+            "a",
+            [fsm.Event("go", ["a"], "b")],
+            callbacks={"go": lambda f, ev, src, dst: seen.append((ev, src, dst))},
+        )
+        m.fire("go")
+        assert seen == [("go", "a", "b")]
+
+
+class TestGC:
+    def test_run_all(self, run):
+        async def body():
+            g = gcreg.GC()
+            hits = []
+
+            async def sweep():
+                hits.append(1)
+
+            def boom():
+                raise RuntimeError("x")
+
+            fut_hits = []
+
+            def returns_future():
+                async def inner():
+                    fut_hits.append(1)
+
+                return asyncio.ensure_future(inner())
+
+            g.add("sweep", interval=100, runner=sweep)
+            g.add("boom", interval=100, runner=boom)
+            g.add("future", interval=100, runner=returns_future)
+            with pytest.raises(ValueError):
+                g.add("sweep", interval=1, runner=sweep)
+            await g.run_all()
+            assert hits == [1]
+            assert fut_hits == [1]  # non-coroutine awaitables are awaited too
+            assert g.tasks()[1].failures == 1
+
+        run(body())
+
+    def test_ticker(self, run):
+        async def body():
+            g = gcreg.GC()
+            hits = []
+            g.add("t", interval=0.02, runner=lambda: hits.append(1))
+            g.start()
+            await asyncio.sleep(0.08)
+            g.stop()
+            assert len(hits) >= 2
+
+        run(body())
+
+
+class TestRateLimit:
+    def test_try_acquire(self):
+        tb = ratelimit.TokenBucket(rate=1000, burst=10)
+        assert tb.try_acquire(10)
+        assert not tb.try_acquire(5)
+
+    def test_async_acquire_waits(self, run):
+        async def body():
+            tb = ratelimit.TokenBucket(rate=1000, burst=10)
+            await tb.acquire(10)
+            t0 = time.monotonic()
+            await tb.acquire(10)  # must wait ~10ms for refill
+            assert time.monotonic() - t0 > 0.005
+
+        run(body())
+
+    def test_try_acquire_during_sleep_extends_wait(self, run):
+        async def body():
+            tb = ratelimit.TokenBucket(rate=1000, burst=10)
+            await tb.acquire(10)  # drain
+
+            async def waiter():
+                t0 = time.monotonic()
+                await tb.acquire(10)
+                return time.monotonic() - t0
+
+            w = asyncio.ensure_future(waiter())
+            await asyncio.sleep(0.008)
+            stolen = tb.try_acquire(5)  # steal mid-sleep
+            elapsed = await w
+            assert stolen
+            # waiter must have waited for its full 10 tokens *plus* the stolen 5
+            assert elapsed > 0.012
+
+        run(body())
+
+    def test_oversize_request_chunks(self, run):
+        async def body():
+            tb = ratelimit.TokenBucket(rate=100_000, burst=10)
+            await tb.acquire(25)  # > burst: drains in chunks without error
+
+        run(body())
+
+
+class TestPieces:
+    def test_piece_size_scales(self):
+        assert pieces.compute_piece_size(0) == 4 << 20
+        assert pieces.compute_piece_size(100 << 20) == 4 << 20
+        assert pieces.compute_piece_size(300 << 20) == 8 << 20
+        assert pieces.compute_piece_size(1 << 40) == 64 << 20  # capped
+
+    def test_piece_geometry(self):
+        size, total = 4, 10
+        assert pieces.piece_count(total, size) == 3
+        assert pieces.piece_range(2, size, total) == pieces.Range(8, 2)
+        with pytest.raises(ValueError):
+            pieces.piece_range(3, size, total)
+        assert pieces.piece_range(0, size, total).header() == "bytes=0-3"
+
+    def test_http_range(self):
+        assert pieces.parse_http_range("bytes=0-3", 10) == pieces.Range(0, 4)
+        assert pieces.parse_http_range("bytes=4-", 10) == pieces.Range(4, 6)
+        assert pieces.parse_http_range("bytes=-3", 10) == pieces.Range(7, 3)
+        assert pieces.parse_http_range("bytes=5-99", 10) == pieces.Range(5, 5)
+        for bad in ["bytes=9-2", "bytes=12-", "pieces=1-2", "bytes=-"]:
+            with pytest.raises(ValueError):
+                pieces.parse_http_range(bad, 10)
+
+    def test_range_spec(self):
+        assert pieces.parse_range_spec("5-9") == pieces.Range(5, 5)
+        with pytest.raises(ValueError):
+            pieces.parse_range_spec("9-5")
+
+
+class TestUnit:
+    def test_parse_format(self):
+        assert unit.parse_bytes("4Mi") == 4 << 20
+        assert unit.parse_bytes("1.5K") == 1536
+        assert unit.parse_bytes(123) == 123
+        assert unit.format_bytes(4 << 20) == "4.0MiB"
+        with pytest.raises(ValueError):
+            unit.parse_bytes("4X")
