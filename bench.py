@@ -1,0 +1,103 @@
+"""Headline benchmark: scheduler parent-scoring throughput + GNN training rate.
+
+Runs on whatever JAX backend is live (real TPU chip under the driver). Prints
+exactly ONE JSON line:
+  metric       scheduler_scoring_calls_per_sec — batched scoring rounds/sec,
+               each round scoring 40 candidate parents (the reference's
+               filter-40→top-4 shape, scheduler/config/constants.go:36-40)
+  vs_baseline  against the 10k calls/s north-star target (BASELINE.md; the
+               reference's intended path was a TF-Serving RPC per round and
+               was never implemented)
+  extra        GNN train steps/sec on the 1k-node synthetic topology
+               (north-star config 2) and scoring p50 latency.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import numpy as np
+
+
+def bench_scoring(rounds: int = 2000, candidates: int = 40) -> tuple[float, float]:
+    from dragonfly2_tpu.models.scorer import GNNScorer
+    from dragonfly2_tpu.trainer import synthetic, train_gnn
+
+    cluster = synthetic.make_cluster(num_nodes=1024, num_neighbors=16, num_pairs=4096, seed=7)
+    cfg = train_gnn.GNNTrainConfig()
+    model = train_gnn.make_model(cfg)
+    state = train_gnn.init_state(cfg, cluster.graph, rng_seed=7)
+    scorer = GNNScorer(model, state.params)
+    scorer.refresh(cluster.graph)
+
+    rng = np.random.default_rng(7)
+    child = rng.integers(0, 1024, size=candidates).astype(np.int32)
+    parent = rng.integers(0, 1024, size=candidates).astype(np.int32)
+    feats = cluster.pairs.feats[:candidates]
+
+    for _ in range(20):  # warmup + compile
+        scorer.score(feats, child=child, parent=parent)
+
+    lat = np.empty(rounds)
+    t0 = time.perf_counter()
+    for i in range(rounds):
+        s = time.perf_counter()
+        scorer.score(feats, child=child, parent=parent)
+        lat[i] = time.perf_counter() - s
+    total = time.perf_counter() - t0
+    return rounds / total, float(np.percentile(lat, 50) * 1000)
+
+
+def bench_gnn_train(steps: int = 30) -> float:
+    from dragonfly2_tpu.parallel import mesh as meshlib
+    from dragonfly2_tpu.trainer import synthetic, train_gnn
+    from dragonfly2_tpu.trainer.synthetic import PairBatch
+
+    import jax.numpy as jnp
+
+    cluster = synthetic.make_cluster(num_nodes=1024, num_neighbors=16, num_pairs=65536, seed=7)
+    cfg = train_gnn.GNNTrainConfig()
+    mesh = meshlib.make_mesh()
+    state = train_gnn.init_state(cfg, cluster.graph, rng_seed=7)
+    state, g, step_fn = train_gnn.shard_for_training(state, cluster.graph, mesh)
+    rng = np.random.default_rng(7)
+
+    def one_step():
+        nonlocal state
+        batch = synthetic.sample_batch(cluster.pairs, cfg.batch_size, rng)
+        state, loss = step_fn(state, g, PairBatch(*(jnp.asarray(a) for a in batch)))
+        return loss
+
+    one_step()  # compile
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = one_step()
+    jax.block_until_ready(loss)
+    return steps / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    calls_per_sec, p50_ms = bench_scoring()
+    steps_per_sec = bench_gnn_train()
+    print(
+        json.dumps(
+            {
+                "metric": "scheduler_scoring_calls_per_sec",
+                "value": round(calls_per_sec, 1),
+                "unit": "calls/s (40 candidates/call)",
+                "vs_baseline": round(calls_per_sec / 10_000, 3),
+                "extra": {
+                    "scoring_p50_ms": round(p50_ms, 3),
+                    "gnn_train_steps_per_sec": round(steps_per_sec, 2),
+                    "backend": jax.devices()[0].platform,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

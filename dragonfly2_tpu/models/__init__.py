@@ -1,0 +1,17 @@
+"""JAX/Flax model definitions for the ML scheduling plane.
+
+This is the plane the reference left unimplemented (trainer/ is config+metrics
+only; scheduler/scheduling/evaluator/evaluator.go:48 is `// TODO Implement
+MLAlgorithm`; manager CreateModel is a stub at manager_server_v2.go:739).
+Here it is primary: an MLP bandwidth predictor over download records and a
+GraphSAGE GNN over the network-topology probe graph, both trained on TPU
+meshes and exported as batched scorers for the scheduler's hot loop.
+"""
+
+from dragonfly2_tpu.models.features import (  # noqa: F401
+    FEATURE_DIM,
+    FEATURE_NAMES,
+    PAIR_FEATURE_DIM,
+)
+from dragonfly2_tpu.models.mlp import BandwidthMLP  # noqa: F401
+from dragonfly2_tpu.models.graphsage import GraphSAGE, TopoScorer  # noqa: F401

@@ -1,0 +1,125 @@
+"""GraphSAGE over the network-topology probe graph (north-star configs 2-3).
+
+The flagship model. The reference collects (src, dst, RTT) probes into Redis
+queues (scheduler/networktopology/network_topology.go:38-122) and streams them
+to a trainer that was never implemented. Here the probe graph becomes a dense
+padded-neighbor-table `TopoGraph` (see dragonfly2_tpu.ops.neighbor_agg for the
+TPU-first rationale) and a GraphSAGE encoder produces per-host embeddings; a
+pairwise head scores (child, parent) candidates by predicted bandwidth — the
+`ml` evaluator slot the reference stubbed (evaluator.go:48).
+
+All shapes static; compute in bfloat16 on the MXU; params float32.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from dragonfly2_tpu.ops.neighbor_agg import masked_mean, neighbor_gather
+
+
+class TopoGraph(NamedTuple):
+    """Dense padded topology graph.
+
+    node_feats: [N, F] float32 host features (models.features.NODE_FEATURE_NAMES)
+    neighbors:  [N, K] int32 neighbor indices (padded slots point at 0)
+    mask:       [N, K] float32 1.0 for real edges
+    edge_feats: [N, K, E] float32 probe stats (rtt mean/std/min, probe count)
+    """
+
+    node_feats: jnp.ndarray
+    neighbors: jnp.ndarray
+    mask: jnp.ndarray
+    edge_feats: jnp.ndarray
+
+
+class SAGELayer(nn.Module):
+    features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, h: jnp.ndarray, g: TopoGraph) -> jnp.ndarray:
+        h = h.astype(self.dtype)
+        hn = neighbor_gather(h, g.neighbors)  # [N, K, H]
+        msg_in = jnp.concatenate(
+            [hn, jnp.broadcast_to(h[:, None, :], hn.shape), g.edge_feats.astype(self.dtype)],
+            axis=-1,
+        )
+        msg = nn.gelu(
+            nn.Dense(self.features, dtype=self.dtype, param_dtype=jnp.float32)(msg_in)
+        )
+        agg = masked_mean(msg, g.mask.astype(self.dtype))  # [N, features]
+        self_h = nn.Dense(self.features, dtype=self.dtype, param_dtype=jnp.float32)(h)
+        out = nn.gelu(self_h + agg)
+        return nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32)(out)
+
+
+class GraphSAGE(nn.Module):
+    """Encoder: TopoGraph -> per-node embeddings [N, embed_dim]."""
+
+    hidden: int = 256
+    embed_dim: int = 128
+    num_layers: int = 3
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, g: TopoGraph) -> jnp.ndarray:
+        h = nn.Dense(self.hidden, dtype=self.dtype, param_dtype=jnp.float32)(
+            g.node_feats.astype(self.dtype)
+        )
+        for _ in range(self.num_layers):
+            h = SAGELayer(self.hidden, dtype=self.dtype)(h, g)
+        z = nn.Dense(self.embed_dim, dtype=self.dtype, param_dtype=jnp.float32)(h)
+        # L2-normalized embeddings (standard GraphSAGE) keep the pairwise head
+        # scale-stable across training rounds.
+        z = z.astype(jnp.float32)
+        return z / (jnp.linalg.norm(z, axis=-1, keepdims=True) + 1e-6)
+
+
+class TopoScorer(nn.Module):
+    """GraphSAGE encoder + pairwise (child, parent) bandwidth head.
+
+    score(g, child_idx[B], parent_idx[B], pair_feats[B, Fp]) -> [B] in (0, 1):
+    predicted normalized bandwidth, used directly as the parent score for one
+    batched call per scheduling round (all ~40 candidates at once).
+    """
+
+    hidden: int = 256
+    embed_dim: int = 128
+    num_layers: int = 3
+    head_hidden: int = 256
+    dtype: jnp.dtype = jnp.bfloat16
+
+    def setup(self) -> None:
+        self.encoder = GraphSAGE(self.hidden, self.embed_dim, self.num_layers, self.dtype)
+        self.head = nn.Sequential(
+            [
+                nn.Dense(self.head_hidden, dtype=self.dtype, param_dtype=jnp.float32),
+                nn.gelu,
+                nn.Dense(self.head_hidden // 2, dtype=self.dtype, param_dtype=jnp.float32),
+                nn.gelu,
+                nn.Dense(1, dtype=self.dtype, param_dtype=jnp.float32),
+            ]
+        )
+
+    def __call__(
+        self,
+        g: TopoGraph,
+        child_idx: jnp.ndarray,
+        parent_idx: jnp.ndarray,
+        pair_feats: jnp.ndarray,
+    ) -> jnp.ndarray:
+        z = self.encoder(g)  # [N, D] float32
+        zc = jnp.take(z, child_idx, axis=0)
+        zp = jnp.take(z, parent_idx, axis=0)
+        x = jnp.concatenate(
+            [zc, zp, zc * zp, pair_feats.astype(jnp.float32)], axis=-1
+        ).astype(self.dtype)
+        out = self.head(x).astype(jnp.float32).squeeze(-1)
+        return nn.sigmoid(out)
+
+    def embed(self, g: TopoGraph) -> jnp.ndarray:
+        return self.encoder(g)

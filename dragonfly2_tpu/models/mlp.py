@@ -1,0 +1,31 @@
+"""MLP bandwidth predictor (north-star config 1).
+
+Trains on scheduler download records (the reference streams these CSVs as
+TrainMLPRequest chunks — scheduler/announcer/announcer.go:193; the receiving
+trainer was never built). Input: PAIR_FEATURE_DIM features for a (child,
+parent) pair; output: predicted download bandwidth (normalized) usable
+directly as a parent score.
+
+TPU notes: pure dense layers in bfloat16 compute / float32 params, batch-first
+static shapes — everything lands on the MXU.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class BandwidthMLP(nn.Module):
+    hidden: tuple[int, ...] = (256, 256, 128)
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        """x: [B, PAIR_FEATURE_DIM] float32 → [B] predicted bandwidth in [0,1]."""
+        h = x.astype(self.dtype)
+        for width in self.hidden:
+            h = nn.Dense(width, dtype=self.dtype, param_dtype=jnp.float32)(h)
+            h = nn.gelu(h)
+        out = nn.Dense(1, dtype=self.dtype, param_dtype=jnp.float32)(h)
+        return nn.sigmoid(out.astype(jnp.float32)).squeeze(-1)

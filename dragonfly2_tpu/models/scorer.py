@@ -1,0 +1,107 @@
+"""Batched parent scorer serving the scheduler's hot loop.
+
+Serving design (vs reference): the reference called per-pair Evaluate inside a
+sort comparator (~2·40·log 40 calls per round, evaluator_base.go:79) and its
+intended ML path was a TF-Serving RPC per round (tfserving/client_v1.go:82).
+Here scoring is one batched call per round: node embeddings are *cached*
+(recomputed only when telemetry refreshes, `refresh()`), and a round scores
+all ~40 candidates through the pairwise head in a single jitted call — the
+batch API SURVEY.md §7 says must be designed in from day one.
+
+Two engines:
+  LinearScorer  — the reference's default evaluator weights (base fallback).
+  GNNScorer     — TopoScorer embeddings + head (the `ml` slot, no RPC hop).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dragonfly2_tpu.models.features import BASE_WEIGHTS
+from dragonfly2_tpu.models.graphsage import TopoGraph, TopoScorer
+
+
+def _to_device(tree: Any, device: Any) -> Any:
+    """Move a pytree to a device, staging through host memory.
+
+    Direct cross-backend jax.device_put (e.g. tunneled-TPU array → CPU client)
+    can hang on exotic PJRT transports; np.asarray is a plain D2H copy that
+    always works, and the host→target H2D copy is local.
+    """
+    return jax.tree.map(lambda a: jax.device_put(np.asarray(a), device), tree)
+
+
+class LinearScorer:
+    """Reference-default linear blend (evaluator_base.go:31-49 weights)."""
+
+    def score(self, pair_feats: np.ndarray, **_: Any) -> np.ndarray:
+        return np.asarray(pair_feats[:, : len(BASE_WEIGHTS)] @ BASE_WEIGHTS[: pair_feats.shape[1]])
+
+
+class GNNScorer:
+    """Cached-embedding GNN scorer; one jitted head call per scheduling round.
+
+    Serving is pinned to the host CPU backend by default: training runs on the
+    TPU mesh, but per-round scoring must not pay a device-dispatch round trip
+    (the north-star contract is 10k calls/s "with no GPU" — the reference's
+    equivalent hop was a TF-Serving RPC). Params/embeddings transfer once per
+    refresh; each round is a committed-CPU jit call.
+    """
+
+    def __init__(self, model: TopoScorer, params: Any, device: Any = None):
+        if device is None:
+            try:
+                device = jax.devices("cpu")[0]
+            except RuntimeError:
+                device = jax.devices()[0]
+        self._device = device
+        self._model = model
+        self._params = _to_device(params, device)
+        self._z: jax.Array | None = None
+
+        def _embed(params: Any, g: TopoGraph) -> jax.Array:
+            return model.apply(params, g, method=model.embed)
+
+        def _score(params: Any, z: jax.Array, child: jax.Array, parent: jax.Array, feats: jax.Array) -> jax.Array:
+            zc = jnp.take(z, child, axis=0)
+            zp = jnp.take(z, parent, axis=0)
+            x = jnp.concatenate([zc, zp, zc * zp, feats], axis=-1).astype(model.dtype)
+            head = lambda p, v: model.apply(p, v, method=lambda m, vv: m.head(vv))
+            out = head(params, x).astype(jnp.float32).squeeze(-1)
+            return jax.nn.sigmoid(out)
+
+        self._embed = jax.jit(_embed)
+        self._score_fn = jax.jit(_score)
+
+    def refresh(self, graph: TopoGraph) -> None:
+        """Recompute cached node embeddings (call when telemetry updates)."""
+        g = TopoGraph(*(jax.device_put(np.asarray(a), self._device) for a in graph))
+        self._z = self._embed(self._params, g)
+        self._z.block_until_ready()
+
+    def update_params(self, params: Any) -> None:
+        self._params = _to_device(params, self._device)
+        self._z = None
+
+    @property
+    def ready(self) -> bool:
+        return self._z is not None
+
+    def score(
+        self, pair_feats: np.ndarray, *, child: np.ndarray, parent: np.ndarray
+    ) -> np.ndarray:
+        if self._z is None:
+            raise RuntimeError("GNNScorer.refresh(graph) must run before score()")
+        dev = self._device
+        out = self._score_fn(
+            self._params,
+            self._z,
+            jax.device_put(np.asarray(child, np.int32), dev),
+            jax.device_put(np.asarray(parent, np.int32), dev),
+            jax.device_put(np.asarray(pair_feats, np.float32), dev),
+        )
+        return np.asarray(out)

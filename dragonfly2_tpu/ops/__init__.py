@@ -1,0 +1,3 @@
+"""TPU kernels for the GNN hot ops (XLA reference paths + Pallas variants)."""
+
+from dragonfly2_tpu.ops.neighbor_agg import masked_mean, neighbor_gather  # noqa: F401

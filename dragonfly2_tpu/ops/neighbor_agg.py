@@ -1,0 +1,53 @@
+"""Neighbor gather + masked mean: the GraphSAGE aggregation hot op.
+
+Graph layout is TPU-first (SURVEY.md §7): instead of the reference's Redis
+FIFO probe lists per (src, dst) edge (scheduler/networktopology/probes.go),
+the topology graph is a *dense padded neighbor table* — `neighbors[N, K]`
+int32 with a boolean mask — so aggregation is static-shaped gather + masked
+mean + matmul, all of which XLA tiles onto the MXU with no dynamic shapes.
+
+The XLA path below is the default (and currently only) implementation; a
+fused Pallas variant of the same contract is the planned follow-up once it
+beats XLA's gather fusion on-chip.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def neighbor_gather(h: jnp.ndarray, neighbors: jnp.ndarray) -> jnp.ndarray:
+    """Gather node states for each padded neighbor slot.
+
+    h: [N, H] node states; neighbors: [N, K] int32 indices (padding may point
+    anywhere valid, typically 0 — the mask zeroes its contribution).
+    Returns [N, K, H].
+    """
+    return jnp.take(h, neighbors, axis=0)
+
+
+def masked_mean(x: jnp.ndarray, mask: jnp.ndarray, *, eps: float = 1e-6) -> jnp.ndarray:
+    """Mean over axis 1 counting only mask==1 slots. x: [N, K, H], mask: [N, K]."""
+    m = mask.astype(x.dtype)[..., None]
+    total = jnp.sum(x * m, axis=1)
+    count = jnp.sum(m, axis=1)
+    return total / (count + eps)
+
+
+def neighbor_aggregate(
+    h: jnp.ndarray, neighbors: jnp.ndarray, mask: jnp.ndarray
+) -> jnp.ndarray:
+    """Fused gather + masked mean: [N, H] -> [N, H] neighborhood means."""
+    return masked_mean(neighbor_gather(h, neighbors), mask)
+
+
+def segment_mean(values: jnp.ndarray, segment_ids: jnp.ndarray, num_segments: int) -> jnp.ndarray:
+    """COO-style aggregation for data prep: mean of values rows per segment.
+
+    Used when building the padded neighbor table from raw probe records
+    (edge list form), not in the training step itself.
+    """
+    total = jax.ops.segment_sum(values, segment_ids, num_segments)
+    count = jax.ops.segment_sum(jnp.ones_like(values[..., :1]), segment_ids, num_segments)
+    return total / jnp.maximum(count, 1.0)

@@ -1,0 +1,94 @@
+"""Device mesh + sharding rules for the trainer.
+
+Scaling model ("How to Scale Your Model" recipe): pick a mesh, annotate
+shardings on inputs/params, let XLA insert the collectives, profile. Axes:
+
+  data  — batch/data parallelism: training pairs and graph node rows are
+          row-sharded here; XLA inserts the gradient psum and the per-layer
+          all-gather that the cross-shard neighbor gather needs (this is the
+          sequence-parallel-shaped axis of the GNN: nodes play the role of
+          sequence positions).
+  model — tensor parallelism: Dense kernels column-sharded on the output dim.
+
+The reference has no ICI story at all (its parallelism is goroutines + gRPC,
+SURVEY.md §2.4); this module is where the TPU build replaces it.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(devices: list | None = None, *, model_parallel: int | None = None) -> Mesh:
+    """Build a ("data", "model") mesh over the given (or all) devices.
+
+    model_parallel defaults to the largest power of two ≤ min(4, n_devices)
+    that divides the device count — tp stays small (it rides ICI), dp takes
+    the rest.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if model_parallel is None:
+        model_parallel = 1
+        for cand in (2, 4):
+            if n % cand == 0 and cand <= n:
+                model_parallel = cand
+    if n % model_parallel:
+        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
+    grid = np.asarray(devices).reshape(n // model_parallel, model_parallel)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def _shardable(dim: int, mesh: Mesh, axis: str) -> bool:
+    return dim % mesh.shape[axis] == 0
+
+
+def param_leaf_sharding(leaf: Any, mesh: Mesh) -> NamedSharding:
+    """Tensor-parallel rule for one leaf: 2-D kernels column-shard the output
+    dim over "model" when divisible; 1-D biases follow; else replicate.
+
+    Also applied to optimizer-state leaves (adam m/v mirror param shapes) so
+    opt state and params never diverge in sharding.
+    """
+    shape = getattr(leaf, "shape", ())
+    if len(shape) == 2 and _shardable(shape[1], mesh, MODEL_AXIS):
+        return NamedSharding(mesh, P(None, MODEL_AXIS))
+    if len(shape) == 1 and shape[0] > 1 and _shardable(shape[0], mesh, MODEL_AXIS):
+        return NamedSharding(mesh, P(MODEL_AXIS))
+    return NamedSharding(mesh, P())
+
+
+def infer_param_sharding(params: Any, mesh: Mesh) -> Any:
+    """Apply param_leaf_sharding across a whole pytree."""
+    return jax.tree.map(lambda leaf: param_leaf_sharding(leaf, mesh), params)
+
+
+def graph_shardings(mesh: Mesh) -> tuple[NamedSharding, ...]:
+    """Shardings for TopoGraph fields: node rows over "data"."""
+    row = NamedSharding(mesh, P(DATA_AXIS))
+    return (
+        row,  # node_feats [N, F]
+        row,  # neighbors  [N, K]
+        row,  # mask       [N, K]
+        row,  # edge_feats [N, K, E]
+    )
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P(DATA_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    return int(math.ceil(n / multiple) * multiple)

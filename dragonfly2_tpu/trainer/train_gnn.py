@@ -1,0 +1,165 @@
+"""Sharded GNN training (north-star configs 2-3).
+
+One jitted train step over a ("data", "model") mesh: graph node rows and the
+pair batch are sharded over "data", Dense kernels over "model"; XLA inserts
+the neighbor-gather all-gathers and the gradient psum from the sharding
+annotations alone (no hand-written collectives — pjit style, per the
+scaling-book recipe).
+
+Replaces the reference's never-implemented trainer loop (trainer/ is
+config+metrics only; the Train RPC at pkg/rpc/trainer/server/server.go:59
+received CSV chunks and dropped them on the floor).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax.training import train_state
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from dragonfly2_tpu.models.graphsage import TopoGraph, TopoScorer
+from dragonfly2_tpu.parallel import mesh as meshlib
+from dragonfly2_tpu.trainer.synthetic import PairBatch, sample_batch
+
+
+@dataclass
+class GNNTrainConfig:
+    hidden: int = 256
+    embed_dim: int = 128
+    num_layers: int = 3
+    batch_size: int = 4096
+    learning_rate: float = 3e-3
+    weight_decay: float = 1e-4
+    warmup_steps: int = 100
+    remat: bool = False
+
+
+def make_model(cfg: GNNTrainConfig) -> TopoScorer:
+    return TopoScorer(hidden=cfg.hidden, embed_dim=cfg.embed_dim, num_layers=cfg.num_layers)
+
+
+def init_state(
+    cfg: GNNTrainConfig, graph: TopoGraph, rng_seed: int = 0
+) -> train_state.TrainState:
+    from dragonfly2_tpu.models.features import FEATURE_DIM
+
+    model = make_model(cfg)
+    dummy_idx = jnp.zeros((8,), jnp.int32)
+    dummy_feats = jnp.zeros((8, FEATURE_DIM), jnp.float32)
+    params = model.init(
+        jax.random.PRNGKey(rng_seed), _as_jnp_graph(graph), dummy_idx, dummy_idx, dummy_feats
+    )
+    tx = optax.chain(
+        optax.clip_by_global_norm(1.0),
+        optax.adamw(
+            optax.warmup_cosine_decay_schedule(
+                0.0, cfg.learning_rate, cfg.warmup_steps, 20_000, cfg.learning_rate * 0.05
+            ),
+            weight_decay=cfg.weight_decay,
+        ),
+    )
+    return train_state.TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+
+def _as_jnp_graph(g: TopoGraph) -> TopoGraph:
+    return TopoGraph(*(jnp.asarray(a) for a in g))
+
+
+def loss_fn(apply_fn: Callable, params: Any, g: TopoGraph, batch: PairBatch) -> jnp.ndarray:
+    pred = apply_fn(params, g, batch.child, batch.parent, batch.feats)
+    return jnp.mean((pred - batch.label) ** 2)
+
+
+def train_step(
+    state: train_state.TrainState, g: TopoGraph, batch: PairBatch
+) -> tuple[train_state.TrainState, jnp.ndarray]:
+    loss, grads = jax.value_and_grad(partial(loss_fn, state.apply_fn))(state.params, g, batch)
+    return state.apply_gradients(grads=grads), loss
+
+
+def shard_for_training(
+    state: train_state.TrainState, g: TopoGraph, mesh: Mesh
+) -> tuple[train_state.TrainState, TopoGraph, Callable]:
+    """Place state/graph per the mesh rules and return the jitted step.
+
+    Node rows over "data" (pad N to the dp size first), kernels over "model",
+    batch rows over "data".
+    """
+    dp = mesh.shape[meshlib.DATA_AXIS]
+    g = pad_graph(g, meshlib.pad_to_multiple(g.node_feats.shape[0], dp))
+    param_sh = meshlib.infer_param_sharding(state.params, mesh)
+    state_sh = train_state.TrainState(
+        step=NamedSharding(mesh, P()),
+        apply_fn=state.apply_fn,
+        params=param_sh,
+        tx=state.tx,
+        opt_state=jax.tree.map(
+            lambda leaf: meshlib.param_leaf_sharding(leaf, mesh), state.opt_state
+        ),
+    )
+    state = jax.device_put(state, state_sh)
+    g_sh = TopoGraph(*meshlib.graph_shardings(mesh))
+    g = jax.device_put(_as_jnp_graph(g), g_sh)
+    batch_sh = PairBatch(*([meshlib.batch_sharding(mesh)] * 4))
+    step = jax.jit(
+        train_step,
+        in_shardings=(state_sh, g_sh, batch_sh),
+        out_shardings=(state_sh, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+    return state, g, step
+
+
+def pad_graph(g: TopoGraph, n_padded: int) -> TopoGraph:
+    """Pad node dim to n_padded with masked isolated nodes (static shapes)."""
+    n = g.node_feats.shape[0]
+    if n_padded == n:
+        return g
+    pad = n_padded - n
+    return TopoGraph(
+        np.concatenate([g.node_feats, np.zeros((pad, g.node_feats.shape[1]), np.float32)]),
+        np.concatenate([g.neighbors, np.zeros((pad, g.neighbors.shape[1]), np.int32)]),
+        np.concatenate([g.mask, np.zeros((pad, g.mask.shape[1]), np.float32)]),
+        np.concatenate(
+            [g.edge_feats, np.zeros((pad,) + g.edge_feats.shape[1:], np.float32)]
+        ),
+    )
+
+
+def train(
+    cfg: GNNTrainConfig,
+    graph: TopoGraph,
+    pairs: PairBatch,
+    *,
+    steps: int,
+    mesh: Mesh | None = None,
+    seed: int = 0,
+    log_every: int = 100,
+    log: Callable[[str], None] = lambda s: None,
+) -> tuple[train_state.TrainState, list[float]]:
+    """Full training driver; returns final state + loss history."""
+    mesh = mesh or meshlib.make_mesh()
+    state = init_state(cfg, graph, seed)
+    state, g, step_fn = shard_for_training(state, graph, mesh)
+    rng = np.random.default_rng(seed)
+    # Batch rows shard over "data": round up so every shard is equal-sized.
+    batch_size = meshlib.pad_to_multiple(cfg.batch_size, mesh.shape[meshlib.DATA_AXIS])
+    losses: list[float] = []
+    t0 = time.perf_counter()
+    for i in range(steps):
+        batch = sample_batch(pairs, batch_size, rng)
+        state, loss = step_fn(state, g, PairBatch(*(jnp.asarray(a) for a in batch)))
+        if (i + 1) % log_every == 0 or i == 0:
+            lv = float(loss)
+            losses.append(lv)
+            log(f"step {i + 1}/{steps} loss={lv:.5f} ({(i + 1) / (time.perf_counter() - t0):.2f} steps/s)")
+    jax.block_until_ready(state.params)
+    return state, losses

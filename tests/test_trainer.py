@@ -1,0 +1,119 @@
+"""Trainer tests: sharded train step over the virtual 8-device mesh,
+convergence on the synthetic cluster, GNN beating the linear baseline."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.models.scorer import GNNScorer, LinearScorer
+from dragonfly2_tpu.parallel import mesh as meshlib
+from dragonfly2_tpu.trainer import synthetic, train_gnn
+from dragonfly2_tpu.trainer.synthetic import PairBatch
+
+
+def test_make_mesh_axes():
+    mesh = meshlib.make_mesh()
+    assert set(mesh.axis_names) == {"data", "model"}
+    assert mesh.shape["data"] * mesh.shape["model"] == len(jax.devices())
+    assert mesh.shape["model"] in (2, 4)  # 8 devices → real tensor parallelism
+
+
+def test_param_sharding_rule():
+    mesh = meshlib.make_mesh()
+    params = {
+        "kernel": jnp.zeros((16, 64)),
+        "bias": jnp.zeros((64,)),
+        "odd": jnp.zeros((16, 7)),
+        "scalar": jnp.zeros(()),
+    }
+    sh = meshlib.infer_param_sharding(params, mesh)
+    assert "model" in str(sh["kernel"].spec)
+    assert "model" in str(sh["bias"].spec)
+    assert sh["odd"].spec == jax.sharding.PartitionSpec()
+    assert sh["scalar"].spec == jax.sharding.PartitionSpec()
+
+
+class TestShardedTraining:
+    @pytest.fixture(scope="class")
+    def cluster(self):
+        # 10240 pairs: first 8192 for training, last 2048 held out for eval.
+        return synthetic.make_cluster(num_nodes=128, num_neighbors=8, num_pairs=10240, seed=3)
+
+    def test_one_sharded_step_runs_on_mesh(self, cluster):
+        mesh = meshlib.make_mesh()
+        cfg = train_gnn.GNNTrainConfig(hidden=32, embed_dim=16, num_layers=2, batch_size=64, warmup_steps=2)
+        state = train_gnn.init_state(cfg, cluster.graph)
+        state, g, step_fn = train_gnn.shard_for_training(state, cluster.graph, mesh)
+        # params actually sharded over the model axis
+        kernels = [p for p in jax.tree.leaves(state.params) if getattr(p, "ndim", 0) == 2]
+        assert any("model" in str(k.sharding.spec) for k in kernels)
+        # graph rows actually sharded over the data axis
+        assert "data" in str(g.node_feats.sharding.spec)
+        rng = np.random.default_rng(0)
+        batch = synthetic.sample_batch(cluster.pairs, 64, rng)
+        state, loss = step_fn(state, g, PairBatch(*(jnp.asarray(a) for a in batch)))
+        assert np.isfinite(float(loss))
+
+    def test_convergence_beats_linear_baseline(self, cluster):
+        train_pairs = PairBatch(*(a[:8192] for a in cluster.pairs))
+        held_out = PairBatch(*(a[8192:] for a in cluster.pairs))
+        cfg = train_gnn.GNNTrainConfig(
+            hidden=64, embed_dim=32, num_layers=2, batch_size=512, warmup_steps=10, learning_rate=3e-3
+        )
+        state, losses = train_gnn.train(
+            cfg, cluster.graph, train_pairs, steps=120, mesh=meshlib.make_mesh(), log_every=40
+        )
+        assert losses[-1] < losses[0] * 0.5, f"no convergence: {losses}"
+
+        # Held-out pairs (same graph, never trained on): GNN must beat the
+        # reference's linear evaluator at ranking parents by true bandwidth.
+        model = train_gnn.make_model(cfg)
+        scorer = GNNScorer(model, state.params)
+        scorer.refresh(cluster.graph)
+        rng = np.random.default_rng(42)
+        pairs = synthetic.sample_batch(held_out, 1024, rng)
+        gnn_scores = scorer.score(pairs.feats, child=pairs.child, parent=pairs.parent)
+        lin_scores = LinearScorer().score(pairs.feats)
+
+        def rank_corr(a, b):
+            ra, rb = np.argsort(np.argsort(a)), np.argsort(np.argsort(b))
+            ra = ra - ra.mean()
+            rb = rb - rb.mean()
+            return float((ra * rb).sum() / np.sqrt((ra**2).sum() * (rb**2).sum()))
+
+        gnn_corr = rank_corr(gnn_scores, pairs.label)
+        lin_corr = rank_corr(lin_scores, pairs.label)
+        assert gnn_corr > lin_corr + 0.1, f"GNN {gnn_corr:.3f} vs linear {lin_corr:.3f}"
+        assert gnn_corr > 0.6, f"weak ranking: {gnn_corr:.3f}"
+
+
+def test_mlp_training_learns_bandwidth():
+    """North-star config 1: MLP bandwidth predictor on download records."""
+    import optax
+    from flax.training import train_state as ts
+
+    from dragonfly2_tpu.models import BandwidthMLP
+
+    cluster = synthetic.make_cluster(num_nodes=128, num_neighbors=8, num_pairs=8192, seed=5)
+    model = BandwidthMLP(hidden=(64, 32))
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, cluster.pairs.feats.shape[1])))
+    state = ts.TrainState.create(apply_fn=model.apply, params=params, tx=optax.adam(1e-2))
+
+    @jax.jit
+    def step(state, x, y):
+        def loss_fn(p):
+            return jnp.mean((state.apply_fn(p, x) - y) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        return state.apply_gradients(grads=grads), loss
+
+    rng = np.random.default_rng(0)
+    first = last = None
+    for i in range(150):
+        b = synthetic.sample_batch(cluster.pairs, 256, rng)
+        state, loss = step(state, jnp.asarray(b.feats), jnp.asarray(b.label))
+        if i == 0:
+            first = float(loss)
+        last = float(loss)
+    assert last < first * 0.4, f"MLP no convergence: {first} -> {last}"
