@@ -1,0 +1,2 @@
+"""Data plane: the peer daemon — piece storage, download conductor, upload
+server, back-to-source clients (reference client/daemon equivalents)."""

@@ -1,0 +1,498 @@
+"""Peer-task conductor: drives one task download end to end.
+
+Parity with reference client/daemon/peer/peertask_conductor.go:68-1157 — the
+survey's flagged hard part ("1,565 LoC of subtle concurrency: three bitmaps +
+broker + dispatcher + per-parent sync streams + traffic shaper + back-source
+cutover"). Redesigned as an explicit asyncio pipeline instead of goroutine
+spaghetti:
+
+  register → (back-to-source | P2P) → piece workers → storage → report → done
+
+P2P mode: a score-based PieceDispatcher (ref piece_dispatcher.go:33-124,
+ε-random exploration) assigns each missing piece to a parent that has it;
+N workers pull assignments, HTTP-range the bytes from the parent's upload
+server, verify, write, and report. Parent piece availability is polled from
+the parents' /metadata endpoint (replacing the reference's bidi
+SyncPieceTasks streams). Failures block the parent and trigger a scheduler
+reschedule; after the retry budget the conductor cuts over to back-to-source
+for the remaining pieces (ref partial back-source path).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Optional, Protocol
+
+import aiohttp
+
+from dragonfly2_tpu.daemon.source import SourceRegistry
+from dragonfly2_tpu.daemon.storage import StorageManager, TaskStorage
+from dragonfly2_tpu.scheduler.service import HostInfo, ParentInfo, RegisterResult, TaskMeta
+from dragonfly2_tpu.utils import digest as digestlib
+from dragonfly2_tpu.utils.pieces import Range, compute_piece_size, piece_count, piece_range
+from dragonfly2_tpu.utils.ratelimit import TokenBucket
+
+logger = logging.getLogger(__name__)
+
+
+class SchedulerClient(Protocol):
+    """What the conductor needs from the control plane. Implemented in-process
+    (wrapping SchedulerService) and over the wire (rpc client)."""
+
+    async def register_peer(self, peer_id: str, meta: TaskMeta, host: HostInfo) -> RegisterResult: ...
+    async def report_task_metadata(self, task_id: str, *, content_length: int,
+                                   piece_size: int, digest: str = "",
+                                   direct_piece: bytes = b"") -> None: ...
+    async def report_piece_result(self, peer_id: str, piece_index: int, *, success: bool,
+                                  cost_ms: float = 0.0, parent_id: str = "") -> None: ...
+    async def report_peer_result(self, peer_id: str, *, success: bool,
+                                 bandwidth_bps: float = 0.0) -> None: ...
+    async def reschedule(self, peer_id: str) -> RegisterResult: ...
+    async def leave_peer(self, peer_id: str) -> None: ...
+
+
+@dataclass
+class ParentState:
+    info: ParentInfo
+    pieces: set[int] = field(default_factory=set)
+    successes: int = 0
+    failures: int = 0
+    cost_ewma_ms: float = 0.0
+    blocked: bool = False
+
+    def score(self) -> float:
+        """Higher is better: success rate shaded by recent piece cost."""
+        total = self.successes + self.failures
+        rate = (self.successes + 1) / (total + 2)  # Laplace prior
+        cost_penalty = self.cost_ewma_ms / 10_000.0
+        return rate - cost_penalty
+
+    def record(self, success: bool, cost_ms: float) -> None:
+        if success:
+            self.successes += 1
+            alpha = 0.3
+            self.cost_ewma_ms = (
+                cost_ms if self.cost_ewma_ms == 0 else alpha * cost_ms + (1 - alpha) * self.cost_ewma_ms
+            )
+        else:
+            self.failures += 1
+            if self.failures >= 3:
+                self.blocked = True
+
+
+class PieceDispatcher:
+    """Pick the parent for each piece: best score with ε-random exploration
+    (ref piece_dispatcher.go:103-124 exploration/exploitation split)."""
+
+    def __init__(self, epsilon: float = 0.1, rng: random.Random | None = None):
+        self.parents: dict[str, ParentState] = {}
+        self.epsilon = epsilon
+        self._rng = rng or random.Random()
+
+    def update_parents(self, parents: list[ParentInfo]) -> None:
+        keep = {p.peer_id for p in parents}
+        for pid in list(self.parents):
+            if pid not in keep:
+                del self.parents[pid]
+        for p in parents:
+            if p.peer_id not in self.parents:
+                self.parents[p.peer_id] = ParentState(p)
+
+    def set_pieces(self, parent_id: str, pieces: set[int]) -> None:
+        if parent_id in self.parents:
+            self.parents[parent_id].pieces = pieces
+
+    def pick(self, piece_index: int) -> ParentState | None:
+        candidates = [
+            s for s in self.parents.values() if not s.blocked and piece_index in s.pieces
+        ]
+        if not candidates:
+            return None
+        if self._rng.random() < self.epsilon:
+            return self._rng.choice(candidates)
+        return max(candidates, key=ParentState.score)
+
+    def usable(self) -> list[ParentState]:
+        return [s for s in self.parents.values() if not s.blocked]
+
+
+@dataclass
+class ConductorConfig:
+    piece_workers: int = 4
+    download_rate_bps: float = 512 << 20  # per-peer default (ref constants.go:45)
+    piece_timeout: float = 30.0
+    metadata_poll_interval: float = 0.2
+    reschedule_limit: int = 5
+    watchdog_timeout: float = 600.0
+
+
+class PeerTaskConductor:
+    def __init__(
+        self,
+        *,
+        peer_id: str,
+        meta: TaskMeta,
+        host: HostInfo,
+        scheduler: SchedulerClient,
+        storage: StorageManager,
+        sources: SourceRegistry,
+        config: ConductorConfig | None = None,
+        http_session: aiohttp.ClientSession | None = None,
+    ):
+        self.peer_id = peer_id
+        self.meta = meta
+        self.host = host
+        self.scheduler = scheduler
+        self.storage = storage
+        self.sources = sources
+        self.cfg = config or ConductorConfig()
+        self.dispatcher = PieceDispatcher()
+        self.bucket = TokenBucket(self.cfg.download_rate_bps, burst=64 << 20)
+        self._session = http_session
+        self._owns_session = http_session is None
+        self.ts: TaskStorage | None = None
+        self.bytes_from_parents = 0
+        self.bytes_from_source = 0
+        self._piece_digests: dict[str, str] = {}  # learned from parent metadata
+        self._peer_reported = False
+        self._t0 = 0.0
+
+    # ---- entry ----
+
+    async def run(self) -> TaskStorage:
+        """Download the task fully; returns its storage. Raises on failure."""
+        self._t0 = time.monotonic()
+        try:
+            result = await asyncio.wait_for(self._run_inner(), self.cfg.watchdog_timeout)
+            return result
+        except BaseException:
+            await self._safe_report_peer(success=False)
+            raise
+        finally:
+            if self._owns_session and self._session is not None:
+                await self._session.close()
+
+    async def _run_inner(self) -> TaskStorage:
+        reg = await self.scheduler.register_peer(self.peer_id, self.meta, self.host)
+        self.ts = self.storage.register_task(
+            self.meta.task_id,
+            url=self.meta.url,
+            digest=self.meta.digest,
+            tag=self.meta.tag,
+            application=self.meta.application,
+        )
+
+        if reg.scope == "empty":
+            self.ts.set_task_info(content_length=0, piece_size=1, total_pieces=0)
+            self.ts.mark_done()
+            await self._safe_report_peer(success=True)
+            return self.ts
+        if reg.scope == "tiny" and reg.direct_piece:
+            await self._finish_tiny(reg.direct_piece)
+            return self.ts
+        if reg.back_to_source:
+            await self._download_back_to_source()
+        else:
+            self._apply_task_info(reg)
+            await self._download_p2p(reg.parents)
+
+        if not self.ts.verify():
+            await self._safe_report_peer(success=False)
+            raise digestlib.InvalidDigestError(
+                f"task {self.meta.task_id}: content digest mismatch"
+            )
+        self.ts.mark_done()
+        await self._safe_report_peer(success=True)
+        return self.ts
+
+    def _apply_task_info(self, reg: RegisterResult) -> None:
+        if reg.content_length is not None and self.ts.meta.content_length < 0:
+            self.ts.set_task_info(
+                content_length=reg.content_length,
+                piece_size=reg.piece_size,
+                total_pieces=reg.total_pieces,
+                digest=reg.digest or self.meta.digest,
+            )
+
+    async def _finish_tiny(self, data: bytes) -> None:
+        self.ts.set_task_info(
+            content_length=len(data), piece_size=max(1, len(data)), total_pieces=1
+        )
+        await self.ts.write_piece(0, data)
+        self.ts.mark_done()
+        await self._safe_report_peer(success=True)
+
+    # ---- back-to-source (ref pieceManager.DownloadSource) ----
+
+    async def _download_back_to_source(self) -> None:
+        url = self.meta.url
+        info = await self.sources.info(url)
+        if self.ts.meta.content_length < 0:
+            if info.content_length < 0:
+                await self._download_source_unknown_length(info)
+                return
+            psize = compute_piece_size(info.content_length)
+            self.ts.set_task_info(
+                content_length=info.content_length,
+                piece_size=psize,
+                total_pieces=piece_count(info.content_length, psize),
+                digest=self.meta.digest,
+            )
+            await self.scheduler.report_task_metadata(
+                self.meta.task_id,
+                content_length=info.content_length,
+                piece_size=psize,
+                digest=self.meta.digest,
+            )
+        m = self.ts.meta
+        if m.content_length == 0:
+            self.ts.mark_done()
+            return
+        if info.supports_range:
+            await self._download_source_ranged()
+        else:
+            await self._download_source_sequential()
+        if m.content_length <= 128:
+            data = await self.ts.read_range(Range(0, m.content_length))
+            await self.scheduler.report_task_metadata(
+                self.meta.task_id,
+                content_length=m.content_length,
+                piece_size=m.piece_size,
+                direct_piece=data,
+            )
+
+    async def _download_source_ranged(self) -> None:
+        """Pull only missing pieces via Range requests."""
+        m = self.ts.meta
+        for idx in self.ts.finished.missing_until(m.total_pieces):
+            r = piece_range(idx, m.piece_size, m.content_length)
+            t0 = time.monotonic()
+            buf = bytearray()
+            async for chunk in self.sources.download(self.meta.url, r):
+                buf.extend(chunk)
+                await self.bucket.acquire(len(chunk))
+            if len(buf) != r.length:
+                raise IOError(f"source piece {idx}: got {len(buf)}, want {r.length}")
+            await self.ts.write_piece(idx, bytes(buf))
+            self.bytes_from_source += len(buf)
+            await self.scheduler.report_piece_result(
+                self.peer_id, idx, success=True, cost_ms=(time.monotonic() - t0) * 1000
+            )
+
+    async def _download_source_sequential(self) -> None:
+        """Origin without Range support: stream the whole body once, carving
+        pieces as they fill (ref DownloadSource without ConcurrentOption)."""
+        m = self.ts.meta
+        buf = bytearray()
+        idx = 0
+        t0 = time.monotonic()
+        async for chunk in self.sources.download(self.meta.url):
+            buf.extend(chunk)
+            await self.bucket.acquire(len(chunk))
+            while len(buf) >= m.piece_size and idx < m.total_pieces - 1:
+                piece, buf = bytes(buf[: m.piece_size]), bytearray(buf[m.piece_size :])
+                await self._write_source_piece(idx, piece, t0)
+                idx += 1
+                t0 = time.monotonic()
+        if idx != m.total_pieces - 1 or len(buf) != m.content_length - idx * m.piece_size:
+            raise IOError(
+                f"source stream ended early: piece {idx}, {len(buf)} buffered"
+            )
+        await self._write_source_piece(idx, bytes(buf), t0)
+
+    async def _write_source_piece(self, idx: int, data: bytes, t0: float) -> None:
+        await self.ts.write_piece(idx, data)
+        self.bytes_from_source += len(data)
+        await self.scheduler.report_piece_result(
+            self.peer_id, idx, success=True, cost_ms=(time.monotonic() - t0) * 1000
+        )
+
+    async def _download_source_unknown_length(self, info) -> None:
+        """Origin without Content-Length: stream whole body, then size pieces."""
+        buf = bytearray()
+        async for chunk in self.sources.download(self.meta.url):
+            buf.extend(chunk)
+            await self.bucket.acquire(len(chunk))
+        data = bytes(buf)
+        psize = compute_piece_size(len(data))
+        self.ts.set_task_info(
+            content_length=len(data),
+            piece_size=psize,
+            total_pieces=piece_count(len(data), psize),
+            digest=self.meta.digest,
+        )
+        for idx in range(self.ts.meta.total_pieces):
+            r = piece_range(idx, psize, len(data))
+            await self.ts.write_piece(idx, data[r.start : r.start + r.length])
+        self.bytes_from_source += len(data)
+        await self.scheduler.report_task_metadata(
+            self.meta.task_id,
+            content_length=len(data),
+            piece_size=psize,
+            direct_piece=data if len(data) <= 128 else b"",
+        )
+
+    # ---- P2P (ref pullPiecesWithP2P + downloadPieceWorker) ----
+
+    async def _download_p2p(self, parents: list[ParentInfo]) -> None:
+        self.dispatcher.update_parents(parents)
+        session = self._http()
+        reschedules = 0
+
+        while True:
+            await self._poll_parent_metadata(session)
+            if self.ts.meta.content_length < 0:
+                # Parents are still back-to-source themselves and haven't
+                # learned the object size; wait for their metadata rather than
+                # burning the reschedule budget.
+                if not self.dispatcher.usable():
+                    reschedules += 1
+                    if reschedules > self.cfg.reschedule_limit:
+                        await self._download_back_to_source()
+                        return
+                    reg = await self.scheduler.reschedule(self.peer_id)
+                    if reg.back_to_source:
+                        await self._download_back_to_source()
+                        return
+                    self.dispatcher.update_parents(reg.parents)
+                await asyncio.sleep(self.cfg.metadata_poll_interval)
+                continue
+            if self.ts.meta.content_length == 0 or self.ts.is_complete():
+                return
+            total = self.ts.meta.total_pieces
+            missing = list(self.ts.finished.missing_until(total))
+            available = [i for i in missing if self.dispatcher.pick(i) is not None]
+            if not available:
+                if reschedules >= self.cfg.reschedule_limit:
+                    logger.info(
+                        "peer %s: cutover to back-to-source for %d pieces",
+                        self.peer_id, len(missing),
+                    )
+                    await self._download_back_to_source()
+                    return
+                reschedules += 1
+                reg = await self.scheduler.reschedule(self.peer_id)
+                if reg.back_to_source:
+                    await self._download_back_to_source()
+                    return
+                self.dispatcher.update_parents(reg.parents)
+                await asyncio.sleep(self.cfg.metadata_poll_interval)
+                continue
+
+            queue: asyncio.Queue[int] = asyncio.Queue()
+            for i in available:
+                queue.put_nowait(i)
+            workers = [
+                asyncio.ensure_future(self._piece_worker(session, queue))
+                for _ in range(min(self.cfg.piece_workers, len(available)))
+            ]
+            await queue.join()
+            for w in workers:
+                w.cancel()
+            await asyncio.gather(*workers, return_exceptions=True)
+
+    async def _poll_parent_metadata(self, session: aiohttp.ClientSession) -> None:
+        async def poll(state: ParentState) -> None:
+            url = f"http://{state.info.ip}:{state.info.download_port}/metadata/{self.meta.task_id}"
+            try:
+                async with session.get(url, timeout=aiohttp.ClientTimeout(total=5)) as resp:
+                    if resp.status != 200:
+                        state.record(False, 0)
+                        return
+                    data = await resp.json()
+            except (aiohttp.ClientError, asyncio.TimeoutError):
+                state.record(False, 0)
+                return
+            state.pieces = set(data.get("finished_pieces", ()))
+            for k, v in data.get("piece_digests", {}).items():
+                self._piece_digests.setdefault(k, v)
+            if self.ts.meta.content_length < 0 and data.get("content_length", -1) >= 0:
+                self.ts.set_task_info(
+                    content_length=data["content_length"],
+                    piece_size=data["piece_size"],
+                    total_pieces=data["total_pieces"],
+                    digest=data.get("digest", ""),
+                )
+
+        await asyncio.gather(*(poll(s) for s in self.dispatcher.usable()))
+
+    async def _piece_worker(self, session: aiohttp.ClientSession, queue: asyncio.Queue) -> None:
+        while True:
+            idx = await queue.get()
+            try:
+                if not self.ts.has_piece(idx):
+                    await self._download_one_piece(session, idx)
+            except Exception:
+                logger.debug("piece %d failed", idx, exc_info=True)
+            finally:
+                queue.task_done()
+
+    async def _download_one_piece(self, session: aiohttp.ClientSession, idx: int) -> None:
+        state = self.dispatcher.pick(idx)
+        if state is None:
+            return
+        m = self.ts.meta
+        r = piece_range(idx, m.piece_size, m.content_length)
+        url = (
+            f"http://{state.info.ip}:{state.info.download_port}"
+            f"/download/{self.meta.task_id[:3]}/{self.meta.task_id}?peerId={self.peer_id}"
+        )
+        t0 = time.monotonic()
+        try:
+            await self.bucket.acquire(r.length)
+            async with session.get(
+                url,
+                headers={"Range": r.header()},
+                timeout=aiohttp.ClientTimeout(total=self.cfg.piece_timeout),
+            ) as resp:
+                if resp.status != 206:
+                    raise IOError(f"parent returned HTTP {resp.status}")
+                data = await resp.read()
+        except (aiohttp.ClientError, asyncio.TimeoutError, IOError) as e:
+            cost = (time.monotonic() - t0) * 1000
+            state.record(False, cost)
+            await self.scheduler.report_piece_result(
+                self.peer_id, idx, success=False, cost_ms=cost, parent_id=state.info.peer_id
+            )
+            logger.debug("piece %d from %s failed: %s", idx, state.info.peer_id, e)
+            return
+        cost = (time.monotonic() - t0) * 1000
+        expected = self._piece_digests.get(str(idx), "")
+        try:
+            await self.ts.write_piece(idx, data, expected_digest=expected)
+        except (ValueError, digestlib.InvalidDigestError) as e:
+            state.record(False, cost)
+            await self.scheduler.report_piece_result(
+                self.peer_id, idx, success=False, cost_ms=cost, parent_id=state.info.peer_id
+            )
+            logger.warning("piece %d from %s corrupt: %s", idx, state.info.peer_id, e)
+            return
+        state.record(True, cost)
+        self.bytes_from_parents += len(data)
+        await self.scheduler.report_piece_result(
+            self.peer_id, idx, success=True, cost_ms=cost, parent_id=state.info.peer_id
+        )
+
+    # ---- helpers ----
+
+    def _http(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession()
+        return self._session
+
+    async def _safe_report_peer(self, *, success: bool) -> None:
+        if self._peer_reported:  # failure paths raise after reporting: once only
+            return
+        self._peer_reported = True
+        elapsed = max(1e-6, time.monotonic() - self._t0)
+        bw = (self.bytes_from_parents + self.bytes_from_source) / elapsed
+        try:
+            await self.scheduler.report_peer_result(
+                self.peer_id, success=success, bandwidth_bps=bw
+            )
+        except Exception:
+            logger.exception("report_peer_result failed for %s", self.peer_id)

@@ -1,0 +1,167 @@
+"""Peer engine: task-level API over the conductor (download / stream / seed).
+
+Parity with reference client/daemon/peer/peertask_manager.go:47-58
+(StartFileTask / StartSeedTask) and the reuse fast path (peertask_reuse.go):
+completed tasks short-circuit to local storage, partial tasks resume from
+their finished-piece bitset. One engine per daemon process; it owns the
+storage manager, the upload (piece) server, and the scheduler client.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from pathlib import Path
+from typing import Optional
+
+from dragonfly2_tpu.daemon.conductor import ConductorConfig, PeerTaskConductor, SchedulerClient
+from dragonfly2_tpu.daemon.source import SourceRegistry
+from dragonfly2_tpu.daemon.storage import StorageManager, TaskStorage
+from dragonfly2_tpu.daemon.upload import UploadServer
+from dragonfly2_tpu.scheduler.service import HostInfo, SchedulerService, TaskMeta
+from dragonfly2_tpu.utils import idgen
+
+logger = logging.getLogger(__name__)
+
+
+class InProcessSchedulerClient:
+    """SchedulerClient over a same-process SchedulerService (test/e2e wiring;
+    the wire client in dragonfly2_tpu.rpc implements the same protocol)."""
+
+    def __init__(self, service: SchedulerService):
+        self._svc = service
+
+    async def register_peer(self, peer_id, meta, host):
+        return await self._svc.register_peer(peer_id, meta, host)
+
+    async def report_task_metadata(self, task_id, *, content_length, piece_size, digest="", direct_piece=b""):
+        self._svc.report_task_metadata(
+            task_id, content_length=content_length, piece_size=piece_size,
+            digest=digest, direct_piece=direct_piece,
+        )
+
+    async def report_piece_result(self, peer_id, piece_index, *, success, cost_ms=0.0, parent_id=""):
+        self._svc.report_piece_result(
+            peer_id, piece_index, success=success, cost_ms=cost_ms, parent_id=parent_id
+        )
+
+    async def report_peer_result(self, peer_id, *, success, bandwidth_bps=0.0):
+        self._svc.report_peer_result(peer_id, success=success, bandwidth_bps=bandwidth_bps)
+
+    async def reschedule(self, peer_id):
+        return await self._svc.reschedule(peer_id)
+
+    async def leave_peer(self, peer_id):
+        self._svc.leave_peer(peer_id)
+
+
+class PeerEngine:
+    def __init__(
+        self,
+        *,
+        storage_root: str | Path,
+        scheduler: SchedulerClient,
+        ip: str = "127.0.0.1",
+        hostname: str = "",
+        host_type: str = "normal",
+        idc: str = "",
+        location: str = "",
+        upload_port: int = 0,
+        conductor_config: ConductorConfig | None = None,
+    ):
+        self.ip = ip
+        self.hostname = hostname or f"peer-{idgen.local_ip()}"
+        self.host_type = host_type
+        self.idc = idc
+        self.location = location
+        self.storage = StorageManager(storage_root)
+        self.scheduler = scheduler
+        self.sources = SourceRegistry()
+        self.upload = UploadServer(self.storage, host=ip, port=upload_port)
+        self.conductor_config = conductor_config or ConductorConfig()
+        self._started = False
+
+    @property
+    def host_id(self) -> str:
+        return idgen.host_id(self.hostname, self.upload.port)
+
+    def host_info(self) -> HostInfo:
+        return HostInfo(
+            id=self.host_id,
+            ip=self.ip,
+            hostname=self.hostname,
+            download_port=self.upload.port,
+            type=self.host_type,
+            idc=self.idc,
+            location=self.location,
+        )
+
+    async def start(self) -> None:
+        if not self._started:
+            await self.upload.start()
+            self._started = True
+
+    async def stop(self) -> None:
+        if self._started:
+            await self.upload.stop()
+            await self.sources.close()
+            self._started = False
+
+    # ---- task API (ref StartFileTask / StartSeedTask) ----
+
+    def make_meta(self, url: str, **kw) -> TaskMeta:
+        task_id = idgen.task_id(
+            url,
+            filters=kw.get("filters", ()),
+            tag=kw.get("tag", ""),
+            application=kw.get("application", ""),
+            digest=kw.get("digest", ""),
+        )
+        return TaskMeta(
+            task_id=task_id,
+            url=url,
+            digest=kw.get("digest", ""),
+            tag=kw.get("tag", ""),
+            application=kw.get("application", ""),
+            filters=tuple(kw.get("filters", ())),
+        )
+
+    async def download_task(
+        self, url: str, *, output: str | Path | None = None, seed: bool = False, **meta_kw
+    ) -> TaskStorage:
+        """Download (or reuse) a task; optionally export to a named file."""
+        await self.start()
+        meta = self.make_meta(url, **meta_kw)
+
+        ts = self.storage.find_completed_task(meta.task_id)
+        if ts is not None and ts.verify():
+            logger.info("task %s: reuse fast path", meta.task_id[:12])
+        else:
+            if ts is not None:
+                # completed-but-corrupt local copy: purge so the conductor
+                # re-fetches instead of short-circuiting on the full bitset
+                logger.warning("task %s: local copy corrupt, purging", meta.task_id[:12])
+                self.storage.delete_task(meta.task_id)
+            peer_id = idgen.peer_id(self.ip, self.hostname, seed=seed)
+            conductor = PeerTaskConductor(
+                peer_id=peer_id,
+                meta=meta,
+                host=self.host_info(),
+                scheduler=self.scheduler,
+                storage=self.storage,
+                sources=self.sources,
+                config=self.conductor_config,
+            )
+            ts = await conductor.run()
+        if output is not None:
+            await ts.export_to(output)
+        return ts
+
+    async def seed_task(self, task) -> None:
+        """seed_trigger hook for SchedulerService: pull the task from origin
+        so normal peers can parent off this engine (ref StartSeedTask +
+        seeder.ObtainSeeds, client/daemon/rpcserver/seeder.go:49-53)."""
+        await self.download_task(
+            task.url, seed=True, tag=task.tag, application=task.application,
+            digest=task.digest, filters=task.filters,
+        )

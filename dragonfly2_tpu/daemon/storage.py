@@ -1,0 +1,215 @@
+"""Piece store on disk.
+
+Parity with reference client/daemon/storage (storage_manager.go:51-108,
+local_storage.go, metadata.go): per-task data file + JSON metadata, piece
+write/read with digest validation, completed/partial task reuse lookup, and
+GC reclaim. Single sparse data file per task (pieces written at their offset)
+instead of the reference's driver split; piece state is a bitset in metadata.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+from typing import Iterable, Optional
+
+from dragonfly2_tpu.utils import digest as digestlib
+from dragonfly2_tpu.utils.bitset import Bitset
+from dragonfly2_tpu.utils.pieces import Range, piece_count, piece_range
+
+
+@dataclass
+class TaskMetadata:
+    task_id: str
+    url: str = ""
+    content_length: int = -1
+    piece_size: int = 0
+    total_pieces: int = -1
+    digest: str = ""
+    tag: str = ""
+    application: str = ""
+    finished_pieces: int = 0  # bitset int
+    piece_digests: dict[str, str] = field(default_factory=dict)  # index -> sha256 hex
+    done: bool = False
+    created_at: float = field(default_factory=time.time)
+    updated_at: float = field(default_factory=time.time)
+
+
+class TaskStorage:
+    """One task's on-disk state: <dir>/<task_id>/{data,metadata.json}."""
+
+    def __init__(self, root: Path, meta: TaskMetadata):
+        self.dir = root / meta.task_id
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.data_path = self.dir / "data"
+        self.meta = meta
+        self._bitset = Bitset(meta.finished_pieces)
+        self._lock = asyncio.Lock()
+        if not self.data_path.exists():
+            self.data_path.touch()
+
+    # ---- metadata ----
+
+    def save_metadata(self) -> None:
+        self.meta.finished_pieces = self._bitset.to_int()
+        self.meta.updated_at = time.time()
+        tmp = self.dir / "metadata.json.tmp"
+        tmp.write_text(json.dumps(asdict(self.meta)))
+        tmp.replace(self.dir / "metadata.json")
+
+    def set_task_info(
+        self, *, content_length: int, piece_size: int, total_pieces: int, digest: str = ""
+    ) -> None:
+        self.meta.content_length = content_length
+        self.meta.piece_size = piece_size
+        self.meta.total_pieces = total_pieces
+        if digest:
+            self.meta.digest = digest
+        # Preallocate so piece writes at any offset land in a right-sized file.
+        with open(self.data_path, "r+b") as f:
+            f.truncate(content_length)
+        self.save_metadata()
+
+    # ---- pieces ----
+
+    @property
+    def finished(self) -> Bitset:
+        return self._bitset
+
+    def has_piece(self, index: int) -> bool:
+        return self._bitset.test(index)
+
+    def finished_count(self) -> int:
+        return self._bitset.count()
+
+    def is_complete(self) -> bool:
+        total = self.meta.total_pieces
+        return total >= 0 and self._bitset.count() == total
+
+    async def write_piece(self, index: int, data: bytes, *, expected_digest: str = "") -> str:
+        """Write one piece at its offset; returns the piece sha256 hex."""
+        if self.meta.piece_size <= 0:
+            raise ValueError("task info not set before write_piece")
+        r = piece_range(index, self.meta.piece_size, self.meta.content_length)
+        if len(data) != r.length:
+            raise ValueError(f"piece {index}: got {len(data)} bytes, want {r.length}")
+        d = digestlib.sha256_bytes(data)
+        if expected_digest and d != expected_digest:
+            raise digestlib.InvalidDigestError(
+                f"piece {index} digest mismatch: {d[:12]} != {expected_digest[:12]}"
+            )
+        async with self._lock:
+            with open(self.data_path, "r+b") as f:
+                f.seek(r.start)
+                f.write(data)
+            if self._bitset.set(index):
+                self.meta.piece_digests[str(index)] = d
+                self.save_metadata()
+        return d
+
+    async def read_piece(self, index: int) -> bytes:
+        if not self.has_piece(index):
+            raise KeyError(f"piece {index} not present")
+        r = piece_range(index, self.meta.piece_size, self.meta.content_length)
+        return await self.read_range(r)
+
+    async def read_range(self, r: Range) -> bytes:
+        async with self._lock:
+            with open(self.data_path, "rb") as f:
+                f.seek(r.start)
+                return f.read(r.length)
+
+    def mark_done(self) -> None:
+        self.meta.done = True
+        self.save_metadata()
+
+    def verify(self) -> bool:
+        """Full-content digest check against task digest (if known)."""
+        if not self.meta.digest:
+            return True
+        try:
+            want = digestlib.parse(self.meta.digest)
+        except digestlib.InvalidDigestError:
+            return False
+        with open(self.data_path, "rb") as f:
+            got = digestlib.compute_file(want.algorithm, f)
+        return got.encoded == want.encoded
+
+    async def export_to(self, dest: str | Path) -> None:
+        """Hard-link when possible, else copy (ref storage.Store to named file)."""
+        dest = Path(dest)
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        dest.unlink(missing_ok=True)
+        try:
+            os.link(self.data_path, dest)
+        except OSError:
+            import shutil
+
+            shutil.copyfile(self.data_path, dest)
+
+
+class StorageManager:
+    """All task stores under a root dir (ref storage_manager.go Manager)."""
+
+    def __init__(self, root: str | Path):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self._tasks: dict[str, TaskStorage] = {}
+        self._load_existing()
+
+    def _load_existing(self) -> None:
+        for meta_path in self.root.glob("*/metadata.json"):
+            try:
+                meta = TaskMetadata(**json.loads(meta_path.read_text()))
+                self._tasks[meta.task_id] = TaskStorage(self.root, meta)
+            except (json.JSONDecodeError, TypeError):
+                continue
+
+    def register_task(self, task_id: str, **meta_kw) -> TaskStorage:
+        ts = self._tasks.get(task_id)
+        if ts is None:
+            ts = TaskStorage(self.root, TaskMetadata(task_id=task_id, **meta_kw))
+            ts.save_metadata()
+            self._tasks[task_id] = ts
+        return ts
+
+    def get(self, task_id: str) -> TaskStorage | None:
+        return self._tasks.get(task_id)
+
+    def find_completed_task(self, task_id: str) -> TaskStorage | None:
+        """Reuse fast path (ref FindCompletedTask, storage_manager.go:100-105)."""
+        ts = self._tasks.get(task_id)
+        return ts if ts is not None and ts.meta.done and ts.is_complete() else None
+
+    def find_partial_task(self, task_id: str) -> TaskStorage | None:
+        ts = self._tasks.get(task_id)
+        return ts if ts is not None and ts.finished_count() > 0 else None
+
+    def delete_task(self, task_id: str) -> None:
+        ts = self._tasks.pop(task_id, None)
+        if ts is not None:
+            import shutil
+
+            shutil.rmtree(ts.dir, ignore_errors=True)
+
+    def tasks(self) -> list[TaskStorage]:
+        return list(self._tasks.values())
+
+    def reclaim(self, *, ttl: float = 24 * 3600) -> int:
+        """Drop tasks idle past ttl (ref Reclaimer + gc_manager.go loop)."""
+        now = time.time()
+        n = 0
+        for tid, ts in list(self._tasks.items()):
+            if now - ts.meta.updated_at > ttl:
+                self.delete_task(tid)
+                n += 1
+        return n
+
+    def total_bytes(self) -> int:
+        return sum(
+            ts.data_path.stat().st_size for ts in self._tasks.values() if ts.data_path.exists()
+        )

@@ -1,0 +1,124 @@
+"""Piece upload server: serves stored pieces to child peers over HTTP.
+
+Parity with reference client/daemon/upload/upload_manager.go:92-127,214
+(HTTP GET /download/{taskID[:3]}/{taskID}?peerId= with Range headers) plus a
+piece-metadata endpoint replacing the reference's gRPC GetPieceTasks/
+SyncPieceTasks streams (rpcserver.go:151,268): children poll
+GET /metadata/{taskID} for the parent's finished-piece bitset + digests.
+Rate-limited by the shared token bucket (1 GiB/s default upload cap,
+ref client/config/constants.go:47).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+from aiohttp import web
+
+from dragonfly2_tpu.daemon.storage import StorageManager
+from dragonfly2_tpu.utils.pieces import parse_http_range
+from dragonfly2_tpu.utils.ratelimit import TokenBucket
+
+logger = logging.getLogger(__name__)
+
+
+class UploadServer:
+    def __init__(
+        self,
+        storage: StorageManager,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        rate_limit_bps: float = 1 << 30,
+    ):
+        self.storage = storage
+        self.host = host
+        self.port = port
+        self.bucket = TokenBucket(rate_limit_bps, burst=64 << 20)
+        self.bytes_served = 0
+        self.pieces_served = 0
+        self._runner: web.AppRunner | None = None
+
+    def _app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get("/download/{prefix}/{task_id}", self._handle_download)
+        app.router.add_get("/metadata/{task_id}", self._handle_metadata)
+        app.router.add_get("/healthz", self._handle_health)
+        return app
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self._app(), access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        # resolve the ephemeral port
+        self.port = site._server.sockets[0].getsockname()[1]
+        logger.info("upload server on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+    async def _handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"ok": True})
+
+    async def _handle_metadata(self, request: web.Request) -> web.Response:
+        task_id = request.match_info["task_id"]
+        ts = self.storage.get(task_id)
+        if ts is None:
+            raise web.HTTPNotFound(text=f"task {task_id} unknown")
+        m = ts.meta
+        return web.json_response(
+            {
+                "task_id": task_id,
+                "content_length": m.content_length,
+                "piece_size": m.piece_size,
+                "total_pieces": m.total_pieces,
+                "digest": m.digest,
+                "finished_pieces": sorted(ts.finished.indices()),
+                "piece_digests": m.piece_digests,
+                "done": m.done,
+            }
+        )
+
+    async def _handle_download(self, request: web.Request) -> web.StreamResponse:
+        task_id = request.match_info["task_id"]
+        if request.match_info["prefix"] != task_id[:3]:
+            raise web.HTTPBadRequest(text="prefix/task mismatch")
+        ts = self.storage.get(task_id)
+        if ts is None:
+            raise web.HTTPNotFound(text=f"task {task_id} unknown")
+        total = ts.meta.content_length
+        if total <= 0 or ts.meta.piece_size <= 0:
+            raise web.HTTPNotFound(text=f"task {task_id} metadata not ready")
+        range_header = request.headers.get("Range")
+        if range_header is None:
+            raise web.HTTPBadRequest(text="Range header required (piece-granular server)")
+        try:
+            rng = parse_http_range(range_header, total)
+        except ValueError as e:
+            raise web.HTTPRequestRangeNotSatisfiable(text=str(e))
+
+        # The requested range must be fully covered by finished pieces.
+        psize = ts.meta.piece_size
+        first_piece = rng.start // psize
+        last_piece = (rng.start + rng.length - 1) // psize
+        for idx in range(first_piece, last_piece + 1):
+            if not ts.has_piece(idx):
+                raise web.HTTPNotFound(text=f"piece {idx} not yet available")
+
+        await self.bucket.acquire(rng.length)
+        data = await ts.read_range(rng)
+        self.bytes_served += len(data)
+        self.pieces_served += 1
+        return web.Response(
+            status=206,
+            body=data,
+            headers={
+                "Content-Range": f"bytes {rng.start}-{rng.end}/{total}",
+                "Content-Type": "application/octet-stream",
+            },
+        )

@@ -1,0 +1,2 @@
+"""Control plane: per-cluster parent selection, peer/task/host state machines,
+telemetry capture, network topology (reference scheduler/ equivalents)."""

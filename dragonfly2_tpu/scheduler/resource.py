@@ -1,0 +1,395 @@
+"""In-memory cluster resource model: Host, Task (peer DAG), Peer FSMs.
+
+Parity with reference scheduler/resource/ (task.go:105-169, peer.go:50-243,
+host.go:112-316): a Task owns a DAG of Peers (parents serve pieces to
+children), every Peer transition is FSM-gated, Hosts carry capacity stats and
+upload accounting, and managers GC by TTL. Redesigned async-native: one
+process-wide event loop, plain dicts + the shared GC registry instead of
+goroutine-per-stream.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Iterable, Optional
+
+from dragonfly2_tpu.utils import idgen
+from dragonfly2_tpu.utils.bitset import Bitset
+from dragonfly2_tpu.utils.dag import DAG, CycleError, VertexNotFound
+from dragonfly2_tpu.utils.fsm import FSM, Event
+from dragonfly2_tpu.utils.pieces import compute_piece_size, piece_count
+
+
+class HostType(str, Enum):
+    NORMAL = "normal"
+    SEED = "seed"
+
+
+class SizeScope(str, Enum):
+    """Task size classes driving the scheduling fast paths (ref task.go SizeScope)."""
+
+    EMPTY = "empty"  # 0 bytes: respond inline, no transfer at all
+    TINY = "tiny"  # <= 128 B: bytes ride inside the scheduler response
+    SMALL = "small"  # single piece: one parent, no DAG fan-out
+    NORMAL = "normal"  # multi-piece P2P tree
+    UNKNOWN = "unknown"
+
+    @classmethod
+    def of(cls, content_length: int | None, piece_size: int) -> "SizeScope":
+        if content_length is None or content_length < 0:
+            return cls.UNKNOWN
+        if content_length == 0:
+            return cls.EMPTY
+        if content_length <= TINY_FILE_SIZE:
+            return cls.TINY
+        if content_length <= piece_size:
+            return cls.SMALL
+        return cls.NORMAL
+
+
+TINY_FILE_SIZE = 128
+
+# Peer FSM (reference peer.go:50-130 has ten states; the Received* family is
+# parameterized here by size scope instead of four distinct states).
+PEER_PENDING = "pending"
+PEER_RECEIVED = "received"
+PEER_RUNNING = "running"
+PEER_BACK_TO_SOURCE = "back_to_source"
+PEER_SUCCEEDED = "succeeded"
+PEER_FAILED = "failed"
+PEER_LEAVE = "leave"
+
+_PEER_EVENTS = [
+    Event("register", [PEER_PENDING], PEER_RECEIVED),
+    Event("download", [PEER_RECEIVED], PEER_RUNNING),
+    Event("back_to_source", [PEER_PENDING, PEER_RECEIVED, PEER_RUNNING], PEER_BACK_TO_SOURCE),
+    Event("succeed", [PEER_RUNNING, PEER_BACK_TO_SOURCE], PEER_SUCCEEDED),
+    Event("fail", [PEER_PENDING, PEER_RECEIVED, PEER_RUNNING, PEER_BACK_TO_SOURCE], PEER_FAILED),
+    Event("restart", [PEER_SUCCEEDED, PEER_FAILED], PEER_RECEIVED),
+    Event(
+        "leave",
+        [PEER_PENDING, PEER_RECEIVED, PEER_RUNNING, PEER_BACK_TO_SOURCE, PEER_SUCCEEDED, PEER_FAILED],
+        PEER_LEAVE,
+    ),
+]
+
+TASK_PENDING = "pending"
+TASK_RUNNING = "running"
+TASK_SUCCEEDED = "succeeded"
+TASK_FAILED = "failed"
+
+_TASK_EVENTS = [
+    Event("download", [TASK_PENDING, TASK_SUCCEEDED, TASK_FAILED], TASK_RUNNING),
+    Event("succeed", [TASK_RUNNING], TASK_SUCCEEDED),
+    Event("fail", [TASK_RUNNING], TASK_FAILED),
+]
+
+
+@dataclass
+class HostStats:
+    """Observable host signals feeding NODE_FEATURE_NAMES (announced by daemons)."""
+
+    cpu_usage: float = 0.0
+    mem_usage: float = 0.0
+    disk_usage: float = 0.0
+    network_tx_bps: float = 0.0
+    network_rx_bps: float = 0.0
+
+
+class Host:
+    """A machine running a peer daemon (ref host.go:112-316)."""
+
+    def __init__(
+        self,
+        host_id: str,
+        ip: str,
+        hostname: str,
+        *,
+        port: int = 0,
+        download_port: int = 0,
+        host_type: HostType = HostType.NORMAL,
+        idc: str = "",
+        location: str = "",
+        upload_limit: int = 40,
+    ):
+        self.id = host_id
+        self.ip = ip
+        self.hostname = hostname
+        self.port = port
+        self.download_port = download_port
+        self.type = host_type
+        self.idc = idc
+        self.location = location
+        self.upload_limit = upload_limit
+        self.stats = HostStats()
+        self.concurrent_uploads = 0
+        self.upload_count = 0
+        self.upload_failed_count = 0
+        self.peer_ids: set[str] = set()
+        self.created_at = time.monotonic()
+        self.updated_at = time.monotonic()
+
+    @property
+    def free_upload_slots(self) -> int:
+        return max(0, self.upload_limit - self.concurrent_uploads)
+
+    @property
+    def upload_success_rate(self) -> float:
+        total = self.upload_count + self.upload_failed_count
+        return self.upload_count / total if total else 1.0
+
+    def touch(self) -> None:
+        self.updated_at = time.monotonic()
+
+
+class Peer:
+    """One download attempt of a task by a host (ref peer.go:50-243)."""
+
+    def __init__(self, peer_id: str, task: "Task", host: Host):
+        self.id = peer_id
+        self.task = task
+        self.host = host
+        self.fsm = FSM(PEER_PENDING, _PEER_EVENTS)
+        self.finished_pieces = Bitset()
+        self.piece_costs_ms: deque[float] = deque(maxlen=20)
+        self.block_parents: set[str] = set()
+        self.range = None
+        self.schedule_rounds = 0
+        self.created_at = time.monotonic()
+        self.updated_at = time.monotonic()
+
+    @property
+    def state(self) -> str:
+        return self.fsm.current
+
+    @property
+    def is_seed(self) -> bool:
+        return idgen.is_seed_peer_id(self.id) or self.host.type == HostType.SEED
+
+    def finished_piece_ratio(self) -> float:
+        total = self.task.total_pieces or 0
+        if total <= 0:
+            return 1.0 if self.fsm.is_(PEER_SUCCEEDED) else 0.0
+        return self.finished_pieces.count() / total
+
+    def add_piece_cost(self, ms: float) -> None:
+        self.piece_costs_ms.append(ms)
+        self.touch()
+
+    def depth(self) -> int:
+        """Distance to a DAG root (seed/back-to-source peer)."""
+        depth, cur = 1, self
+        seen = {self.id}
+        while True:
+            parents = self.task.parents_of(cur.id)
+            if not parents:
+                return depth
+            nxt = parents[0]
+            if nxt.id in seen or depth > 10:
+                return depth
+            seen.add(nxt.id)
+            cur = nxt
+            depth += 1
+
+    def touch(self) -> None:
+        self.updated_at = time.monotonic()
+
+
+class Task:
+    """A content-addressed object being distributed (ref task.go:105-169)."""
+
+    def __init__(
+        self,
+        task_id: str,
+        url: str,
+        *,
+        digest: str = "",
+        tag: str = "",
+        application: str = "",
+        filters: tuple[str, ...] = (),
+    ):
+        self.id = task_id
+        self.url = url
+        self.digest = digest
+        self.tag = tag
+        self.application = application
+        self.filters = filters
+        self.fsm = FSM(TASK_PENDING, _TASK_EVENTS)
+        self.content_length: int | None = None
+        self.piece_size: int = 0
+        self.total_pieces: int | None = None
+        self.direct_piece: bytes = b""  # TINY scope payload
+        self.dag: DAG[Peer] = DAG()
+        self.back_to_source_budget = 3  # concurrent back-source peers (ref constants.go:66-70)
+        self.created_at = time.monotonic()
+        self.updated_at = time.monotonic()
+
+    @property
+    def state(self) -> str:
+        return self.fsm.current
+
+    def size_scope(self) -> SizeScope:
+        return SizeScope.of(self.content_length, self.piece_size or compute_piece_size(self.content_length or 0))
+
+    def set_metadata(self, content_length: int, piece_size: int | None = None) -> None:
+        self.content_length = content_length
+        self.piece_size = piece_size or compute_piece_size(content_length)
+        self.total_pieces = piece_count(content_length, self.piece_size)
+        self.touch()
+
+    # ---- peer DAG (ref task.go AddPeerEdge/DeletePeerInEdges) ----
+
+    def add_peer(self, peer: Peer) -> None:
+        self.dag.add_vertex(peer.id, peer)
+        peer.host.peer_ids.add(peer.id)
+
+    def delete_peer(self, peer_id: str) -> None:
+        try:
+            peer = self.dag.vertex(peer_id).value
+            peer.host.peer_ids.discard(peer_id)
+        except VertexNotFound:
+            pass
+        self.dag.delete_vertex(peer_id)
+
+    def peer(self, peer_id: str) -> Peer | None:
+        try:
+            return self.dag.vertex(peer_id).value
+        except VertexNotFound:
+            return None
+
+    def peers(self) -> list[Peer]:
+        return list(self.dag.values())
+
+    def peer_count(self) -> int:
+        return len(self.dag)
+
+    def add_edge(self, parent_id: str, child_id: str) -> None:
+        self.dag.add_edge(parent_id, child_id)
+        parent = self.peer(parent_id)
+        if parent:
+            parent.host.concurrent_uploads += 1
+
+    def can_add_edge(self, parent_id: str, child_id: str) -> bool:
+        return self.dag.can_add_edge(parent_id, child_id)
+
+    def delete_parents(self, child_id: str) -> None:
+        try:
+            for pid in list(self.dag.vertex(child_id).parents):
+                parent = self.peer(pid)
+                if parent:
+                    parent.host.concurrent_uploads = max(0, parent.host.concurrent_uploads - 1)
+            self.dag.delete_in_edges(child_id)
+        except VertexNotFound:
+            pass
+
+    def parents_of(self, peer_id: str) -> list[Peer]:
+        try:
+            v = self.dag.vertex(peer_id)
+        except VertexNotFound:
+            return []
+        return [self.dag.vertex(p).value for p in v.parents]
+
+    def children_of(self, peer_id: str) -> list[Peer]:
+        try:
+            v = self.dag.vertex(peer_id)
+        except VertexNotFound:
+            return []
+        return [self.dag.vertex(c).value for c in v.children]
+
+    def has_available_peer(self, blocklist: set[str] = frozenset()) -> bool:
+        return any(
+            p.id not in blocklist and p.fsm.current in (PEER_RUNNING, PEER_BACK_TO_SOURCE, PEER_SUCCEEDED)
+            for p in self.dag.values()
+        )
+
+    def can_back_to_source(self) -> bool:
+        active = sum(1 for p in self.dag.values() if p.fsm.is_(PEER_BACK_TO_SOURCE))
+        return active < self.back_to_source_budget
+
+    def touch(self) -> None:
+        self.updated_at = time.monotonic()
+
+
+# ---- managers with TTL GC (ref peer_manager.go / task_manager.go / host_manager.go) ----
+
+
+@dataclass
+class GCPolicy:
+    """Reference defaults: peer TTL 24h, task 30min idle, host 6h idle
+    (scheduler/config/constants.go:81-93)."""
+
+    peer_ttl: float = 24 * 3600
+    task_ttl: float = 30 * 60
+    host_ttl: float = 6 * 3600
+
+
+class ResourcePool:
+    """Hosts + tasks + peers with shared GC; the scheduler's world state."""
+
+    def __init__(self, gc_policy: GCPolicy | None = None):
+        self.hosts: dict[str, Host] = {}
+        self.tasks: dict[str, Task] = {}
+        self._peer_index: dict[str, Peer] = {}
+        self.gc_policy = gc_policy or GCPolicy()
+
+    # hosts
+    def load_or_create_host(self, host_id: str, ip: str, hostname: str, **kw: Any) -> Host:
+        host = self.hosts.get(host_id)
+        if host is None:
+            host = Host(host_id, ip, hostname, **kw)
+            self.hosts[host_id] = host
+        host.touch()
+        return host
+
+    # tasks
+    def load_or_create_task(self, task_id: str, url: str, **kw: Any) -> Task:
+        task = self.tasks.get(task_id)
+        if task is None:
+            task = Task(task_id, url, **kw)
+            self.tasks[task_id] = task
+        task.touch()
+        return task
+
+    # peers
+    def create_peer(self, peer_id: str, task: Task, host: Host) -> Peer:
+        existing = task.peer(peer_id)
+        if existing is not None:
+            return existing
+        peer = Peer(peer_id, task, host)
+        task.add_peer(peer)
+        self._peer_index[peer_id] = peer
+        return peer
+
+    def peer(self, peer_id: str) -> Peer | None:
+        return self._peer_index.get(peer_id)
+
+    def delete_peer(self, peer_id: str) -> None:
+        peer = self._peer_index.pop(peer_id, None)
+        if peer is not None:
+            peer.task.delete_parents(peer_id)
+            # release upload slots this peer held as a parent
+            for child in peer.task.children_of(peer_id):
+                peer.host.concurrent_uploads = max(0, peer.host.concurrent_uploads - 1)
+            peer.task.delete_peer(peer_id)
+
+    def gc(self) -> dict[str, int]:
+        """TTL sweep; returns counts removed (wired into utils.gcreg)."""
+        now = time.monotonic()
+        removed = {"peers": 0, "tasks": 0, "hosts": 0}
+        for pid, peer in list(self._peer_index.items()):
+            expired = now - peer.updated_at > self.gc_policy.peer_ttl
+            if expired or peer.fsm.is_(PEER_LEAVE):
+                self.delete_peer(pid)
+                removed["peers"] += 1
+        for tid, task in list(self.tasks.items()):
+            if task.peer_count() == 0 and now - task.updated_at > self.gc_policy.task_ttl:
+                del self.tasks[tid]
+                removed["tasks"] += 1
+        for hid, host in list(self.hosts.items()):
+            if not host.peer_ids and now - host.updated_at > self.gc_policy.host_ttl:
+                del self.hosts[hid]
+                removed["hosts"] += 1
+        return removed

@@ -1,0 +1,168 @@
+"""Parent-selection algorithm.
+
+Parity with reference scheduler/scheduling/scheduling.go:81-207 and the
+constants at scheduler/config/constants.go:36-76: per round, sample up to 40
+random peers from the task DAG, run the candidate filters, score the
+survivors with the (batched) evaluator, and hand back the top 4; retry up to
+10 times at 50 ms intervals, escalating to back-to-source after 5 empty
+rounds.
+
+The retry loop is async (the reference used a goroutine sleep loop); filters
+are pure functions over the resource model so they unit-test without mocks.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from dragonfly2_tpu.scheduler.evaluator import Evaluator
+from dragonfly2_tpu.scheduler.resource import (
+    PEER_BACK_TO_SOURCE,
+    PEER_RECEIVED,
+    PEER_RUNNING,
+    PEER_SUCCEEDED,
+    Peer,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class SchedulingConfig:
+    """Reference defaults (scheduler/config/constants.go:36-79)."""
+
+    candidate_parent_limit: int = 4
+    filter_parent_limit: int = 40
+    retry_limit: int = 10
+    retry_back_to_source_limit: int = 5
+    retry_interval: float = 0.05
+    max_tree_depth: int = 4
+
+
+@dataclass
+class ScheduleOutcome:
+    """One scheduling decision for a child peer."""
+
+    parents: list[Peer] = field(default_factory=list)
+    back_to_source: bool = False
+    rounds: int = 0
+
+
+class Scheduling:
+    def __init__(self, evaluator: Evaluator, config: SchedulingConfig | None = None):
+        self.evaluator = evaluator
+        self.config = config or SchedulingConfig()
+        self._rng = random.Random(0)
+
+    # ---- filters (ref filterCandidateParents' 8 conditions) ----
+
+    def _filters(self, child: Peer, blocklist: set[str]) -> list[Callable[[Peer], bool]]:
+        task = child.task
+        lineage: set[str] = set()
+        try:
+            lineage = task.dag.lineage(child.id)
+        except Exception:
+            pass
+
+        def not_blocked(p: Peer) -> bool:
+            return p.id not in blocklist and p.id not in child.block_parents
+
+        def not_self(p: Peer) -> bool:
+            return p.id != child.id
+
+        def different_host(p: Peer) -> bool:
+            return p.host.id != child.host.id
+
+        def parent_state_ok(p: Peer) -> bool:
+            return p.fsm.current in (PEER_RUNNING, PEER_BACK_TO_SOURCE, PEER_SUCCEEDED)
+
+        def not_bad_node(p: Peer) -> bool:
+            return not self.evaluator.is_bad_node(p)
+
+        def has_upload_slot(p: Peer) -> bool:
+            return p.host.free_upload_slots > 0
+
+        def no_cycle(p: Peer) -> bool:
+            # adding p -> child must not create a cycle (p in child's
+            # descendant lineage would); also p must not already be the child's
+            # parent (re-pick wastes a slot)
+            return p.id not in lineage and task.can_add_edge(p.id, child.id)
+
+        def depth_ok(p: Peer) -> bool:
+            return p.depth() < self.config.max_tree_depth
+
+        return [
+            not_blocked,
+            not_self,
+            different_host,
+            parent_state_ok,
+            not_bad_node,
+            has_upload_slot,
+            no_cycle,
+            depth_ok,
+        ]
+
+    def find_candidate_parents(
+        self, child: Peer, blocklist: set[str] = frozenset()
+    ) -> list[Peer]:
+        """One filtering+scoring round: sample ≤40, filter, score, top-4."""
+        task = child.task
+        sample = [v.value for v in task.dag.random_vertices(self.config.filter_parent_limit, self._rng)]
+        filters = self._filters(child, set(blocklist))
+        candidates = [p for p in sample if all(f(p) for f in filters)]
+        if not candidates:
+            return []
+        scores = np.asarray(self.evaluator.evaluate(child, candidates))
+        order = np.argsort(-scores, kind="stable")
+        top = [candidates[i] for i in order[: self.config.candidate_parent_limit]]
+        logger.debug(
+            "schedule %s: %d sampled, %d candidates, top %s",
+            child.id, len(sample), len(candidates), [p.id for p in top],
+        )
+        return top
+
+    def find_success_parent(self, child: Peer, blocklist: set[str] = frozenset()) -> Peer | None:
+        """SMALL-scope path: a single finished parent (ref FindSuccessParent)."""
+        task = child.task
+        filters = self._filters(child, set(blocklist))
+        done = [
+            p
+            for p in task.peers()
+            if p.fsm.is_(PEER_SUCCEEDED) and all(f(p) for f in filters)
+        ]
+        if not done:
+            return None
+        scores = np.asarray(self.evaluator.evaluate(child, done))
+        return done[int(np.argmax(scores))]
+
+    async def schedule_candidate_parents(
+        self, child: Peer, blocklist: set[str] = frozenset()
+    ) -> ScheduleOutcome:
+        """Retry loop with back-to-source escalation (ref scheduling.go:81-153)."""
+        cfg = self.config
+        for attempt in range(cfg.retry_limit):
+            if child.fsm.is_(PEER_BACK_TO_SOURCE):
+                return ScheduleOutcome(back_to_source=True, rounds=attempt)
+            if attempt >= cfg.retry_back_to_source_limit and child.task.can_back_to_source():
+                child.fsm.fire("back_to_source")
+                return ScheduleOutcome(back_to_source=True, rounds=attempt)
+            parents = self.find_candidate_parents(child, blocklist)
+            if parents:
+                task = child.task
+                task.delete_parents(child.id)
+                for p in parents:
+                    task.add_edge(p.id, child.id)
+                child.schedule_rounds += 1
+                return ScheduleOutcome(parents=parents, rounds=attempt + 1)
+            await asyncio.sleep(cfg.retry_interval)
+        # retries exhausted: last resort is back-to-source, else failure
+        if child.task.can_back_to_source():
+            child.fsm.fire("back_to_source")
+            return ScheduleOutcome(back_to_source=True, rounds=cfg.retry_limit)
+        return ScheduleOutcome(rounds=cfg.retry_limit)

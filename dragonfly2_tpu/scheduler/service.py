@@ -1,0 +1,383 @@
+"""Scheduler service: the control-plane business logic.
+
+Parity with reference scheduler/service/service_v2.go (AnnouncePeer handler
+family, :81-189 and :641-1308) and service_v1.go: peer registration with
+size-scope fast paths (EMPTY/TINY inline, SMALL single-parent, NORMAL DAG),
+piece-result accounting, peer-result completion with telemetry records,
+host announce/leave, and seed-peer triggering. In-process async API; the RPC
+server wraps these methods 1:1, so the full logic is testable without sockets
+(the reference needed 4,182 lines of mock-stream tests for the same coverage,
+SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Awaitable, Callable, Optional
+
+import numpy as np
+
+from dragonfly2_tpu.scheduler.evaluator import Evaluator, build_pair_features, new_evaluator
+from dragonfly2_tpu.scheduler.resource import (
+    GCPolicy,
+    Host,
+    HostType,
+    PEER_BACK_TO_SOURCE,
+    PEER_FAILED,
+    PEER_LEAVE,
+    PEER_RUNNING,
+    PEER_SUCCEEDED,
+    Peer,
+    ResourcePool,
+    SizeScope,
+    Task,
+)
+from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+from dragonfly2_tpu.telemetry import TelemetryStorage
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class HostInfo:
+    id: str
+    ip: str
+    hostname: str
+    port: int = 0
+    download_port: int = 0
+    type: str = "normal"
+    idc: str = ""
+    location: str = ""
+
+
+@dataclass
+class TaskMeta:
+    task_id: str
+    url: str
+    digest: str = ""
+    tag: str = ""
+    application: str = ""
+    filters: tuple = ()
+
+
+@dataclass
+class ParentInfo:
+    """What a child needs to reach a parent's piece server."""
+
+    peer_id: str
+    host_id: str
+    ip: str
+    download_port: int
+
+    @classmethod
+    def of(cls, p: Peer) -> "ParentInfo":
+        return cls(p.id, p.host.id, p.host.ip, p.host.download_port)
+
+
+@dataclass
+class RegisterResult:
+    scope: str
+    task_id: str
+    back_to_source: bool = False
+    parents: list[ParentInfo] = field(default_factory=list)
+    direct_piece: bytes = b""
+    content_length: int | None = None
+    piece_size: int | None = None
+    total_pieces: int | None = None
+    digest: str = ""
+
+
+class SchedulerService:
+    def __init__(
+        self,
+        *,
+        evaluator: Evaluator | None = None,
+        scheduling_config: SchedulingConfig | None = None,
+        telemetry: TelemetryStorage | None = None,
+        gc_policy: GCPolicy | None = None,
+        seed_trigger: Callable[[Task], Awaitable[None]] | None = None,
+    ):
+        self.pool = ResourcePool(gc_policy)
+        self.evaluator = evaluator or new_evaluator("base")
+        self.scheduling = Scheduling(self.evaluator, scheduling_config)
+        self.telemetry = telemetry
+        self.seed_trigger = seed_trigger
+        self._seed_triggered: set[str] = set()
+
+    # ---- registration (ref handleRegisterPeerRequest → schedule()) ----
+
+    async def register_peer(
+        self, peer_id: str, meta: TaskMeta, host_info: HostInfo
+    ) -> RegisterResult:
+        host = self.pool.load_or_create_host(
+            host_info.id,
+            host_info.ip,
+            host_info.hostname,
+            port=host_info.port,
+            download_port=host_info.download_port,
+            host_type=HostType(host_info.type),
+            idc=host_info.idc,
+            location=host_info.location,
+        )
+        task = self.pool.load_or_create_task(
+            meta.task_id,
+            meta.url,
+            digest=meta.digest,
+            tag=meta.tag,
+            application=meta.application,
+            filters=tuple(meta.filters),
+        )
+        peer = self.pool.create_peer(peer_id, task, host)
+        if task.fsm.can("download"):
+            task.fsm.fire("download")
+
+        def ensure_received() -> None:
+            # Idempotent for RPC retries: a reused peer may already be past
+            # PENDING; finished peers restart (ref FSM "restart" event).
+            if peer.fsm.can("register"):
+                peer.fsm.fire("register")
+            elif peer.fsm.can("restart"):
+                peer.fsm.fire("restart")
+
+        # Unstarted task: hand it to a seed peer if we have one, else this
+        # peer goes back-to-source (ref downloadTaskBySeedPeer, :1134).
+        if not task.has_available_peer(blocklist={peer.id}):
+            if (
+                self.seed_trigger is not None
+                and task.id not in self._seed_triggered
+                and host.type != HostType.SEED
+            ):
+                self._seed_triggered.add(task.id)
+                asyncio.ensure_future(self._run_seed_trigger(task))
+            ensure_received()
+            if peer.fsm.can("back_to_source"):
+                peer.fsm.fire("back_to_source")
+            return RegisterResult(
+                scope=SizeScope.UNKNOWN.value, task_id=task.id, back_to_source=True
+            )
+
+        scope = task.size_scope()
+        common = dict(
+            task_id=task.id,
+            content_length=task.content_length,
+            piece_size=task.piece_size,
+            total_pieces=task.total_pieces,
+            digest=task.digest,
+        )
+        if scope == SizeScope.EMPTY:
+            ensure_received()
+            return RegisterResult(scope=scope.value, **common)
+        if scope == SizeScope.TINY and task.direct_piece:
+            ensure_received()
+            return RegisterResult(scope=scope.value, direct_piece=task.direct_piece, **common)
+        if scope == SizeScope.SMALL:
+            parent = self.scheduling.find_success_parent(peer)
+            if parent is not None:
+                ensure_received()
+                task.add_edge(parent.id, peer.id)
+                return RegisterResult(
+                    scope=scope.value, parents=[ParentInfo.of(parent)], **common
+                )
+        # NORMAL (or SMALL fallback): full scheduling round
+        ensure_received()
+        outcome = await self.scheduling.schedule_candidate_parents(peer)
+        if outcome.back_to_source:
+            return RegisterResult(
+                scope=SizeScope.NORMAL.value, task_id=task.id, back_to_source=True,
+                content_length=task.content_length, piece_size=task.piece_size,
+                total_pieces=task.total_pieces, digest=task.digest,
+            )
+        if peer.fsm.can("download"):
+            peer.fsm.fire("download")
+        return RegisterResult(
+            scope=SizeScope.NORMAL.value,
+            parents=[ParentInfo.of(p) for p in outcome.parents],
+            **common,
+        )
+
+    async def _run_seed_trigger(self, task: Task) -> None:
+        try:
+            await self.seed_trigger(task)
+        except Exception:
+            logger.exception("seed trigger failed for task %s", task.id)
+            self._seed_triggered.discard(task.id)
+
+    # ---- metadata from the first back-to-source peer ----
+
+    def report_task_metadata(
+        self,
+        task_id: str,
+        *,
+        content_length: int,
+        piece_size: int | None = None,
+        digest: str = "",
+        direct_piece: bytes = b"",
+    ) -> None:
+        task = self.pool.tasks.get(task_id)
+        if task is None:
+            return
+        task.set_metadata(content_length, piece_size)
+        if digest:
+            task.digest = digest
+        if direct_piece:
+            task.direct_piece = direct_piece
+
+    # ---- piece + peer results (ref handleDownloadPiece*Request) ----
+
+    def report_piece_result(
+        self,
+        peer_id: str,
+        piece_index: int,
+        *,
+        success: bool,
+        cost_ms: float = 0.0,
+        parent_id: str = "",
+    ) -> None:
+        peer = self.pool.peer(peer_id)
+        if peer is None:
+            return
+        peer.touch()
+        if success:
+            if peer.fsm.can("download"):
+                peer.fsm.fire("download")
+            peer.finished_pieces.set(piece_index)
+            peer.add_piece_cost(cost_ms)
+            if parent_id:
+                parent = self.pool.peer(parent_id)
+                if parent is not None:
+                    parent.host.upload_count += 1
+                    parent.touch()
+        else:
+            if parent_id:
+                parent = self.pool.peer(parent_id)
+                if parent is not None:
+                    parent.host.upload_failed_count += 1
+                peer.block_parents.add(parent_id)
+
+    async def reschedule(self, peer_id: str) -> RegisterResult:
+        """Child lost its parents; run another round (ref reschedule path)."""
+        peer = self.pool.peer(peer_id)
+        if peer is None:
+            raise KeyError(peer_id)
+        task = peer.task
+        outcome = await self.scheduling.schedule_candidate_parents(peer, blocklist=peer.block_parents)
+        if outcome.back_to_source:
+            return RegisterResult(
+                scope=task.size_scope().value, task_id=task.id, back_to_source=True,
+                content_length=task.content_length, piece_size=task.piece_size,
+                total_pieces=task.total_pieces, digest=task.digest,
+            )
+        return RegisterResult(
+            scope=task.size_scope().value,
+            task_id=task.id,
+            parents=[ParentInfo.of(p) for p in outcome.parents],
+            content_length=task.content_length,
+            piece_size=task.piece_size,
+            total_pieces=task.total_pieces,
+            digest=task.digest,
+        )
+
+    def report_peer_result(
+        self, peer_id: str, *, success: bool, bandwidth_bps: float = 0.0
+    ) -> None:
+        peer = self.pool.peer(peer_id)
+        if peer is None:
+            return
+        task = peer.task
+        if success:
+            if peer.fsm.can("succeed"):
+                peer.fsm.fire("succeed")
+            if task.fsm.can("succeed"):
+                task.fsm.fire("succeed")
+        else:
+            if peer.fsm.can("fail"):
+                peer.fsm.fire("fail")
+            if not task.has_available_peer() and task.fsm.can("fail"):
+                task.fsm.fire("fail")
+        self._record_download(peer, success, bandwidth_bps)
+        # The peer stops downloading either way: release its parents' upload
+        # slots now, not at the 24h GC (it stays in the DAG as a parent).
+        task.delete_parents(peer_id)
+
+    def _record_download(self, peer: Peer, success: bool, bandwidth_bps: float) -> None:
+        """Telemetry emit (ref createDownloadRecord, service_v1.go:1241)."""
+        if self.telemetry is None:
+            return
+        task = peer.task
+        parents = task.parents_of(peer.id)
+        costs = peer.piece_costs_ms
+        base = dict(
+            task_id=task.id.encode()[:64],
+            child_peer_id=peer.id.encode()[:64],
+            child_host_id=peer.host.id.encode()[:64],
+            piece_count=peer.finished_pieces.count(),
+            piece_size=task.piece_size or 0,
+            content_length=task.content_length or -1,
+            bandwidth_bps=bandwidth_bps,
+            piece_cost_ms_mean=float(np.mean(costs)) if costs else 0.0,
+            success=success,
+            back_to_source=peer.fsm.is_(PEER_BACK_TO_SOURCE) or peer.state == PEER_SUCCEEDED and not parents,
+        )
+        if parents:
+            feats = build_pair_features(peer, parents)
+            for p, f in zip(parents, feats):
+                self.telemetry.downloads.append(
+                    parent_peer_id=p.id.encode()[:64],
+                    parent_host_id=p.host.id.encode()[:64],
+                    pair_features=f,
+                    **base,
+                )
+        else:
+            self.telemetry.downloads.append(
+                parent_peer_id=b"", parent_host_id=b"",
+                pair_features=np.zeros(16, np.float32), **base,
+            )
+
+    # ---- host lifecycle (ref AnnounceHost / LeaveHost / LeaveTask) ----
+
+    def announce_host(self, info: HostInfo, stats: dict[str, float] | None = None) -> None:
+        host = self.pool.load_or_create_host(
+            info.id, info.ip, info.hostname,
+            port=info.port, download_port=info.download_port,
+            host_type=HostType(info.type), idc=info.idc, location=info.location,
+        )
+        if stats:
+            for k, v in stats.items():
+                if hasattr(host.stats, k):
+                    setattr(host.stats, k, float(v))
+        host.touch()
+
+    def leave_peer(self, peer_id: str) -> None:
+        peer = self.pool.peer(peer_id)
+        if peer is None:
+            return
+        if peer.fsm.can("leave"):
+            peer.fsm.fire("leave")
+        # children of this peer must reschedule; drop its edges now
+        self.pool.delete_peer(peer_id)
+
+    def leave_host(self, host_id: str) -> None:
+        host = self.pool.hosts.get(host_id)
+        if host is None:
+            return
+        for pid in list(host.peer_ids):
+            self.leave_peer(pid)
+        del self.pool.hosts[host_id]
+
+    def stat_task(self, task_id: str) -> dict[str, Any] | None:
+        task = self.pool.tasks.get(task_id)
+        if task is None:
+            return None
+        return {
+            "id": task.id,
+            "url": task.url,
+            "state": task.state,
+            "content_length": task.content_length,
+            "piece_size": task.piece_size,
+            "total_pieces": task.total_pieces,
+            "peer_count": task.peer_count(),
+            "size_scope": task.size_scope().value,
+        }

@@ -1,0 +1,15 @@
+"""Training-telemetry capture: download + network-topology records.
+
+Reference equivalent: scheduler/storage/ (buffered CSV writers with rotation,
+storage.go:60-208, record schemas types.go:26-235). Redesigned columnar —
+numpy structured arrays persisted as .npz with rotation — so the trainer's
+data loader is a zero-copy `np.load` into device arrays instead of CSV
+parsing (SURVEY.md §7 hard part: "CSV→Arrow schema fidelity").
+"""
+
+from dragonfly2_tpu.telemetry.records import (  # noqa: F401
+    DOWNLOAD_DTYPE,
+    PROBE_DTYPE,
+    ColumnarStore,
+    TelemetryStorage,
+)

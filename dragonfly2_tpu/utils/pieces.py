@@ -63,6 +63,8 @@ _RANGE_RE = re.compile(r"^\s*bytes\s*=\s*(\d*)\s*-\s*(\d*)\s*$")
 
 def parse_http_range(header: str, total: int) -> Range:
     """Parse a single-part HTTP Range header against a known total size."""
+    if total < 0:
+        raise ValueError("total size must be known to resolve a Range header")
     m = _RANGE_RE.match(header)
     if not m:
         raise ValueError(f"unsupported Range header: {header!r}")

@@ -1,0 +1,236 @@
+"""Daemon unit tests: storage, sources, dispatcher, upload server."""
+
+import asyncio
+import hashlib
+
+import numpy as np
+import pytest
+from aiohttp import web
+
+from dragonfly2_tpu.daemon.conductor import ParentState, PieceDispatcher
+from dragonfly2_tpu.daemon.source import SourceError, SourceRegistry
+from dragonfly2_tpu.daemon.storage import StorageManager
+from dragonfly2_tpu.daemon.upload import UploadServer
+from dragonfly2_tpu.scheduler.service import ParentInfo
+from dragonfly2_tpu.utils.pieces import Range
+
+
+class TestStorage:
+    def test_write_read_roundtrip(self, run, tmp_path):
+        async def body():
+            sm = StorageManager(tmp_path)
+            ts = sm.register_task("t" * 64, url="http://x/f")
+            ts.set_task_info(content_length=10, piece_size=4, total_pieces=3)
+            await ts.write_piece(0, b"aaaa")
+            await ts.write_piece(2, b"cc")
+            assert ts.has_piece(0) and not ts.has_piece(1)
+            assert await ts.read_piece(0) == b"aaaa"
+            with pytest.raises(KeyError):
+                await ts.read_piece(1)
+            await ts.write_piece(1, b"bbbb")
+            assert ts.is_complete()
+            assert await ts.read_range(Range(2, 6)) == b"aabbbb"
+
+        run(body())
+
+    def test_piece_size_validation(self, run, tmp_path):
+        async def body():
+            sm = StorageManager(tmp_path)
+            ts = sm.register_task("t2", url="x")
+            ts.set_task_info(content_length=10, piece_size=4, total_pieces=3)
+            with pytest.raises(ValueError):
+                await ts.write_piece(0, b"toolongpiece")
+            with pytest.raises(Exception):
+                await ts.write_piece(0, b"aaaa", expected_digest="0" * 64)
+
+        run(body())
+
+    def test_reuse_and_persistence(self, run, tmp_path):
+        async def body():
+            sm = StorageManager(tmp_path)
+            data = b"hello world!"
+            digest = "sha256:" + hashlib.sha256(data).hexdigest()
+            ts = sm.register_task("t3", url="x", digest=digest)
+            ts.set_task_info(content_length=len(data), piece_size=16, total_pieces=1, digest=digest)
+            await ts.write_piece(0, data)
+            ts.mark_done()
+            assert ts.verify()
+            # fresh manager reloads from disk
+            sm2 = StorageManager(tmp_path)
+            found = sm2.find_completed_task("t3")
+            assert found is not None and found.verify()
+            assert await found.read_piece(0) == data
+            assert sm2.find_completed_task("missing") is None
+
+        run(body())
+
+    def test_export_and_delete(self, run, tmp_path):
+        async def body():
+            sm = StorageManager(tmp_path / "store")
+            ts = sm.register_task("t4", url="x")
+            ts.set_task_info(content_length=4, piece_size=4, total_pieces=1)
+            await ts.write_piece(0, b"data")
+            out = tmp_path / "out" / "file.bin"
+            await ts.export_to(out)
+            assert out.read_bytes() == b"data"
+            sm.delete_task("t4")
+            assert sm.get("t4") is None
+            assert out.read_bytes() == b"data"  # export survives deletion
+
+        run(body())
+
+
+class TestSource:
+    def test_file_source(self, run, tmp_path):
+        async def body():
+            f = tmp_path / "origin.bin"
+            f.write_bytes(b"0123456789")
+            reg = SourceRegistry()
+            info = await reg.info(f"file://{f}")
+            assert info.content_length == 10 and info.supports_range
+            out = b""
+            async for chunk in reg.download(f"file://{f}", Range(2, 5)):
+                out += chunk
+            assert out == b"23456"
+            with pytest.raises(SourceError):
+                await reg.info(f"file://{tmp_path}/missing")
+
+        run(body())
+
+    def test_http_source_range(self, run, tmp_path):
+        async def body():
+            payload = bytes(range(256)) * 10
+            routes = web.RouteTableDef()
+
+            @routes.get("/f")
+            async def handler(request):
+                rng = request.headers.get("Range")
+                if rng:
+                    from dragonfly2_tpu.utils.pieces import parse_http_range
+
+                    r = parse_http_range(rng, len(payload))
+                    return web.Response(
+                        status=206,
+                        body=payload[r.start : r.start + r.length],
+                        headers={"Content-Range": f"bytes {r.start}-{r.end}/{len(payload)}"},
+                    )
+                return web.Response(body=payload)
+
+            app = web.Application()
+            app.add_routes(routes)
+            runner = web.AppRunner(app)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            try:
+                reg = SourceRegistry()
+                url = f"http://127.0.0.1:{port}/f"
+                info = await reg.info(url)
+                assert info.content_length == len(payload)
+                got = b""
+                async for chunk in reg.download(url, Range(100, 50)):
+                    got += chunk
+                assert got == payload[100:150]
+                await reg.close()
+            finally:
+                await runner.cleanup()
+
+        run(body())
+
+    def test_unsupported_scheme(self, run):
+        async def body():
+            reg = SourceRegistry()
+            with pytest.raises(SourceError):
+                await reg.info("gopher://x/f")
+
+        run(body())
+
+
+class TestDispatcher:
+    def _parents(self, n):
+        return [ParentInfo(f"p{i}", f"h{i}", "127.0.0.1", 8000 + i) for i in range(n)]
+
+    def test_pick_prefers_successful_parent(self):
+        d = PieceDispatcher(epsilon=0.0)
+        d.update_parents(self._parents(2))
+        d.set_pieces("p0", {0, 1, 2})
+        d.set_pieces("p1", {0, 1, 2})
+        for _ in range(5):
+            d.parents["p0"].record(True, 10.0)
+            d.parents["p1"].record(False, 10.0)
+        assert d.pick(0).info.peer_id == "p0"
+
+    def test_pick_requires_piece(self):
+        d = PieceDispatcher(epsilon=0.0)
+        d.update_parents(self._parents(2))
+        d.set_pieces("p0", {0})
+        d.set_pieces("p1", {1})
+        assert d.pick(1).info.peer_id == "p1"
+        assert d.pick(5) is None
+
+    def test_blocked_after_failures(self):
+        d = PieceDispatcher(epsilon=0.0)
+        d.update_parents(self._parents(1))
+        d.set_pieces("p0", {0})
+        for _ in range(3):
+            d.parents["p0"].record(False, 0)
+        assert d.pick(0) is None
+        assert d.usable() == []
+
+    def test_update_parents_drops_stale(self):
+        d = PieceDispatcher()
+        d.update_parents(self._parents(3))
+        d.update_parents(self._parents(1))
+        assert set(d.parents) == {"p0"}
+
+
+class TestUploadServer:
+    def test_metadata_and_range_serving(self, run, tmp_path):
+        async def body():
+            import aiohttp
+
+            sm = StorageManager(tmp_path)
+            tid = "abc123"
+            ts = sm.register_task(tid, url="x")
+            ts.set_task_info(content_length=10, piece_size=4, total_pieces=3)
+            await ts.write_piece(0, b"aaaa")
+            await ts.write_piece(1, b"bbbb")
+            srv = UploadServer(sm, port=0)
+            await srv.start()
+            try:
+                async with aiohttp.ClientSession() as s:
+                    base = f"http://127.0.0.1:{srv.port}"
+                    async with s.get(f"{base}/metadata/{tid}") as r:
+                        meta = await r.json()
+                    assert meta["finished_pieces"] == [0, 1]
+                    async with s.get(
+                        f"{base}/download/{tid[:3]}/{tid}?peerId=x",
+                        headers={"Range": "bytes=0-3"},
+                    ) as r:
+                        assert r.status == 206
+                        assert await r.read() == b"aaaa"
+                    # piece 2 missing -> 404
+                    async with s.get(
+                        f"{base}/download/{tid[:3]}/{tid}?peerId=x",
+                        headers={"Range": "bytes=8-9"},
+                    ) as r:
+                        assert r.status == 404
+                    # no Range -> 400
+                    async with s.get(f"{base}/download/{tid[:3]}/{tid}") as r:
+                        assert r.status == 400
+                    # wrong prefix -> 400
+                    async with s.get(
+                        f"{base}/download/zzz/{tid}", headers={"Range": "bytes=0-3"}
+                    ) as r:
+                        assert r.status == 400
+                    # unknown task -> 404
+                    async with s.get(
+                        f"{base}/metadata/nope"
+                    ) as r:
+                        assert r.status == 404
+                assert srv.bytes_served == 4
+            finally:
+                await srv.stop()
+
+        run(body())

@@ -1,0 +1,347 @@
+"""Scheduler tests: resource FSMs, candidate filtering/scoring, service flows
+(reference scheduler_test coverage shape: scheduling_test.go, peer_test.go,
+service_v2_test.go — but against the real in-process service, no mock streams)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.scheduler import resource as res
+from dragonfly2_tpu.scheduler.evaluator import Evaluator, build_pair_features, new_evaluator
+from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+from dragonfly2_tpu.scheduler.service import HostInfo, SchedulerService, TaskMeta
+from dragonfly2_tpu.telemetry import TelemetryStorage
+from dragonfly2_tpu.utils import idgen
+
+
+def make_pool_with_task(n_hosts=5, content_length=100 << 20):
+    pool = res.ResourcePool()
+    task = pool.load_or_create_task("t1", "http://origin/f")
+    task.set_metadata(content_length)
+    hosts = [
+        pool.load_or_create_host(f"h{i}", f"10.0.0.{i}", f"host{i}", download_port=8000 + i)
+        for i in range(n_hosts)
+    ]
+    return pool, task, hosts
+
+
+def add_running_peer(pool, task, host, peer_id=None, pieces=0):
+    peer = pool.create_peer(peer_id or idgen.peer_id(host.ip, host.hostname), task, host)
+    peer.fsm.fire("register")
+    peer.fsm.fire("download")
+    for i in range(pieces):
+        peer.finished_pieces.set(i)
+    return peer
+
+
+class TestResource:
+    def test_size_scope(self):
+        assert res.SizeScope.of(0, 4096) == res.SizeScope.EMPTY
+        assert res.SizeScope.of(100, 4096) == res.SizeScope.TINY
+        assert res.SizeScope.of(4000, 4096) == res.SizeScope.SMALL
+        assert res.SizeScope.of(10 << 20, 4 << 20) == res.SizeScope.NORMAL
+        assert res.SizeScope.of(None, 4096) == res.SizeScope.UNKNOWN
+
+    def test_peer_fsm_gates(self):
+        pool, task, hosts = make_pool_with_task(1)
+        peer = pool.create_peer("p1", task, hosts[0])
+        assert peer.state == res.PEER_PENDING
+        peer.fsm.fire("register")
+        peer.fsm.fire("download")
+        with pytest.raises(Exception):
+            peer.fsm.fire("register")  # illegal from running
+        peer.fsm.fire("succeed")
+        assert peer.state == res.PEER_SUCCEEDED
+
+    def test_edges_track_upload_slots(self):
+        pool, task, hosts = make_pool_with_task(2)
+        parent = add_running_peer(pool, task, hosts[0])
+        child = add_running_peer(pool, task, hosts[1])
+        task.add_edge(parent.id, child.id)
+        assert hosts[0].concurrent_uploads == 1
+        task.delete_parents(child.id)
+        assert hosts[0].concurrent_uploads == 0
+
+    def test_delete_peer_releases_children_slots(self):
+        pool, task, hosts = make_pool_with_task(2)
+        parent = add_running_peer(pool, task, hosts[0])
+        child = add_running_peer(pool, task, hosts[1])
+        task.add_edge(parent.id, child.id)
+        pool.delete_peer(parent.id)
+        assert hosts[0].concurrent_uploads == 0
+        assert task.peer(parent.id) is None
+
+    def test_gc_expires(self):
+        pool, task, hosts = make_pool_with_task(1)
+        pool.gc_policy = res.GCPolicy(peer_ttl=0.0, task_ttl=0.0, host_ttl=0.0)
+        peer = add_running_peer(pool, task, hosts[0])
+        import time
+
+        time.sleep(0.01)
+        removed = pool.gc()
+        # one sweep cascades: expired peer out first, then the now-empty task+host
+        assert removed == {"peers": 1, "tasks": 1, "hosts": 1}
+        assert not pool.tasks and not pool.hosts
+
+
+class TestEvaluator:
+    def test_base_prefers_seed_and_progress(self):
+        pool, task, hosts = make_pool_with_task(3)
+        hosts[1].type = res.HostType.SEED
+        child = add_running_peer(pool, task, hosts[0])
+        slow = add_running_peer(pool, task, hosts[2], pieces=1)
+        seed = add_running_peer(pool, task, hosts[1], pieces=20)
+        ev = new_evaluator("base")
+        scores = ev.evaluate(child, [slow, seed])
+        assert scores[1] > scores[0]
+
+    def test_bad_node_small_sample(self):
+        pool, task, hosts = make_pool_with_task(1)
+        peer = add_running_peer(pool, task, hosts[0])
+        for _ in range(5):
+            peer.add_piece_cost(10.0)
+        assert not Evaluator().is_bad_node(peer)
+        peer.add_piece_cost(500.0)  # > 20x mean
+        assert Evaluator().is_bad_node(peer)
+
+    def test_bad_node_sigma(self):
+        pool, task, hosts = make_pool_with_task(1)
+        peer = add_running_peer(pool, task, hosts[0])
+        rng = np.random.default_rng(0)
+        # maxlen=20 keeps the window < 30 samples: small-sample rule applies
+        for c in rng.normal(100, 5, size=40):
+            peer.add_piece_cost(float(c))
+        assert not Evaluator().is_bad_node(peer)
+
+    def test_feature_matrix_shape(self):
+        pool, task, hosts = make_pool_with_task(3)
+        child = add_running_peer(pool, task, hosts[0])
+        parents = [add_running_peer(pool, task, h) for h in hosts[1:]]
+        feats = build_pair_features(child, parents)
+        assert feats.shape == (2, 16)
+        assert np.isfinite(feats).all()
+
+
+class TestScheduling:
+    def test_filters_exclude_invalid(self, run):
+        pool, task, hosts = make_pool_with_task(6)
+        child = add_running_peer(pool, task, hosts[0])
+        good = add_running_peer(pool, task, hosts[1], pieces=5)
+        same_host = add_running_peer(pool, task, hosts[0])
+        pending = pool.create_peer("pend", task, hosts[2])
+        no_slots = add_running_peer(pool, task, hosts[3], pieces=5)
+        no_slots.host.upload_limit = 0
+        blocked = add_running_peer(pool, task, hosts[4], pieces=5)
+        s = Scheduling(new_evaluator("base"))
+        parents = s.find_candidate_parents(child, blocklist={blocked.id})
+        assert [p.id for p in parents] == [good.id]
+
+    def test_top4_by_score(self):
+        pool, task, hosts = make_pool_with_task(8)
+        child = add_running_peer(pool, task, hosts[0])
+        peers = [add_running_peer(pool, task, hosts[i], pieces=i * 2) for i in range(1, 8)]
+        s = Scheduling(new_evaluator("base"))
+        parents = s.find_candidate_parents(child)
+        assert len(parents) == 4
+        # highest-progress peers selected first
+        assert parents[0].id == peers[-1].id
+
+    def test_schedule_back_to_source_escalation(self, run):
+        async def body():
+            pool, task, hosts = make_pool_with_task(1)
+            child = add_running_peer(pool, task, hosts[0])
+            cfg = SchedulingConfig(retry_interval=0.001, retry_back_to_source_limit=2)
+            s = Scheduling(new_evaluator("base"), cfg)
+            out = await s.schedule_candidate_parents(child)
+            assert out.back_to_source
+            assert child.state == res.PEER_BACK_TO_SOURCE
+
+        run(body())
+
+    def test_no_cycles_scheduled(self, run):
+        async def body():
+            pool, task, hosts = make_pool_with_task(2)
+            a = add_running_peer(pool, task, hosts[0])
+            b = add_running_peer(pool, task, hosts[1])
+            task.add_edge(a.id, b.id)
+            s = Scheduling(new_evaluator("base"), SchedulingConfig(retry_interval=0.001))
+            parents = s.find_candidate_parents(a)
+            assert b.id not in [p.id for p in parents]  # would close a cycle
+
+        run(body())
+
+
+class TestService:
+    def _service(self, tmp_path=None, **kw):
+        telemetry = TelemetryStorage(tmp_path) if tmp_path else None
+        return SchedulerService(telemetry=telemetry, **kw)
+
+    def _host(self, i):
+        return HostInfo(id=f"h{i}", ip=f"10.0.0.{i}", hostname=f"host{i}", download_port=8000 + i)
+
+    def test_first_peer_goes_back_to_source(self, run):
+        async def body():
+            svc = self._service()
+            out = await svc.register_peer("p1", TaskMeta("t1", "http://o/f"), self._host(1))
+            assert out.back_to_source
+            peer = svc.pool.peer("p1")
+            assert peer.state == res.PEER_BACK_TO_SOURCE
+
+        run(body())
+
+    def test_second_peer_gets_parent(self, run):
+        async def body():
+            svc = self._service()
+            meta = TaskMeta("t1", "http://o/f")
+            await svc.register_peer("p1", meta, self._host(1))
+            svc.report_task_metadata("t1", content_length=100 << 20)
+            for i in range(10):
+                svc.report_piece_result("p1", i, success=True, cost_ms=5.0)
+            out2 = await svc.register_peer("p2", meta, self._host(2))
+            assert not out2.back_to_source
+            assert [p.peer_id for p in out2.parents] == ["p1"]
+            assert out2.content_length == 100 << 20
+
+        run(body())
+
+    def test_tiny_task_direct_piece(self, run):
+        async def body():
+            svc = self._service()
+            meta = TaskMeta("t1", "http://o/tiny")
+            await svc.register_peer("p1", meta, self._host(1))
+            svc.report_task_metadata("t1", content_length=16, direct_piece=b"x" * 16)
+            svc.report_peer_result("p1", success=True)
+            out = await svc.register_peer("p2", meta, self._host(2))
+            assert out.scope == "tiny" and out.direct_piece == b"x" * 16
+
+        run(body())
+
+    def test_small_task_single_parent(self, run):
+        async def body():
+            svc = self._service()
+            meta = TaskMeta("t1", "http://o/small")
+            await svc.register_peer("p1", meta, self._host(1))
+            svc.report_task_metadata("t1", content_length=1 << 20)
+            svc.report_piece_result("p1", 0, success=True, cost_ms=3.0)
+            svc.report_peer_result("p1", success=True)
+            out = await svc.register_peer("p2", meta, self._host(2))
+            assert out.scope == "small"
+            assert [p.peer_id for p in out.parents] == ["p1"]
+
+        run(body())
+
+    def test_piece_failure_blocks_parent_and_reschedules(self, run):
+        async def body():
+            svc = self._service()
+            meta = TaskMeta("t1", "http://o/f")
+            await svc.register_peer("p1", meta, self._host(1))
+            svc.report_task_metadata("t1", content_length=100 << 20)
+            for i in range(5):
+                svc.report_piece_result("p1", i, success=True, cost_ms=5.0)
+            await svc.register_peer("p2", meta, self._host(2))
+            for i in range(5):
+                svc.report_piece_result("p2", i, success=True, cost_ms=5.0)
+            out3 = await svc.register_peer("p3", meta, self._host(3))
+            assert out3.parents
+            svc.report_piece_result("p3", 0, success=False, parent_id=out3.parents[0].peer_id)
+            peer3 = svc.pool.peer("p3")
+            assert out3.parents[0].peer_id in peer3.block_parents
+            re = await svc.reschedule("p3")
+            assert out3.parents[0].peer_id not in [p.peer_id for p in re.parents]
+
+        run(body())
+
+    def test_peer_result_records_telemetry(self, run, tmp_path):
+        async def body():
+            svc = self._service(tmp_path)
+            meta = TaskMeta("t1", "http://o/f")
+            await svc.register_peer("p1", meta, self._host(1))
+            svc.report_task_metadata("t1", content_length=100 << 20)
+            for i in range(3):
+                svc.report_piece_result("p1", i, success=True, cost_ms=4.0)
+            svc.report_peer_result("p1", success=True, bandwidth_bps=1e8)
+            await svc.register_peer("p2", meta, self._host(2))
+            svc.report_piece_result("p2", 0, success=True, cost_ms=4.0, parent_id="p1")
+            svc.report_peer_result("p2", success=True, bandwidth_bps=2e8)
+            svc.telemetry.flush()
+            recs = svc.telemetry.downloads.load_all()
+            assert len(recs) == 2
+            assert recs[1]["parent_peer_id"] == b"p1"
+            assert recs[1]["bandwidth_bps"] == pytest.approx(2e8)
+
+        run(body())
+
+    def test_leave_peer_cleans_up(self, run):
+        async def body():
+            svc = self._service()
+            meta = TaskMeta("t1", "http://o/f")
+            await svc.register_peer("p1", meta, self._host(1))
+            svc.report_task_metadata("t1", content_length=100 << 20)
+            svc.report_piece_result("p1", 0, success=True)
+            await svc.register_peer("p2", meta, self._host(2))
+            svc.leave_peer("p1")
+            assert svc.pool.peer("p1") is None
+            task = svc.pool.tasks["t1"]
+            assert task.parents_of("p2") == []
+
+        run(body())
+
+    def test_seed_trigger_called_once(self, run):
+        async def body():
+            triggered = []
+
+            async def trigger(task):
+                triggered.append(task.id)
+
+            svc = self._service(seed_trigger=trigger)
+            meta = TaskMeta("t1", "http://o/f")
+            await svc.register_peer("p1", meta, self._host(1))
+            await svc.register_peer("p1b", meta, self._host(4))
+            await asyncio.sleep(0.01)
+            assert triggered == ["t1"]
+
+        run(body())
+
+    def test_peer_completion_releases_parent_slots(self, run):
+        async def body():
+            svc = self._service()
+            meta = TaskMeta("t1", "http://o/f")
+            await svc.register_peer("p1", meta, self._host(1))
+            svc.report_task_metadata("t1", content_length=100 << 20)
+            svc.report_piece_result("p1", 0, success=True)
+            out = await svc.register_peer("p2", meta, self._host(2))
+            parent_host = svc.pool.hosts["h1"]
+            assert parent_host.concurrent_uploads == 1
+            svc.report_peer_result("p2", success=True)
+            assert parent_host.concurrent_uploads == 0  # slot freed on completion
+
+        run(body())
+
+    def test_register_retry_is_idempotent(self, run):
+        async def body():
+            svc = self._service()
+            meta = TaskMeta("t1", "http://o/f")
+            await svc.register_peer("p1", meta, self._host(1))
+            # RPC-retry shape: same peer_id registers again mid-flight
+            out = await svc.register_peer("p1", meta, self._host(1))
+            assert out.back_to_source
+            # and again after completion (restart path)
+            svc.report_task_metadata("t1", content_length=100 << 20)
+            svc.report_piece_result("p1", 0, success=True)
+            svc.report_peer_result("p1", success=True)
+            out = await svc.register_peer("p1", meta, self._host(1))
+            assert svc.pool.peer("p1").state != "pending"
+
+        run(body())
+
+    def test_stat_task(self, run):
+        async def body():
+            svc = self._service()
+            await svc.register_peer("p1", TaskMeta("t1", "http://o/f"), self._host(1))
+            svc.report_task_metadata("t1", content_length=10 << 20)
+            st = svc.stat_task("t1")
+            assert st["peer_count"] == 1 and st["size_scope"] == "normal"
+            assert svc.stat_task("nope") is None
+
+        run(body())
