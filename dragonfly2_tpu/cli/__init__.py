@@ -1,0 +1,1 @@
+"""Thin CLIs: dfget / dfcache / dfstore front-ends over the daemon RPC."""

@@ -1,0 +1,119 @@
+"""dfget: download a URL through the P2P cluster.
+
+Reference equivalent: cmd/dfget + client/dfget/dfget.go:47-138 (talks to the
+daemon over its unix-socket RPC; spawns the daemon if absent, the
+checkAndSpawnDaemon behavior at cmd/dfget/cmd/root.go:266).
+
+  python -m dragonfly2_tpu.cli.dfget http://origin/file -O /tmp/out \
+      --scheduler 127.0.0.1:9000
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import subprocess
+import sys
+import time
+
+from dragonfly2_tpu.rpc.core import RpcClient
+
+DEFAULT_SOCK = "/tmp/dragonfly2_tpu_daemon.sock"
+
+
+async def _daemon_alive(sock: str) -> bool:
+    if not os.path.exists(sock):
+        return False
+    client = RpcClient(sock, retries=0)
+    try:
+        return await client.healthy()
+    finally:
+        await client.close()
+
+
+def spawn_daemon(sock: str, scheduler: str, storage: str | None, *, seed: bool = False) -> None:
+    """Fork a daemon process and wait for its socket (ref checkAndSpawnDaemon)."""
+    cmd = [
+        sys.executable, "-m", "dragonfly2_tpu.daemon.server",
+        "--scheduler", scheduler, "--sock", sock,
+    ]
+    if storage:
+        cmd += ["--storage", storage]
+    if seed:
+        cmd += ["--seed"]
+    subprocess.Popen(
+        cmd,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+        start_new_session=True,  # detach: daemon outlives this CLI
+    )
+
+
+async def download(args: argparse.Namespace) -> int:
+    sock = args.sock
+    if not await _daemon_alive(sock):
+        if args.no_spawn:
+            print(f"error: no daemon at {sock} (and --no-spawn set)", file=sys.stderr)
+            return 1
+        spawn_daemon(sock, args.scheduler, args.storage)
+        deadline = time.monotonic() + args.spawn_timeout
+        while time.monotonic() < deadline:
+            if await _daemon_alive(sock):
+                break
+            await asyncio.sleep(0.1)
+        else:
+            print("error: daemon failed to start", file=sys.stderr)
+            return 1
+
+    client = RpcClient(sock, timeout=args.timeout)
+    try:
+        t0 = time.monotonic()
+        result = await client.call(
+            "download",
+            {
+                "url": args.url,
+                "output": os.path.abspath(args.output),
+                "tag": args.tag,
+                "application": args.application,
+                "digest": args.digest,
+                "filters": args.filter,
+            },
+            timeout=args.timeout,
+        )
+        elapsed = time.monotonic() - t0
+        size = result["content_length"]
+        rate = size / max(elapsed, 1e-6) / (1 << 20)
+        print(
+            f"downloaded {args.url} -> {args.output}: {size} bytes, "
+            f"{result['pieces']} pieces, {elapsed:.2f}s ({rate:.1f} MiB/s) "
+            f"task={result['task_id'][:16]}"
+        )
+        return 0
+    except Exception as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    finally:
+        await client.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(prog="dfget", description="P2P file download")
+    ap.add_argument("url", help="source URL (http/https/file)")
+    ap.add_argument("-O", "--output", required=True, help="output file path")
+    ap.add_argument("--scheduler", default=os.environ.get("DF_SCHEDULER", "127.0.0.1:9000"))
+    ap.add_argument("--sock", default=os.environ.get("DF_DAEMON_SOCK", DEFAULT_SOCK))
+    ap.add_argument("--storage", default=None, help="daemon storage root (spawn only)")
+    ap.add_argument("--tag", default="")
+    ap.add_argument("--application", default="")
+    ap.add_argument("--digest", default="", help="expected digest algo:hex")
+    ap.add_argument("--filter", action="append", default=[], help="query params to drop from task id")
+    ap.add_argument("--timeout", type=float, default=600.0)
+    ap.add_argument("--spawn-timeout", type=float, default=10.0)
+    ap.add_argument("--no-spawn", action="store_true", help="fail if daemon absent")
+    args = ap.parse_args(argv)
+    return asyncio.run(download(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

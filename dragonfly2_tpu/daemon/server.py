@@ -1,0 +1,180 @@
+"""Peer-daemon process entry point + its RPC surface for thin CLIs.
+
+Reference equivalent: client/daemon (daemon boot) + client/daemon/rpcserver
+(rpcserver.go:72-151 — the unix-socket download API dfget/dfcache talk to,
+and the peer API served to other daemons; our peer API is the HTTP piece
+server in daemon.upload). `python -m dragonfly2_tpu.daemon.server
+--scheduler 127.0.0.1:9000 --sock /tmp/df.sock`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import os
+
+from dragonfly2_tpu.daemon.engine import PeerEngine
+from dragonfly2_tpu.rpc.core import RpcError, RpcServer
+from dragonfly2_tpu.rpc.scheduler import RemoteSchedulerClient
+from dragonfly2_tpu.utils.proc import run_until_signalled
+
+logger = logging.getLogger("daemon")
+
+DAEMON_METHODS = ["download", "stat_task", "delete_task", "export_task", "host_info"]
+
+
+class DaemonRpcAdapter:
+    """Download API for the thin CLIs (ref dfdaemon Download/Stat/Delete)."""
+
+    def __init__(self, engine: PeerEngine):
+        self.engine = engine
+
+    async def download(self, p: dict) -> dict:
+        ts = await self.engine.download_task(
+            p["url"],
+            output=p.get("output"),
+            tag=p.get("tag", ""),
+            application=p.get("application", ""),
+            digest=p.get("digest", ""),
+            filters=tuple(p.get("filters", ())),
+        )
+        return {
+            "task_id": ts.meta.task_id,
+            "content_length": ts.meta.content_length,
+            "pieces": ts.finished_count(),
+            "done": ts.meta.done,
+        }
+
+    async def stat_task(self, p: dict) -> dict | None:
+        ts = self.engine.storage.get(p["task_id"])
+        if ts is None:
+            return None
+        return {
+            "task_id": ts.meta.task_id,
+            "content_length": ts.meta.content_length,
+            "pieces": ts.finished_count(),
+            "total_pieces": ts.meta.total_pieces,
+            "done": ts.meta.done,
+        }
+
+    async def delete_task(self, p: dict) -> None:
+        self.engine.storage.delete_task(p["task_id"])
+
+    async def export_task(self, p: dict) -> None:
+        ts = self.engine.storage.get(p["task_id"])
+        if ts is None or not ts.meta.done:
+            raise RpcError(f"task {p['task_id']} not complete", code="not_found")
+        await ts.export_to(p["output"])
+
+    async def host_info(self, p: dict | None) -> dict:
+        hi = self.engine.host_info()
+        return {"id": hi.id, "ip": hi.ip, "download_port": hi.download_port}
+
+
+async def run_daemon(
+    *,
+    scheduler_addr: str,
+    storage_root: str,
+    sock_path: str,
+    ip: str = "127.0.0.1",
+    hostname: str = "",
+    host_type: str = "normal",
+    idc: str = "",
+    location: str = "",
+    upload_port: int = 0,
+    announce_interval: float = 30.0,
+    ready_event: asyncio.Event | None = None,
+) -> None:
+    scheduler = RemoteSchedulerClient(scheduler_addr)
+    engine = PeerEngine(
+        storage_root=storage_root,
+        scheduler=scheduler,
+        ip=ip,
+        hostname=hostname,
+        host_type=host_type,
+        idc=idc,
+        location=location,
+        upload_port=upload_port,
+    )
+    await engine.start()
+
+    server = RpcServer(unix_path=sock_path)
+    server.register_service(DaemonRpcAdapter(engine), DAEMON_METHODS)
+    await server.start()
+    logger.info("daemon rpc on %s, piece server on :%d", sock_path, engine.upload.port)
+    print(f"DAEMON_READY {sock_path} {engine.upload.port}", flush=True)
+
+    async def announce_loop() -> None:
+        """Keepalive + host stats to the scheduler (ref client/daemon/announcer)."""
+        while True:
+            try:
+                await scheduler.announce_host(engine.host_info(), _host_stats())
+            except Exception:
+                logger.warning("announce failed", exc_info=True)
+            await asyncio.sleep(announce_interval)
+
+    announcer = asyncio.ensure_future(announce_loop())
+    try:
+        await run_until_signalled(ready_event)
+    finally:
+        announcer.cancel()
+        await server.stop()
+        await engine.stop()
+        await scheduler.close()
+        if os.path.exists(sock_path):
+            os.unlink(sock_path)
+
+
+def _host_stats() -> dict:
+    """Best-effort host stats (the reference uses gopsutil; stdlib here)."""
+    stats: dict[str, float] = {}
+    try:
+        load1, _, _ = os.getloadavg()
+        stats["cpu_usage"] = min(1.0, load1 / max(1, os.cpu_count() or 1))
+    except OSError:
+        pass
+    try:
+        import shutil
+
+        du = shutil.disk_usage("/")
+        stats["disk_usage"] = du.used / du.total
+    except OSError:
+        pass
+    return stats
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="dragonfly2_tpu peer daemon")
+    ap.add_argument("--scheduler", required=True, help="scheduler address host:port")
+    ap.add_argument("--storage", default=os.path.expanduser("~/.dragonfly2_tpu/storage"))
+    ap.add_argument("--sock", default="/tmp/dragonfly2_tpu_daemon.sock")
+    ap.add_argument("--ip", default="127.0.0.1")
+    ap.add_argument("--hostname", default="")
+    ap.add_argument("--seed", action="store_true", help="run as seed peer")
+    ap.add_argument("--idc", default="")
+    ap.add_argument("--location", default="")
+    ap.add_argument("--upload-port", type=int, default=0)
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    asyncio.run(
+        run_daemon(
+            scheduler_addr=args.scheduler,
+            storage_root=args.storage,
+            sock_path=args.sock,
+            ip=args.ip,
+            hostname=args.hostname,
+            host_type="seed" if args.seed else "normal",
+            idc=args.idc,
+            location=args.location,
+            upload_port=args.upload_port,
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

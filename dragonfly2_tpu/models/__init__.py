@@ -6,6 +6,10 @@ MLAlgorithm`; manager CreateModel is a stub at manager_server_v2.go:739).
 Here it is primary: an MLP bandwidth predictor over download records and a
 GraphSAGE GNN over the network-topology probe graph, both trained on TPU
 meshes and exported as batched scorers for the scheduler's hot loop.
+
+Lazy attribute exports: service processes (scheduler/daemon/CLIs) import
+models.features (pure numpy) without paying the flax/jax import — and,
+critically, without initializing the TPU backend in every daemon process.
 """
 
 from dragonfly2_tpu.models.features import (  # noqa: F401
@@ -13,5 +17,21 @@ from dragonfly2_tpu.models.features import (  # noqa: F401
     FEATURE_NAMES,
     PAIR_FEATURE_DIM,
 )
-from dragonfly2_tpu.models.mlp import BandwidthMLP  # noqa: F401
-from dragonfly2_tpu.models.graphsage import GraphSAGE, TopoScorer  # noqa: F401
+
+_LAZY = {
+    "BandwidthMLP": ("dragonfly2_tpu.models.mlp", "BandwidthMLP"),
+    "GraphSAGE": ("dragonfly2_tpu.models.graphsage", "GraphSAGE"),
+    "TopoScorer": ("dragonfly2_tpu.models.graphsage", "TopoScorer"),
+    "TopoGraph": ("dragonfly2_tpu.models.graphsage", "TopoGraph"),
+    "GNNScorer": ("dragonfly2_tpu.models.scorer", "GNNScorer"),
+    "LinearScorer": ("dragonfly2_tpu.models.scorer", "LinearScorer"),
+}
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        return getattr(importlib.import_module(module), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

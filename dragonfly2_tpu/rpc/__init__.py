@@ -1,0 +1,11 @@
+"""Wire RPC: msgpack-framed asyncio protocol (unary, multiplexed).
+
+Reference equivalent: pkg/rpc — per-service gRPC client/server wrappers with
+interceptor chains (scheduler/server/server.go:43-44 rate limits, retry,
+logging). Redesigned: a compact length-prefixed msgpack protocol over
+TCP/unix sockets with per-connection multiplexing, retry with linear backoff,
+QPS limiting, and keepalive — no protoc codegen step, and the message schema
+is the service dataclasses themselves.
+"""
+
+from dragonfly2_tpu.rpc.core import RpcClient, RpcError, RpcServer  # noqa: F401

@@ -1,0 +1,272 @@
+"""Framed msgpack RPC core.
+
+Wire format: 4-byte big-endian length + msgpack map
+  request:  {"i": id, "m": method, "p": payload}
+  response: {"i": id, "r": result} | {"i": id, "e": {"code", "message"}}
+Payloads are msgpack-native types (dicts/lists/str/bytes/numbers); service
+adapters convert dataclasses at the boundary.
+
+Server: asyncio.start_server (tcp or unix), method registry, per-server QPS
+token bucket (reference default 10k QPS / 20k burst,
+pkg/rpc/scheduler/server/server.go:43-44), error mapping.
+Client: one connection with request multiplexing, auto-reconnect, retry with
+linear backoff (ref interceptor chain's retry), request timeout.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import Any, Awaitable, Callable, Optional
+
+import msgpack
+
+from dragonfly2_tpu.utils.ratelimit import TokenBucket
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 256 << 20  # direct pieces / piece payloads stay well under this
+
+
+class RpcError(Exception):
+    def __init__(self, message: str, code: str = "internal"):
+        super().__init__(message)
+        self.code = code
+
+
+class ConnectionClosed(RpcError):
+    def __init__(self) -> None:
+        super().__init__("connection closed", code="unavailable")
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> dict:
+    header = await reader.readexactly(_LEN.size)
+    (length,) = _LEN.unpack(header)
+    if length > MAX_FRAME:
+        raise RpcError(f"frame too large: {length}", code="resource_exhausted")
+    body = await reader.readexactly(length)
+    return msgpack.unpackb(body, raw=False)
+
+
+def _write_frame(writer: asyncio.StreamWriter, msg: dict) -> None:
+    body = msgpack.packb(msg, use_bin_type=True)
+    writer.write(_LEN.pack(len(body)) + body)
+
+
+Handler = Callable[[Any], Awaitable[Any]]
+
+
+class RpcServer:
+    def __init__(
+        self,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        unix_path: str | None = None,
+        qps_limit: float = 10_000,
+        qps_burst: float = 20_000,
+    ):
+        self._handlers: dict[str, Handler] = {}
+        self.host = host
+        self.port = port
+        self.unix_path = unix_path
+        self._server: asyncio.AbstractServer | None = None
+        self._conns: set[asyncio.StreamWriter] = set()
+        self._bucket = TokenBucket(qps_limit, qps_burst)
+        self.register("_ping", self._ping)
+
+    async def _ping(self, payload: Any) -> str:
+        return "pong"
+
+    def register(self, method: str, handler: Handler) -> None:
+        self._handlers[method] = handler
+
+    def register_service(self, obj: Any, methods: list[str]) -> None:
+        """Expose async methods of obj taking/returning msgpack-able payloads."""
+        for name in methods:
+            self.register(name, getattr(obj, name))
+
+    async def start(self) -> None:
+        if self.unix_path:
+            self._server = await asyncio.start_unix_server(self._on_conn, path=self.unix_path)
+        else:
+            self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+            self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # Drop live connections too: wait_closed() (3.12+) waits for
+            # connection handlers, which otherwise run until the peer hangs up.
+            for w in list(self._conns):
+                w.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    @property
+    def address(self) -> str:
+        return self.unix_path or f"{self.host}:{self.port}"
+
+    async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
+        tasks: set[asyncio.Task] = set()
+        write_lock = asyncio.Lock()
+        self._conns.add(writer)
+        try:
+            while True:
+                try:
+                    msg = await _read_frame(reader)
+                except (asyncio.IncompleteReadError, ConnectionResetError):
+                    break
+                if not isinstance(msg, dict):
+                    logger.warning("malformed frame (%s), closing connection", type(msg).__name__)
+                    break
+                t = asyncio.ensure_future(self._dispatch(msg, writer, write_lock))
+                tasks.add(t)
+                t.add_done_callback(tasks.discard)
+        finally:
+            self._conns.discard(writer)
+            for t in tasks:
+                t.cancel()
+            writer.close()
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass
+
+    async def _dispatch(
+        self, msg: dict, writer: asyncio.StreamWriter, write_lock: asyncio.Lock
+    ) -> None:
+        rid = msg.get("i")
+        method = msg.get("m", "")
+        handler = self._handlers.get(method)
+        if handler is None:
+            out = {"i": rid, "e": {"code": "unimplemented", "message": f"no method {method!r}"}}
+        elif not self._bucket.try_acquire():
+            out = {"i": rid, "e": {"code": "resource_exhausted", "message": "rate limited"}}
+        else:
+            try:
+                result = await handler(msg.get("p"))
+                out = {"i": rid, "r": result}
+            except RpcError as e:
+                out = {"i": rid, "e": {"code": e.code, "message": str(e)}}
+            except Exception as e:
+                logger.exception("rpc handler %s failed", method)
+                out = {"i": rid, "e": {"code": "internal", "message": f"{type(e).__name__}: {e}"}}
+        async with write_lock:
+            try:
+                _write_frame(writer, out)
+                await writer.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+
+
+class RpcClient:
+    def __init__(
+        self,
+        address: str,
+        *,
+        timeout: float = 30.0,
+        retries: int = 3,
+        retry_backoff: float = 0.2,
+    ):
+        self.address = address
+        self.timeout = timeout
+        self.retries = retries
+        self.retry_backoff = retry_backoff
+        self._reader: asyncio.StreamReader | None = None
+        self._writer: asyncio.StreamWriter | None = None
+        self._pending: dict[int, asyncio.Future] = {}
+        self._next_id = 0
+        self._recv_task: asyncio.Task | None = None
+        self._conn_lock = asyncio.Lock()
+
+    async def _connect(self) -> None:
+        async with self._conn_lock:
+            if self._writer is not None and not self._writer.is_closing():
+                return
+            if "/" in self.address and ":" not in self.address:
+                self._reader, self._writer = await asyncio.open_unix_connection(self.address)
+            else:
+                host, port = self.address.rsplit(":", 1)
+                self._reader, self._writer = await asyncio.open_connection(host, int(port))
+            self._recv_task = asyncio.ensure_future(self._recv_loop(self._reader))
+
+    async def _recv_loop(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                msg = await _read_frame(reader)
+                fut = self._pending.pop(msg.get("i"), None)
+                if fut is None or fut.done():
+                    continue
+                if "e" in msg:
+                    err = msg["e"]
+                    fut.set_exception(RpcError(err.get("message", ""), err.get("code", "internal")))
+                else:
+                    fut.set_result(msg.get("r"))
+        except (asyncio.IncompleteReadError, ConnectionResetError, asyncio.CancelledError):
+            pass
+        finally:
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(ConnectionClosed())
+            self._pending.clear()
+            # Reset connection state so the next call() reconnects instead of
+            # writing into the dead socket and waiting out its timeout.
+            if self._reader is reader:
+                if self._writer is not None:
+                    self._writer.close()
+                self._reader = self._writer = None
+                self._recv_task = None
+
+    async def call(self, method: str, payload: Any = None, *, timeout: float | None = None) -> Any:
+        last_err: Exception | None = None
+        for attempt in range(self.retries + 1):
+            try:
+                return await self._call_once(method, payload, timeout or self.timeout)
+            except (ConnectionClosed, ConnectionError, OSError) as e:
+                last_err = e
+                self._drop_connection()
+                if attempt < self.retries:  # no pointless sleep before raising
+                    await asyncio.sleep(self.retry_backoff * (attempt + 1))  # linear backoff
+            except RpcError as e:
+                if e.code == "resource_exhausted" and attempt < self.retries:
+                    last_err = e
+                    await asyncio.sleep(self.retry_backoff * (attempt + 1))
+                    continue
+                raise
+        raise last_err or RpcError("rpc call failed")
+
+    async def _call_once(self, method: str, payload: Any, timeout: float) -> Any:
+        await self._connect()
+        self._next_id += 1
+        rid = self._next_id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            _write_frame(self._writer, {"i": rid, "m": method, "p": payload})
+            await self._writer.drain()
+            return await asyncio.wait_for(fut, timeout)
+        except asyncio.TimeoutError:
+            self._pending.pop(rid, None)
+            raise RpcError(f"{method} timed out after {timeout}s", code="deadline_exceeded")
+        finally:
+            self._pending.pop(rid, None)
+
+    def _drop_connection(self) -> None:
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+            self._recv_task = None
+        if self._writer is not None:
+            self._writer.close()
+        self._reader = self._writer = None
+
+    async def close(self) -> None:
+        self._drop_connection()
+
+    async def healthy(self) -> bool:
+        try:
+            return await self.call("_ping", timeout=2.0) == "pong"
+        except Exception:
+            return False

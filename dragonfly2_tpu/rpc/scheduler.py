@@ -1,0 +1,149 @@
+"""Scheduler RPC adapters: wire client + server over rpc.core.
+
+Reference equivalent: pkg/rpc/scheduler/{client,server} (client_v1.go:46-53
+consistent-hash-balanced clients) + scheduler/rpcserver thin adapters. The
+client implements daemon.conductor.SchedulerClient, so engines swap freely
+between in-process and wire transports.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict
+from typing import Any
+
+from dragonfly2_tpu.rpc.core import RpcClient, RpcServer
+from dragonfly2_tpu.scheduler.service import (
+    HostInfo,
+    ParentInfo,
+    RegisterResult,
+    SchedulerService,
+    TaskMeta,
+)
+
+SCHEDULER_METHODS = [
+    "register_peer",
+    "report_task_metadata",
+    "report_piece_result",
+    "report_peer_result",
+    "reschedule",
+    "leave_peer",
+    "announce_host",
+    "stat_task",
+]
+
+
+def _result_to_wire(r: RegisterResult) -> dict:
+    return asdict(r)  # recursive: ParentInfo entries become dicts too
+
+
+def _result_from_wire(d: dict) -> RegisterResult:
+    parents = [ParentInfo(**p) for p in d.pop("parents", [])]
+    return RegisterResult(parents=parents, **d)
+
+
+class SchedulerRpcAdapter:
+    """Server-side: msgpack payloads -> SchedulerService calls."""
+
+    def __init__(self, service: SchedulerService):
+        self.svc = service
+
+    async def register_peer(self, p: dict) -> dict:
+        out = await self.svc.register_peer(
+            p["peer_id"],
+            TaskMeta(**{**p["meta"], "filters": tuple(p["meta"].get("filters", ()))}),
+            HostInfo(**p["host"]),
+        )
+        return _result_to_wire(out)
+
+    async def report_task_metadata(self, p: dict) -> None:
+        self.svc.report_task_metadata(
+            p["task_id"],
+            content_length=p["content_length"],
+            piece_size=p.get("piece_size"),
+            digest=p.get("digest", ""),
+            direct_piece=p.get("direct_piece", b""),
+        )
+
+    async def report_piece_result(self, p: dict) -> None:
+        self.svc.report_piece_result(
+            p["peer_id"],
+            p["piece_index"],
+            success=p["success"],
+            cost_ms=p.get("cost_ms", 0.0),
+            parent_id=p.get("parent_id", ""),
+        )
+
+    async def report_peer_result(self, p: dict) -> None:
+        self.svc.report_peer_result(
+            p["peer_id"], success=p["success"], bandwidth_bps=p.get("bandwidth_bps", 0.0)
+        )
+
+    async def reschedule(self, p: dict) -> dict:
+        return _result_to_wire(await self.svc.reschedule(p["peer_id"]))
+
+    async def leave_peer(self, p: dict) -> None:
+        self.svc.leave_peer(p["peer_id"])
+
+    async def announce_host(self, p: dict) -> None:
+        self.svc.announce_host(HostInfo(**p["host"]), p.get("stats"))
+
+    async def stat_task(self, p: dict) -> dict | None:
+        return self.svc.stat_task(p["task_id"])
+
+
+def serve_scheduler(service: SchedulerService, **server_kw: Any) -> RpcServer:
+    server = RpcServer(**server_kw)
+    server.register_service(SchedulerRpcAdapter(service), SCHEDULER_METHODS)
+    return server
+
+
+class RemoteSchedulerClient:
+    """daemon.conductor.SchedulerClient over the wire."""
+
+    def __init__(self, address: str, **client_kw: Any):
+        self._rpc = RpcClient(address, **client_kw)
+
+    async def register_peer(self, peer_id: str, meta: TaskMeta, host: HostInfo) -> RegisterResult:
+        out = await self._rpc.call(
+            "register_peer",
+            {"peer_id": peer_id, "meta": asdict(meta), "host": asdict(host)},
+        )
+        return _result_from_wire(out)
+
+    async def report_task_metadata(self, task_id, *, content_length, piece_size, digest="", direct_piece=b""):
+        await self._rpc.call(
+            "report_task_metadata",
+            {"task_id": task_id, "content_length": content_length,
+             "piece_size": piece_size, "digest": digest, "direct_piece": direct_piece},
+        )
+
+    async def report_piece_result(self, peer_id, piece_index, *, success, cost_ms=0.0, parent_id=""):
+        await self._rpc.call(
+            "report_piece_result",
+            {"peer_id": peer_id, "piece_index": piece_index, "success": success,
+             "cost_ms": cost_ms, "parent_id": parent_id},
+        )
+
+    async def report_peer_result(self, peer_id, *, success, bandwidth_bps=0.0):
+        await self._rpc.call(
+            "report_peer_result",
+            {"peer_id": peer_id, "success": success, "bandwidth_bps": bandwidth_bps},
+        )
+
+    async def reschedule(self, peer_id):
+        return _result_from_wire(await self._rpc.call("reschedule", {"peer_id": peer_id}))
+
+    async def leave_peer(self, peer_id):
+        await self._rpc.call("leave_peer", {"peer_id": peer_id})
+
+    async def announce_host(self, host: HostInfo, stats: dict | None = None):
+        await self._rpc.call("announce_host", {"host": asdict(host), "stats": stats})
+
+    async def stat_task(self, task_id: str):
+        return await self._rpc.call("stat_task", {"task_id": task_id})
+
+    async def healthy(self) -> bool:
+        return await self._rpc.healthy()
+
+    async def close(self):
+        await self._rpc.close()

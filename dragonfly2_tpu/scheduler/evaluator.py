@@ -104,7 +104,13 @@ class MLEvaluator(Evaluator):
 
     name = "ml"
 
-    def __init__(self, scorer, node_index: dict[str, int]):
+    def __init__(self, scorer=None, node_index: dict[str, int] | None = None):
+        self._scorer = scorer
+        self._node_index = node_index or {}
+
+    def attach_scorer(self, scorer, node_index: dict[str, int]) -> None:
+        """Hot-swap the model (called when the trainer publishes a version);
+        until then evaluate() serves the base fallback."""
         self._scorer = scorer
         self._node_index = node_index
 
@@ -131,9 +137,13 @@ class MLEvaluator(Evaluator):
 
 
 def new_evaluator(algorithm: str = "base", **kw) -> Evaluator:
-    """Factory (ref evaluator.go:35-54): "base" | "ml"; unknown → base."""
+    """Factory (ref evaluator.go:35-54): "base" | "ml"; unknown → base.
+
+    "ml" without a scorer starts in base-fallback mode and upgrades when
+    attach_scorer() is called (the scheduler boots before any model exists).
+    """
     if algorithm == "ml":
-        return MLEvaluator(kw["scorer"], kw.get("node_index", {}))
+        return MLEvaluator(kw.get("scorer"), kw.get("node_index"))
     if algorithm != "base":
         logger.warning("unknown evaluator %r, using base", algorithm)
     return Evaluator()

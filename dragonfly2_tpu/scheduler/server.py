@@ -1,0 +1,82 @@
+"""Scheduler process entry point.
+
+Reference equivalent: scheduler/scheduler.go composition root + cmd/scheduler.
+Wires config → telemetry storage → service → RPC server → GC loop, and runs
+until signalled. `python -m dragonfly2_tpu.scheduler.server --port 9000`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from dragonfly2_tpu.rpc.scheduler import serve_scheduler
+from dragonfly2_tpu.utils.proc import run_until_signalled
+from dragonfly2_tpu.scheduler.service import SchedulerService
+from dragonfly2_tpu.telemetry import TelemetryStorage
+from dragonfly2_tpu.utils.gcreg import GC
+
+logger = logging.getLogger("scheduler")
+
+
+async def run_scheduler(
+    *,
+    host: str = "127.0.0.1",
+    port: int = 9000,
+    telemetry_dir: str | None = None,
+    evaluator: str = "base",
+    gc_interval: float = 10.0,
+    ready_event: asyncio.Event | None = None,
+) -> None:
+    from dragonfly2_tpu.scheduler.evaluator import new_evaluator
+
+    telemetry = TelemetryStorage(telemetry_dir) if telemetry_dir else None
+    service = SchedulerService(evaluator=new_evaluator(evaluator), telemetry=telemetry)
+    server = serve_scheduler(service, host=host, port=port)
+    await server.start()
+    logger.info("scheduler listening on %s", server.address)
+    print(f"SCHEDULER_READY {server.address}", flush=True)
+
+    gc = GC()
+    gc.add("resource", gc_interval, lambda: _sweep(service))
+    gc.start()
+    try:
+        await run_until_signalled(ready_event)
+    finally:
+        gc.stop()
+        if telemetry:
+            telemetry.flush()
+        await server.stop()
+
+
+def _sweep(service: SchedulerService) -> None:
+    removed = service.pool.gc()
+    if any(removed.values()):
+        logger.info("gc removed %s", removed)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="dragonfly2_tpu scheduler")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9000)
+    ap.add_argument("--telemetry-dir", default=None)
+    ap.add_argument("--evaluator", default="base", choices=["base", "ml"])
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    asyncio.run(
+        run_scheduler(
+            host=args.host,
+            port=args.port,
+            telemetry_dir=args.telemetry_dir,
+            evaluator=args.evaluator,
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""Process lifecycle helpers shared by the service entry points."""
+
+from __future__ import annotations
+
+import asyncio
+import signal
+
+
+async def run_until_signalled(ready_event: asyncio.Event | None = None) -> None:
+    """Signal readiness, then block until SIGINT/SIGTERM."""
+    if ready_event is not None:
+        ready_event.set()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        try:
+            loop.add_signal_handler(sig, stop.set)
+        except NotImplementedError:  # pragma: no cover - non-unix loops
+            pass
+    await stop.wait()

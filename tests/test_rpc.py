@@ -1,0 +1,225 @@
+"""RPC layer tests: core framing/retry semantics + scheduler wire adapters +
+a full multi-process cluster (scheduler proc, seed+peer daemon procs, dfget
+CLI) — the reference's E2E shape over real sockets."""
+
+import asyncio
+import hashlib
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from dragonfly2_tpu.rpc.core import RpcClient, RpcError, RpcServer
+from dragonfly2_tpu.rpc.scheduler import RemoteSchedulerClient, serve_scheduler
+from dragonfly2_tpu.scheduler.service import HostInfo, SchedulerService, TaskMeta
+
+
+class TestCore:
+    def test_unary_roundtrip_and_errors(self, run):
+        async def body():
+            server = RpcServer(port=0)
+
+            async def echo(p):
+                return {"got": p}
+
+            async def boom(p):
+                raise ValueError("nope")
+
+            server.register("echo", echo)
+            server.register("boom", boom)
+            await server.start()
+            client = RpcClient(server.address)
+            try:
+                out = await client.call("echo", {"x": 1, "b": b"\x00\xff"})
+                assert out == {"got": {"x": 1, "b": b"\x00\xff"}}
+                with pytest.raises(RpcError) as ei:
+                    await client.call("boom")
+                assert "nope" in str(ei.value) and ei.value.code == "internal"
+                with pytest.raises(RpcError) as ei:
+                    await client.call("missing")
+                assert ei.value.code == "unimplemented"
+                assert await client.healthy()
+            finally:
+                await client.close()
+                await server.stop()
+
+        run(body())
+
+    def test_concurrent_calls_multiplex(self, run):
+        async def body():
+            server = RpcServer(port=0)
+
+            async def slow(p):
+                await asyncio.sleep(p["delay"])
+                return p["tag"]
+
+            server.register("slow", slow)
+            await server.start()
+            client = RpcClient(server.address)
+            try:
+                t0 = time.monotonic()
+                results = await asyncio.gather(
+                    *(client.call("slow", {"delay": 0.1, "tag": i}) for i in range(10))
+                )
+                assert results == list(range(10))
+                assert time.monotonic() - t0 < 0.5  # parallel, not serialized
+
+            finally:
+                await client.close()
+                await server.stop()
+
+        run(body())
+
+    def test_reconnect_after_server_restart(self, run):
+        async def body():
+            server = RpcServer(port=0)
+            server.register("hi", lambda p: _async("hi"))
+            await server.start()
+            port = server.port
+            client = RpcClient(f"127.0.0.1:{port}", retries=5, retry_backoff=0.05)
+            try:
+                assert await client.call("hi") == "hi"
+                await server.stop()
+                server2 = RpcServer(port=port)
+                server2.register("hi", lambda p: _async("hi2"))
+                await server2.start()
+                assert await client.call("hi") == "hi2"
+                await server2.stop()
+            finally:
+                await client.close()
+
+        run(body())
+
+    def test_rate_limit(self, run):
+        async def body():
+            server = RpcServer(port=0, qps_limit=1, qps_burst=2)
+            server.register("x", lambda p: _async(1))
+            await server.start()
+            client = RpcClient(server.address, retries=0)
+            try:
+                await client.call("x")
+                await client.call("x")
+                with pytest.raises(RpcError) as ei:
+                    await client.call("x")
+                assert ei.value.code == "resource_exhausted"
+            finally:
+                await client.close()
+                await server.stop()
+
+        run(body())
+
+    def test_unix_socket(self, run, tmp_path):
+        async def body():
+            sock = str(tmp_path / "t.sock")
+            server = RpcServer(unix_path=sock)
+            server.register("hi", lambda p: _async("ok"))
+            await server.start()
+            client = RpcClient(sock)
+            try:
+                assert await client.call("hi") == "ok"
+            finally:
+                await client.close()
+                await server.stop()
+
+        run(body())
+
+
+async def _async(v):
+    return v
+
+
+class TestSchedulerWire:
+    def test_register_over_wire(self, run, tmp_path):
+        async def body():
+            svc = SchedulerService()
+            server = serve_scheduler(svc, port=0)
+            await server.start()
+            client = RemoteSchedulerClient(server.address)
+            try:
+                meta = TaskMeta("t1", "http://o/f")
+                host = HostInfo(id="h1", ip="10.0.0.1", hostname="n1", download_port=8001)
+                out = await client.register_peer("p1", meta, host)
+                assert out.back_to_source
+                await client.report_task_metadata("t1", content_length=100 << 20, piece_size=4 << 20)
+                await client.report_piece_result("p1", 0, success=True, cost_ms=5.0)
+                out2 = await client.register_peer(
+                    "p2", meta, HostInfo(id="h2", ip="10.0.0.2", hostname="n2", download_port=8002)
+                )
+                assert [p.peer_id for p in out2.parents] == ["p1"]
+                assert out2.content_length == 100 << 20
+                st = await client.stat_task("t1")
+                assert st["peer_count"] == 2
+                await client.report_peer_result("p1", success=True, bandwidth_bps=1e8)
+                await client.leave_peer("p2")
+                assert svc.pool.peer("p2") is None
+            finally:
+                await client.close()
+                await server.stop()
+
+        run(body())
+
+
+class TestMultiProcess:
+    """Real processes over real sockets: 1 scheduler + seed daemon + peer
+    daemon + dfget CLI (ref E2E: kind cluster with dfget exec, here localhost)."""
+
+    def test_cluster_download(self, tmp_path):
+        env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+        payload = bytes(range(256)) * (40 * 1024)  # 10 MiB
+        origin_file = tmp_path / "origin.bin"
+        origin_file.write_bytes(payload)
+        url = f"file://{origin_file}"
+        procs = []
+        try:
+            sched = subprocess.Popen(
+                [sys.executable, "-m", "dragonfly2_tpu.scheduler.server", "--port", "0",
+                 "--telemetry-dir", str(tmp_path / "tel")],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+            )
+            procs.append(sched)
+            line = sched.stdout.readline()
+            assert line.startswith("SCHEDULER_READY"), line
+            sched_addr = line.split()[1]
+
+            socks = []
+            for i, name in enumerate(["d1", "d2"]):
+                sock = str(tmp_path / f"{name}.sock")
+                socks.append(sock)
+                d = subprocess.Popen(
+                    [sys.executable, "-m", "dragonfly2_tpu.daemon.server",
+                     "--scheduler", sched_addr, "--sock", sock,
+                     "--storage", str(tmp_path / f"store_{name}"),
+                     "--hostname", name],
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+                )
+                procs.append(d)
+                line = d.stdout.readline()
+                assert line.startswith("DAEMON_READY"), line
+
+            def dfget(sock, out):
+                return subprocess.run(
+                    [sys.executable, "-m", "dragonfly2_tpu.cli.dfget", url,
+                     "-O", str(out), "--sock", sock, "--no-spawn",
+                     "--scheduler", sched_addr],
+                    capture_output=True, text=True, env=env, timeout=120,
+                )
+
+            r1 = dfget(socks[0], tmp_path / "out1.bin")
+            assert r1.returncode == 0, r1.stderr
+            r2 = dfget(socks[1], tmp_path / "out2.bin")
+            assert r2.returncode == 0, r2.stderr
+
+            want = hashlib.sha256(payload).hexdigest()
+            for out in ["out1.bin", "out2.bin"]:
+                assert hashlib.sha256((tmp_path / out).read_bytes()).hexdigest() == want
+        finally:
+            for p in procs:
+                p.send_signal(signal.SIGTERM)
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
