@@ -1,0 +1,11 @@
+"""Management plane: config hub, cluster CRUD, model registry, searcher, jobs.
+
+Reference equivalent: manager/ (manager.go:101, rpcserver/manager_server_v2.go,
+searcher/, job/, models/ — SURVEY.md §2.2). Persistence is sqlite3 (stdlib)
+instead of MySQL+GORM; REST is aiohttp instead of gin; RPC rides rpc.core.
+"""
+
+from dragonfly2_tpu.manager.db import Database
+from dragonfly2_tpu.manager.service import ManagerService
+
+__all__ = ["Database", "ManagerService"]

@@ -1,0 +1,204 @@
+"""Manager REST API (the console/ops surface).
+
+Reference equivalent: manager/handlers/*.go (gin routes under /api/v1:
+scheduler-clusters, schedulers, seed-peer-clusters, seed-peers, applications,
+configs, models, jobs, users, healthz — api/manager swagger). JSON in/out;
+route shape kept 1:1 so ops tooling ports directly.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Any
+
+from aiohttp import web
+
+from dragonfly2_tpu.manager.jobs import JobQueue
+from dragonfly2_tpu.manager.preheat import PreheatProducer
+from dragonfly2_tpu.manager.service import ManagerService
+
+logger = logging.getLogger(__name__)
+
+
+def _json(data: Any, status: int = 200) -> web.Response:
+    return web.json_response(data, status=status)
+
+
+class ManagerRest:
+    def __init__(self, service: ManagerService, jobs: JobQueue):
+        self.svc = service
+        self.jobs = jobs
+        self.preheat = PreheatProducer(jobs)
+
+    def app(self) -> web.Application:
+        app = web.Application()
+        r = app.router
+        r.add_get("/healthz", self.healthz)
+        # scheduler clusters
+        r.add_get("/api/v1/scheduler-clusters", self.list_scheduler_clusters)
+        r.add_post("/api/v1/scheduler-clusters", self.create_scheduler_cluster)
+        r.add_get(r"/api/v1/scheduler-clusters/{id:\d+}", self.get_scheduler_cluster)
+        r.add_patch(r"/api/v1/scheduler-clusters/{id:\d+}", self.update_scheduler_cluster)
+        r.add_delete(r"/api/v1/scheduler-clusters/{id:\d+}", self.delete_scheduler_cluster)
+        # schedulers / seed peers (instance registry, read-mostly)
+        r.add_get("/api/v1/schedulers", self.list_schedulers)
+        r.add_get("/api/v1/seed-peers", self.list_seed_peers)
+        # applications
+        r.add_get("/api/v1/applications", self.list_applications)
+        r.add_post("/api/v1/applications", self.upsert_application)
+        # configs
+        r.add_get("/api/v1/configs/{name}", self.get_config)
+        r.add_post("/api/v1/configs", self.set_config)
+        # model registry
+        r.add_get("/api/v1/models", self.list_models)
+        r.add_post("/api/v1/models", self.create_model)
+        r.add_post(r"/api/v1/models/{id:\d+}/activate", self.activate_model)
+        r.add_delete(r"/api/v1/models/{id:\d+}", self.delete_model)
+        # jobs (preheat)
+        r.add_post("/api/v1/jobs", self.create_job)
+        r.add_get(r"/api/v1/jobs/{id:\d+}", self.get_job)
+        return app
+
+    async def healthz(self, req: web.Request) -> web.Response:
+        return _json({"status": "ok"})
+
+    # ---- scheduler clusters ----
+
+    async def list_scheduler_clusters(self, req: web.Request) -> web.Response:
+        return _json(self.svc.db.find("scheduler_clusters"))
+
+    async def create_scheduler_cluster(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        try:
+            row = self.svc.create_scheduler_cluster(
+                body["name"],
+                bio=body.get("bio", ""),
+                config=body.get("config"),
+                client_config=body.get("client_config"),
+                scopes=body.get("scopes"),
+                is_default=body.get("is_default", False),
+            )
+        except Exception as e:
+            return _json({"error": str(e)}, status=400)
+        return _json(row, status=201)
+
+    async def get_scheduler_cluster(self, req: web.Request) -> web.Response:
+        row = self.svc.db.get("scheduler_clusters", int(req.match_info["id"]))
+        return _json(row) if row else _json({"error": "not found"}, status=404)
+
+    async def update_scheduler_cluster(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        row_id = int(req.match_info["id"])
+        row = self.svc.db.get("scheduler_clusters", row_id)
+        if row is None:
+            return _json({"error": "not found"}, status=404)
+        allowed = {k: body[k] for k in ("bio", "config", "client_config", "scopes", "is_default") if k in body}
+        if allowed:
+            self.svc.db.update("scheduler_clusters", row_id, **allowed)
+        return _json(self.svc.db.get("scheduler_clusters", row_id))
+
+    async def delete_scheduler_cluster(self, req: web.Request) -> web.Response:
+        ok = self.svc.db.delete("scheduler_clusters", int(req.match_info["id"]))
+        return _json({"deleted": ok}, status=200 if ok else 404)
+
+    # ---- instances ----
+
+    async def list_schedulers(self, req: web.Request) -> web.Response:
+        return _json(self.svc.db.find("schedulers"))
+
+    async def list_seed_peers(self, req: web.Request) -> web.Response:
+        return _json(self.svc.db.find("seed_peers"))
+
+    # ---- applications / configs ----
+
+    async def list_applications(self, req: web.Request) -> web.Response:
+        return _json(self.svc.list_applications())
+
+    async def upsert_application(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        return _json(
+            self.svc.upsert_application(
+                body["name"], url=body.get("url", ""),
+                bio=body.get("bio", ""), priority=body.get("priority"),
+            ),
+            status=201,
+        )
+
+    async def get_config(self, req: web.Request) -> web.Response:
+        row = self.svc.get_config(req.match_info["name"])
+        return _json(row) if row else _json({"error": "not found"}, status=404)
+
+    async def set_config(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        return _json(self.svc.set_config(body["name"], body["value"], bio=body.get("bio", "")), status=201)
+
+    # ---- models ----
+
+    async def list_models(self, req: web.Request) -> web.Response:
+        where = {k: v for k, v in req.query.items() if k in ("type", "state", "scheduler_id")}
+        if "scheduler_id" in where:
+            where["scheduler_id"] = int(where["scheduler_id"])
+        return _json(self.svc.list_models(**where))
+
+    async def create_model(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        try:
+            row = self.svc.create_model(
+                body["type"], body["version"],
+                scheduler_id=body.get("scheduler_id", 0),
+                bio=body.get("bio", ""),
+                evaluation=body.get("evaluation"),
+                artifact_path=body.get("artifact_path", ""),
+            )
+        except ValueError as e:
+            return _json({"error": str(e)}, status=400)
+        return _json(row, status=201)
+
+    async def activate_model(self, req: web.Request) -> web.Response:
+        try:
+            return _json(self.svc.activate_model(int(req.match_info["id"])))
+        except KeyError:
+            return _json({"error": "not found"}, status=404)
+
+    async def delete_model(self, req: web.Request) -> web.Response:
+        ok = self.svc.delete_model(int(req.match_info["id"]))
+        return _json({"deleted": ok}, status=200 if ok else 404)
+
+    # ---- jobs ----
+
+    async def create_job(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        if body.get("type") != "preheat":
+            return _json({"error": f"unknown job type {body.get('type')!r}"}, status=400)
+        args = body.get("args") or {}
+        cluster_ids = body.get("scheduler_cluster_ids") or [
+            self.svc.get_or_create_default_cluster()["id"]
+        ]
+        try:
+            job = await self.preheat.create_preheat(
+                args.get("type", "file"),
+                args["url"],
+                scheduler_cluster_ids=cluster_ids,
+                tag=args.get("tag", ""),
+                filters=args.get("filters"),
+                headers=args.get("headers"),
+            )
+        except Exception as e:
+            return _json({"error": str(e)}, status=400)
+        return _json(job, status=201)
+
+    async def get_job(self, req: web.Request) -> web.Response:
+        row = self.jobs.state(int(req.match_info["id"]))
+        return _json(row) if row else _json({"error": "not found"}, status=404)
+
+
+async def start_rest(
+    service: ManagerService, jobs: JobQueue, *, host: str = "127.0.0.1", port: int = 0
+) -> tuple[web.AppRunner, int]:
+    runner = web.AppRunner(ManagerRest(service, jobs).app(), access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, port

@@ -1,0 +1,105 @@
+"""Manager process entry: RPC + REST + reaper in one asyncio loop.
+
+Reference equivalent: manager/manager.go:101 (gin REST + gRPC v1/v2 + GC on
+one composition root). `python -m dragonfly2_tpu.manager.server --port 9200
+--rest-port 9201 --db /var/lib/df/manager.db`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from dragonfly2_tpu.manager.db import Database
+from dragonfly2_tpu.manager.jobs import JobQueue
+from dragonfly2_tpu.manager.rest import start_rest
+from dragonfly2_tpu.manager.service import ManagerService
+from dragonfly2_tpu.rpc.core import RpcServer
+from dragonfly2_tpu.rpc.manager import ManagerRpcAdapter, register_manager
+from dragonfly2_tpu.utils.proc import run_until_signalled
+
+logger = logging.getLogger("manager")
+
+
+class ManagerServer:
+    def __init__(
+        self,
+        *,
+        db_path: str = ":memory:",
+        host: str = "127.0.0.1",
+        port: int = 0,
+        rest_port: int | None = 0,
+        keepalive_ttl: float = 60.0,
+    ):
+        self.db = Database(db_path)
+        self.service = ManagerService(self.db, keepalive_ttl=keepalive_ttl)
+        self.jobs = JobQueue(self.db)
+        self.rpc = RpcServer(host=host, port=port)
+        register_manager(self.rpc, ManagerRpcAdapter(self.service, self.jobs))
+        self.rest_port = rest_port
+        self._rest_runner = None
+        self._reaper: asyncio.Task | None = None
+        self._lease_reaper: asyncio.Task | None = None
+
+    @property
+    def address(self) -> str:
+        return self.rpc.address
+
+    async def start(self) -> None:
+        self.jobs.requeue_pending()
+        await self.rpc.start()
+        if self.rest_port is not None:
+            self._rest_runner, self.rest_port = await start_rest(
+                self.service, self.jobs, host=self.rpc.host, port=self.rest_port
+            )
+        self._reaper = asyncio.ensure_future(self.service.run_reaper())
+        self._lease_reaper = asyncio.ensure_future(self._run_lease_reaper())
+        logger.info("manager rpc on %s rest on :%s", self.rpc.address, self.rest_port)
+
+    async def _run_lease_reaper(self) -> None:
+        while True:
+            await asyncio.sleep(30.0)
+            try:
+                n = self.jobs.reap_leases()
+                if n:
+                    logger.warning("requeued %d expired job leases", n)
+            except Exception:
+                logger.exception("lease reaper pass failed")
+
+    async def stop(self) -> None:
+        for t in (self._reaper, self._lease_reaper):
+            if t is not None:
+                t.cancel()
+        if self._rest_runner is not None:
+            await self._rest_runner.cleanup()
+        await self.rpc.stop()
+        self.db.close()
+
+
+async def amain(args: argparse.Namespace) -> None:
+    server = ManagerServer(
+        db_path=args.db, host=args.host, port=args.port, rest_port=args.rest_port,
+        keepalive_ttl=args.keepalive_ttl,
+    )
+    await server.start()
+    print(f"manager ready rpc={server.address} rest={server.rest_port}", flush=True)
+    await run_until_signalled()
+    await server.stop()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="dragonfly2-tpu manager")
+    p.add_argument("--db", default=":memory:")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9200)
+    p.add_argument("--rest-port", type=int, default=9201)
+    p.add_argument("--keepalive-ttl", type=float, default=60.0)
+    p.add_argument("-v", "--verbose", action="store_true")
+    args = p.parse_args()
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+    asyncio.run(amain(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,288 @@
+"""Manager business logic: cluster CRUD, instance registry, models, configs.
+
+Reference equivalent: manager/rpcserver/manager_server_v2.go:95-746 (the gRPC
+surface schedulers/daemons use: GetScheduler, ListSchedulers, UpdateScheduler,
+UpdateSeedPeer, KeepAlive, ListApplications, CreateModel — the last a TODO
+stub at :739-743 that this implementation completes) + manager/service/ (REST
+business logic). The KeepAlive stream becomes periodic `keepalive` RPCs with
+a TTL reaper marking instances inactive (ref relies on stream close).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Optional
+
+from dragonfly2_tpu.manager import searcher
+from dragonfly2_tpu.manager.db import Database
+
+logger = logging.getLogger(__name__)
+
+STATE_ACTIVE = "active"
+STATE_INACTIVE = "inactive"
+
+MODEL_GNN = "gnn"
+MODEL_MLP = "mlp"
+
+DEFAULT_KEEPALIVE_TTL = 60.0  # reference reaps on stream close; we reap on TTL
+
+
+class ManagerService:
+    def __init__(self, db: Database | None = None, *, keepalive_ttl: float = DEFAULT_KEEPALIVE_TTL):
+        self.db = db or Database()
+        self.keepalive_ttl = keepalive_ttl
+        self._reaper_task: asyncio.Task | None = None
+
+    # ---------- scheduler clusters ----------
+
+    def create_scheduler_cluster(
+        self,
+        name: str,
+        *,
+        bio: str = "",
+        config: dict | None = None,
+        client_config: dict | None = None,
+        scopes: dict | None = None,
+        is_default: bool = False,
+    ) -> dict:
+        row_id = self.db.insert(
+            "scheduler_clusters",
+            name=name,
+            bio=bio,
+            config=config or {},
+            client_config=client_config or {},
+            scopes=scopes or {},
+            is_default=is_default,
+        )
+        return self.db.get("scheduler_clusters", row_id)
+
+    def get_or_create_default_cluster(self) -> dict:
+        row = self.db.find_one("scheduler_clusters", is_default=True)
+        if row is None:
+            row = self.create_scheduler_cluster("default", is_default=True)
+        return row
+
+    # ---------- instance registry (schedulers / seed peers) ----------
+
+    def update_scheduler(
+        self,
+        hostname: str,
+        ip: str,
+        port: int,
+        *,
+        scheduler_cluster_id: int | None = None,
+        idc: str = "",
+        location: str = "",
+        features: list[str] | None = None,
+    ) -> dict:
+        """Register or refresh a scheduler instance (ref UpdateScheduler)."""
+        if scheduler_cluster_id is None:
+            scheduler_cluster_id = self.get_or_create_default_cluster()["id"]
+        return self.db.upsert(
+            "schedulers",
+            {"hostname": hostname, "scheduler_cluster_id": scheduler_cluster_id},
+            ip=ip,
+            port=port,
+            idc=idc,
+            location=location,
+            features=features or ["schedule", "preheat"],
+            state=STATE_ACTIVE,
+            last_keepalive=time.time(),
+        )
+
+    def update_seed_peer(
+        self,
+        hostname: str,
+        ip: str,
+        port: int,
+        *,
+        download_port: int = 0,
+        object_storage_port: int = 0,
+        seed_peer_cluster_id: int | None = None,
+        peer_type: str = "super",
+        idc: str = "",
+        location: str = "",
+    ) -> dict:
+        if seed_peer_cluster_id is None:
+            row = self.db.find_one("seed_peer_clusters", name="default")
+            if row is None:
+                cid = self.db.insert("seed_peer_clusters", name="default", config={})
+                default_sched = self.get_or_create_default_cluster()
+                self.db.link_clusters(cid, default_sched["id"])
+                row = self.db.get("seed_peer_clusters", cid)
+            seed_peer_cluster_id = row["id"]
+        return self.db.upsert(
+            "seed_peers",
+            {"hostname": hostname, "seed_peer_cluster_id": seed_peer_cluster_id},
+            ip=ip,
+            port=port,
+            download_port=download_port,
+            object_storage_port=object_storage_port,
+            type=peer_type,
+            idc=idc,
+            location=location,
+            state=STATE_ACTIVE,
+            last_keepalive=time.time(),
+        )
+
+    def keepalive(self, source_type: str, hostname: str, cluster_id: int | None = None) -> bool:
+        """Refresh liveness (ref KeepAlive stream, manager_server_v2.go:746)."""
+        table = "schedulers" if source_type == "scheduler" else "seed_peers"
+        key = "scheduler_cluster_id" if source_type == "scheduler" else "seed_peer_cluster_id"
+        where: dict[str, Any] = {"hostname": hostname}
+        if cluster_id is not None:
+            where[key] = cluster_id
+        n = self.db.update_where(
+            table, where, state=STATE_ACTIVE, last_keepalive=time.time()
+        )
+        return n > 0
+
+    def reap_stale(self) -> int:
+        """Mark instances inactive when keepalives stop."""
+        cutoff = time.time() - self.keepalive_ttl
+        n = 0
+        for table in ("schedulers", "seed_peers"):
+            for row in self.db.find(table, state=STATE_ACTIVE):
+                if row["last_keepalive"] < cutoff:
+                    self.db.update(table, row["id"], state=STATE_INACTIVE)
+                    n += 1
+        return n
+
+    async def run_reaper(self, interval: float | None = None) -> None:
+        interval = interval or max(self.keepalive_ttl / 3, 1.0)
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                self.reap_stale()
+            except Exception:
+                logger.exception("reaper pass failed")
+
+    # ---------- peer-facing discovery (ref ListSchedulers + searcher) ----------
+
+    def list_schedulers(
+        self, ip: str = "", conditions: dict[str, str] | None = None
+    ) -> list[dict]:
+        """Active schedulers of the best-matching clusters, best first."""
+        clusters = self.db.find("scheduler_clusters")
+        active: dict[int, list[dict]] = {}
+        for s in self.db.find("schedulers", state=STATE_ACTIVE):
+            active.setdefault(s["scheduler_cluster_id"], []).append(s)
+        ranked = searcher.find_scheduler_clusters(
+            clusters, ip, conditions,
+            has_active_schedulers={cid: True for cid in active},
+        )
+        out: list[dict] = []
+        for c in ranked:
+            out.extend(active.get(c["id"], []))
+        return out
+
+    def get_scheduler(self, hostname: str, scheduler_cluster_id: int) -> Optional[dict]:
+        return self.db.find_one(
+            "schedulers", hostname=hostname, scheduler_cluster_id=scheduler_cluster_id
+        )
+
+    def list_seed_peers(self, scheduler_cluster_id: int | None = None) -> list[dict]:
+        """Seed peers serving a scheduler cluster (via the many2many link)."""
+        if scheduler_cluster_id is None:
+            return self.db.find("seed_peers", state=STATE_ACTIVE)
+        out = []
+        for spc_id in self.db.linked_seed_peer_clusters(scheduler_cluster_id):
+            out.extend(
+                self.db.find("seed_peers", seed_peer_cluster_id=spc_id, state=STATE_ACTIVE)
+            )
+        return out
+
+    # ---------- cluster config for dynconfig consumers ----------
+
+    def cluster_config(self, scheduler_cluster_id: int) -> dict:
+        """What a scheduler/daemon pulls via dynconfig: cluster config blobs +
+        current scheduler and seed-peer address books."""
+        cluster = self.db.get("scheduler_clusters", scheduler_cluster_id)
+        if cluster is None:
+            return {}
+        return {
+            "cluster_id": cluster["id"],
+            "config": cluster["config"],
+            "client_config": cluster["client_config"],
+            "schedulers": [
+                {"hostname": s["hostname"], "ip": s["ip"], "port": s["port"]}
+                for s in self.db.find(
+                    "schedulers",
+                    scheduler_cluster_id=scheduler_cluster_id,
+                    state=STATE_ACTIVE,
+                )
+            ],
+            "seed_peers": [
+                {
+                    "hostname": s["hostname"], "ip": s["ip"], "port": s["port"],
+                    "download_port": s["download_port"], "type": s["type"],
+                }
+                for s in self.list_seed_peers(scheduler_cluster_id)
+            ],
+        }
+
+    # ---------- model registry (completes ref CreateModel TODO) ----------
+
+    def create_model(
+        self,
+        model_type: str,
+        version: str,
+        *,
+        scheduler_id: int = 0,
+        bio: str = "",
+        evaluation: dict | None = None,
+        artifact_path: str = "",
+    ) -> dict:
+        if model_type not in (MODEL_GNN, MODEL_MLP):
+            raise ValueError(f"unknown model type {model_type!r}")
+        return self.db.upsert(
+            "models",
+            {"type": model_type, "version": version, "scheduler_id": scheduler_id},
+            bio=bio,
+            evaluation=evaluation or {},
+            artifact_path=artifact_path,
+        )
+
+    def activate_model(self, model_id: int) -> dict:
+        """Make this version active; deactivate siblings of the same
+        (type, scheduler) — the reference's per-scheduler unique active
+        version semantics (models/model.go:19-27)."""
+        row = self.db.get("models", model_id)
+        if row is None:
+            raise KeyError(model_id)
+        self.db.update_where(
+            "models",
+            {"type": row["type"], "scheduler_id": row["scheduler_id"], "state": STATE_ACTIVE},
+            state=STATE_INACTIVE,
+        )
+        self.db.update("models", model_id, state=STATE_ACTIVE)
+        return self.db.get("models", model_id)
+
+    def active_model(self, model_type: str, scheduler_id: int = 0) -> Optional[dict]:
+        return self.db.find_one(
+            "models", type=model_type, scheduler_id=scheduler_id, state=STATE_ACTIVE
+        )
+
+    def list_models(self, **where: Any) -> list[dict]:
+        return self.db.find("models", **where)
+
+    def delete_model(self, model_id: int) -> bool:
+        return self.db.delete("models", model_id)
+
+    # ---------- applications / configs ----------
+
+    def upsert_application(self, name: str, *, url: str = "", bio: str = "", priority: dict | None = None) -> dict:
+        return self.db.upsert(
+            "applications", {"name": name}, url=url, bio=bio, priority=priority or {}
+        )
+
+    def list_applications(self) -> list[dict]:
+        return self.db.find("applications")
+
+    def set_config(self, name: str, value: dict, *, bio: str = "") -> dict:
+        return self.db.upsert("configs", {"name": name}, value=value, bio=bio)
+
+    def get_config(self, name: str) -> Optional[dict]:
+        return self.db.find_one("configs", name=name)

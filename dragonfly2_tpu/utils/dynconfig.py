@@ -1,0 +1,116 @@
+"""Dynconfig: cached remote config with disk cache, TTL refresh, observers.
+
+Reference equivalent: internal/dynconfig/dynconfig.go:44-78 (generic cached
+manager-sourced config; specialized by scheduler/config/dynconfig.go and
+client/config/dynconfig_manager.go). Fetch from the manager, persist a disk
+cache so services boot while the manager is down, refresh on a TTL, and
+notify registered observers on change.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from pathlib import Path
+from typing import Any, Awaitable, Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+Fetcher = Callable[[], Awaitable[dict]]
+Observer = Callable[[dict], None]
+
+
+class Dynconfig:
+    def __init__(
+        self,
+        fetch: Fetcher,
+        *,
+        cache_path: str | Path | None = None,
+        refresh_interval: float = 60.0,
+    ):
+        self._fetch = fetch
+        self.cache_path = Path(cache_path) if cache_path else None
+        self.refresh_interval = refresh_interval
+        self._data: dict = {}
+        self._observers: list[Observer] = []
+        self._task: asyncio.Task | None = None
+        self._loaded_at = 0.0
+
+    @property
+    def data(self) -> dict:
+        return self._data
+
+    def register(self, observer: Observer) -> None:
+        """Observer fires on every successful refresh that changes the data."""
+        self._observers.append(observer)
+
+    async def load(self) -> dict:
+        """Initial load: remote first, disk cache fallback (ref Get path).
+
+        Observers always fire once here — refresh() only notifies on change,
+        and consumers wired purely via register() must still see the boot
+        config even when it came from the disk cache."""
+        notified = False
+        try:
+            notified = await self.refresh()
+        except Exception as e:
+            if not self._load_cache():
+                raise
+            logger.warning("dynconfig: using disk cache, fetch failed: %s", e)
+        if not notified:
+            self._notify()
+        return self._data
+
+    async def refresh(self) -> bool:
+        """Fetch; returns True when the config changed."""
+        data = await self._fetch()
+        self._loaded_at = time.time()
+        if data == self._data:
+            return False
+        self._data = data
+        self._store_cache()
+        self._notify()
+        return True
+
+    def _notify(self) -> None:
+        for obs in self._observers:
+            try:
+                obs(self._data)
+            except Exception:
+                logger.exception("dynconfig observer failed")
+
+    def _load_cache(self) -> bool:
+        if self.cache_path is None or not self.cache_path.exists():
+            return False
+        try:
+            self._data = json.loads(self.cache_path.read_text())
+            return True
+        except (json.JSONDecodeError, OSError):
+            return False
+
+    def _store_cache(self) -> None:
+        if self.cache_path is None:
+            return
+        self.cache_path.parent.mkdir(parents=True, exist_ok=True)
+        tmp = self.cache_path.with_suffix(".tmp")
+        tmp.write_text(json.dumps(self._data))
+        tmp.replace(self.cache_path)
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._run())
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.refresh_interval)
+            try:
+                await self.refresh()
+            except Exception as e:
+                logger.warning("dynconfig refresh failed: %s", e)
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None

@@ -1,0 +1,331 @@
+"""Manager plane: db, searcher, service, jobs, preheat, REST, RPC, dynconfig."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+
+from dragonfly2_tpu.manager import searcher
+from dragonfly2_tpu.manager.db import Database
+from dragonfly2_tpu.manager.jobs import JOB_FAILURE, JOB_SUCCESS, JobQueue, cluster_queue
+from dragonfly2_tpu.manager.preheat import PreheatProducer, resolve_image_layers
+from dragonfly2_tpu.manager.server import ManagerServer
+from dragonfly2_tpu.manager.service import ManagerService
+from dragonfly2_tpu.rpc.manager import RemoteManagerClient
+from dragonfly2_tpu.utils.dynconfig import Dynconfig
+
+
+# ---------- db ----------
+
+def test_db_crud_json_roundtrip(tmp_path):
+    db = Database(tmp_path / "m.db")
+    cid = db.insert(
+        "scheduler_clusters", name="c1", scopes={"idc": "idc-a", "cidrs": ["10.0.0.0/8"]}
+    )
+    row = db.get("scheduler_clusters", cid)
+    assert row["scopes"]["cidrs"] == ["10.0.0.0/8"]
+    assert row["is_default"] is False
+    assert db.update("scheduler_clusters", cid, is_default=True)
+    assert db.get("scheduler_clusters", cid)["is_default"] is True
+    # unique constraint
+    with pytest.raises(Exception):
+        db.insert("scheduler_clusters", name="c1")
+    db.close()
+
+
+def test_db_upsert():
+    db = Database()
+    r1 = db.upsert("schedulers", {"hostname": "h1", "scheduler_cluster_id": 1}, ip="1.2.3.4", port=80)
+    r2 = db.upsert("schedulers", {"hostname": "h1", "scheduler_cluster_id": 1}, ip="5.6.7.8", port=81)
+    assert r1["id"] == r2["id"] and r2["ip"] == "5.6.7.8"
+
+
+# ---------- searcher (ref searcher.go scoring) ----------
+
+def test_searcher_affinities():
+    assert searcher.cidr_affinity("10.1.2.3", ["10.0.0.0/8"]) == 1.0
+    assert searcher.cidr_affinity("192.168.1.1", ["10.0.0.0/8"]) == 0.0
+    assert searcher.cidr_affinity("bogus", ["10.0.0.0/8"]) == 0.0
+    assert searcher.idc_affinity("idc-a", "idc-b|idc-a") == 1.0
+    assert searcher.idc_affinity("idc-a", "idc-b") == 0.0
+    assert searcher.idc_affinity("", "idc-b") == 0.0
+    # hierarchical prefix match, max 5 elements
+    assert searcher.multi_element_affinity("us|west|a", "us|west|a") == 1.0
+    assert searcher.multi_element_affinity("us|west|a", "us|west|b") == 2 / 5
+    assert searcher.multi_element_affinity("us|west", "eu|west") == 0.0
+
+
+def test_searcher_ranking_prefers_matching_scopes():
+    clusters = [
+        {"id": 1, "is_default": True, "scopes": {}},
+        {"id": 2, "is_default": False, "scopes": {"idc": "idc-a", "cidrs": ["10.0.0.0/8"]}},
+    ]
+    ranked = searcher.find_scheduler_clusters(
+        clusters, "10.9.9.9", {"idc": "idc-a"},
+        has_active_schedulers={1: True, 2: True},
+    )
+    assert ranked[0]["id"] == 2  # cidr+idc beats default bonus
+    # no active schedulers -> filtered
+    assert searcher.find_scheduler_clusters(clusters, "", {}, has_active_schedulers={1: True}) == [clusters[0]]
+
+
+# ---------- service ----------
+
+def test_instance_registry_and_keepalive_reap():
+    svc = ManagerService(keepalive_ttl=0.0)  # everything is instantly stale
+    s = svc.update_scheduler("sch1", "10.0.0.1", 9000)
+    assert s["state"] == "active"
+    assert svc.keepalive("scheduler", "sch1")
+    assert not svc.keepalive("scheduler", "nope")
+    assert svc.reap_stale() >= 1
+    assert svc.db.find_one("schedulers", hostname="sch1")["state"] == "inactive"
+    # keepalive revives
+    assert svc.keepalive("scheduler", "sch1")
+    assert svc.db.find_one("schedulers", hostname="sch1")["state"] == "active"
+
+
+def test_list_schedulers_ranked_by_cluster_affinity():
+    svc = ManagerService()
+    default = svc.get_or_create_default_cluster()
+    near = svc.create_scheduler_cluster("near", scopes={"cidrs": ["10.0.0.0/8"]})
+    svc.update_scheduler("far", "1.1.1.1", 9000, scheduler_cluster_id=default["id"])
+    svc.update_scheduler("close", "10.0.0.2", 9000, scheduler_cluster_id=near["id"])
+    out = svc.list_schedulers(ip="10.5.5.5")
+    assert [s["hostname"] for s in out] == ["close", "far"]
+
+
+def test_model_registry_activate_semantics():
+    svc = ManagerService()
+    m1 = svc.create_model("gnn", "v1", scheduler_id=7, evaluation={"auc": 0.8})
+    m2 = svc.create_model("gnn", "v2", scheduler_id=7, evaluation={"auc": 0.9})
+    other = svc.create_model("mlp", "v1", scheduler_id=7)
+    svc.activate_model(m1["id"])
+    svc.activate_model(m2["id"])  # deactivates m1, same (type, scheduler)
+    svc.activate_model(other["id"])
+    assert svc.active_model("gnn", 7)["version"] == "v2"
+    assert svc.db.get("models", m1["id"])["state"] == "inactive"
+    assert svc.active_model("mlp", 7)["version"] == "v1"
+    # idempotent upsert refreshes evaluation
+    again = svc.create_model("gnn", "v2", scheduler_id=7, evaluation={"auc": 0.95})
+    assert again["id"] == m2["id"] and again["evaluation"]["auc"] == 0.95
+    with pytest.raises(ValueError):
+        svc.create_model("transformer", "v1")
+
+
+def test_cluster_config_address_book():
+    svc = ManagerService()
+    c = svc.get_or_create_default_cluster()
+    svc.update_scheduler("sch1", "10.0.0.1", 9000, scheduler_cluster_id=c["id"])
+    svc.update_seed_peer("seed1", "10.0.0.9", 9100, download_port=9101)
+    cfg = svc.cluster_config(c["id"])
+    assert cfg["schedulers"][0]["ip"] == "10.0.0.1"
+    assert cfg["seed_peers"][0]["download_port"] == 9101
+
+
+# ---------- jobs ----------
+
+def test_job_group_success_and_failure(run):
+    async def body():
+        db = Database()
+        q = JobQueue(db)
+        job = await q.create("preheat", {"urls": ["u"]}, scheduler_cluster_ids=[1, 2])
+        i1 = await q.pull(cluster_queue(1), timeout=1)
+        i2 = await q.pull(cluster_queue(2), timeout=1)
+        assert i1["job_id"] == job["id"] and i2["args"]["urls"] == ["u"]
+        q.complete(job["id"], success=True)
+        assert q.state(job["id"])["state"] not in (JOB_SUCCESS, JOB_FAILURE)  # one left
+        q.complete(job["id"], success=True, result={"pieces": 3})
+        assert q.state(job["id"])["state"] == JOB_SUCCESS
+        # failure path
+        job2 = await q.create("preheat", {"urls": []}, scheduler_cluster_ids=[1])
+        await q.pull(cluster_queue(1), timeout=1)
+        q.complete(job2["id"], success=False, result={"error": "origin 500"})
+        st = q.state(job2["id"])
+        assert st["state"] == JOB_FAILURE and st["result"]["items"][0]["error"] == "origin 500"
+
+    run(body())
+
+
+def test_job_pull_timeout_and_requeue(run):
+    async def body():
+        db = Database()
+        q = JobQueue(db)
+        assert await q.pull(cluster_queue(1), timeout=0.05) is None
+        await q.create("preheat", {"urls": ["u"]}, scheduler_cluster_ids=[1])
+        # simulate restart: fresh queue over same db
+        q2 = JobQueue(db)
+        assert q2.requeue_pending() == 1
+        item = await q2.pull(cluster_queue(1), timeout=1)
+        assert item is not None
+
+    run(body())
+
+
+# ---------- preheat manifest resolution ----------
+
+async def _start_fake_registry():
+    manifest = {
+        "mediaType": "application/vnd.oci.image.manifest.v1+json",
+        "layers": [
+            {"digest": "sha256:aaa", "size": 3},
+            {"digest": "sha256:bbb", "size": 5},
+        ],
+    }
+
+    async def manifests(req):
+        return web.json_response(manifest)
+
+    app = web.Application()
+    app.router.add_get("/v2/library/nginx/manifests/latest", manifests)
+    runner = web.AppRunner(app, access_log=None)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    return runner, f"http://127.0.0.1:{port}"
+
+
+def test_resolve_image_layers(run):
+    async def body():
+        runner, base = await _start_fake_registry()
+        try:
+            urls = await resolve_image_layers(f"{base}/v2/library/nginx/manifests/latest")
+            assert urls == [
+                f"{base}/v2/library/nginx/blobs/sha256:aaa",
+                f"{base}/v2/library/nginx/blobs/sha256:bbb",
+            ]
+            with pytest.raises(ValueError):
+                await resolve_image_layers("http://x/not/an/image")
+        finally:
+            await runner.cleanup()
+
+    run(body())
+
+
+def test_preheat_producer_file(run):
+    async def body():
+        q = JobQueue(Database())
+        p = PreheatProducer(q)
+        job = await p.create_preheat("file", "http://o/f", scheduler_cluster_ids=[1], tag="t")
+        item = await q.pull(cluster_queue(1), timeout=1)
+        assert item["args"]["urls"] == ["http://o/f"] and item["args"]["tag"] == "t"
+        with pytest.raises(ValueError):
+            await p.create_preheat("weird", "http://o/f", scheduler_cluster_ids=[1])
+
+    run(body())
+
+
+# ---------- full server: RPC + REST ----------
+
+def test_manager_server_rpc_and_rest(run, tmp_path):
+    async def body():
+        server = ManagerServer(db_path=str(tmp_path / "m.db"))
+        await server.start()
+        try:
+            client = RemoteManagerClient(server.address)
+            assert await client.healthy()
+            await client.update_scheduler("sch1", "127.0.0.1", 9000)
+            scheds = await client.list_schedulers(ip="127.0.0.1")
+            assert scheds[0]["hostname"] == "sch1"
+            assert await client.keepalive("scheduler", "sch1")
+            m = await client.create_model("gnn", "v1", scheduler_id=scheds[0]["id"], evaluation={"auc": 0.7})
+            await client.activate_model(m["id"])
+            active = await client.active_model("gnn", scheds[0]["id"])
+            assert active["version"] == "v1"
+            cfg = await client.cluster_config(scheds[0]["scheduler_cluster_id"])
+            assert cfg["schedulers"]
+
+            # REST smoke
+            import aiohttp
+
+            async with aiohttp.ClientSession() as sess:
+                base = f"http://127.0.0.1:{server.rest_port}"
+                async with sess.get(f"{base}/healthz") as r:
+                    assert (await r.json())["status"] == "ok"
+                async with sess.get(f"{base}/api/v1/schedulers") as r:
+                    assert (await r.json())[0]["hostname"] == "sch1"
+                async with sess.get(f"{base}/api/v1/models") as r:
+                    assert (await r.json())[0]["state"] == "active"
+            await client.close()
+        finally:
+            await server.stop()
+
+    run(body())
+
+
+# ---------- dynconfig ----------
+
+def test_dynconfig_cache_and_observer(run, tmp_path):
+    async def body():
+        calls = {"n": 0}
+        fail = {"on": False}
+
+        async def fetch():
+            if fail["on"]:
+                raise ConnectionError("manager down")
+            calls["n"] += 1
+            return {"schedulers": [{"ip": "10.0.0.1"}], "rev": calls["n"]}
+
+        seen = []
+        dc = Dynconfig(fetch, cache_path=tmp_path / "dc.json")
+        dc.register(seen.append)
+        await dc.load()
+        assert dc.data["rev"] == 1 and seen[-1]["rev"] == 1
+        await dc.refresh()
+        assert dc.data["rev"] == 2
+
+        # manager down, fresh instance: boots from disk cache
+        fail["on"] = True
+        dc2 = Dynconfig(fetch, cache_path=tmp_path / "dc.json")
+        await dc2.load()
+        assert dc2.data["rev"] == 2
+        # no cache and down -> raises
+        dc3 = Dynconfig(fetch, cache_path=tmp_path / "missing.json")
+        with pytest.raises(ConnectionError):
+            await dc3.load()
+
+    run(body())
+
+
+def test_job_complete_idempotent_and_lease_requeue(run):
+    async def body():
+        db = Database()
+        q = JobQueue(db, lease_timeout=0.0)  # leases expire instantly
+        job = await q.create("preheat", {"urls": ["u"]}, scheduler_cluster_ids=[1, 2])
+        item = await q.pull(cluster_queue(1), timeout=1)
+        # duplicate completion (retried RPC) must not finalize the group early
+        q.complete(job["id"], success=True, cluster_id=1)
+        q.complete(job["id"], success=True, cluster_id=1)
+        assert q.state(job["id"])["state"] not in (JOB_SUCCESS, JOB_FAILURE)
+        # lost worker: pulled but never completed -> lease reaper requeues
+        item2 = await q.pull(cluster_queue(2), timeout=1)
+        assert q.reap_leases() == 1
+        item2b = await q.pull(cluster_queue(2), timeout=1)
+        assert item2b["cluster_id"] == 2
+        q.complete(job["id"], success=True, cluster_id=2)
+        assert q.state(job["id"])["state"] == JOB_SUCCESS
+
+    run(body())
+
+
+def test_dynconfig_observer_fires_on_cache_boot(run, tmp_path):
+    async def body():
+        async def ok_fetch():
+            return {"rev": 1}
+
+        dc = Dynconfig(ok_fetch, cache_path=tmp_path / "dc.json")
+        await dc.load()
+
+        async def down_fetch():
+            raise ConnectionError("down")
+
+        seen = []
+        dc2 = Dynconfig(down_fetch, cache_path=tmp_path / "dc.json")
+        dc2.register(seen.append)
+        await dc2.load()  # cache fallback must still notify observers
+        assert seen and seen[-1]["rev"] == 1
+
+    run(body())
