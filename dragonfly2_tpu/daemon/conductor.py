@@ -142,6 +142,7 @@ class PeerTaskConductor:
         sources: SourceRegistry,
         config: ConductorConfig | None = None,
         http_session: aiohttp.ClientSession | None = None,
+        headers: dict[str, str] | None = None,
     ):
         self.peer_id = peer_id
         self.meta = meta
@@ -149,6 +150,7 @@ class PeerTaskConductor:
         self.scheduler = scheduler
         self.storage = storage
         self.sources = sources
+        self.headers = headers or None  # origin request headers (auth etc.)
         self.cfg = config or ConductorConfig()
         self.dispatcher = PieceDispatcher()
         self.bucket = TokenBucket(self.cfg.download_rate_bps, burst=64 << 20)
@@ -230,7 +232,7 @@ class PeerTaskConductor:
 
     async def _download_back_to_source(self) -> None:
         url = self.meta.url
-        info = await self.sources.info(url)
+        info = await self.sources.info(url, self.headers)
         if self.ts.meta.content_length < 0:
             if info.content_length < 0:
                 await self._download_source_unknown_length(info)
@@ -272,7 +274,7 @@ class PeerTaskConductor:
             r = piece_range(idx, m.piece_size, m.content_length)
             t0 = time.monotonic()
             buf = bytearray()
-            async for chunk in self.sources.download(self.meta.url, r):
+            async for chunk in self.sources.download(self.meta.url, r, self.headers):
                 buf.extend(chunk)
                 await self.bucket.acquire(len(chunk))
             if len(buf) != r.length:
@@ -290,7 +292,7 @@ class PeerTaskConductor:
         buf = bytearray()
         idx = 0
         t0 = time.monotonic()
-        async for chunk in self.sources.download(self.meta.url):
+        async for chunk in self.sources.download(self.meta.url, headers=self.headers):
             buf.extend(chunk)
             await self.bucket.acquire(len(chunk))
             while len(buf) >= m.piece_size and idx < m.total_pieces - 1:
@@ -314,7 +316,7 @@ class PeerTaskConductor:
     async def _download_source_unknown_length(self, info) -> None:
         """Origin without Content-Length: stream whole body, then size pieces."""
         buf = bytearray()
-        async for chunk in self.sources.download(self.meta.url):
+        async for chunk in self.sources.download(self.meta.url, headers=self.headers):
             buf.extend(chunk)
             await self.bucket.acquire(len(chunk))
         data = bytes(buf)

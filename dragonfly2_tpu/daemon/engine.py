@@ -71,6 +71,9 @@ class PeerEngine:
     ):
         self.ip = ip
         self.hostname = hostname or f"peer-{idgen.local_ip()}"
+        # TCP RPC port, set by the daemon server when it listens on TCP —
+        # advertised via HostInfo.port so the scheduler can trigger seeds.
+        self.rpc_port = 0
         self.host_type = host_type
         self.idc = idc
         self.location = location
@@ -90,6 +93,7 @@ class PeerEngine:
             id=self.host_id,
             ip=self.ip,
             hostname=self.hostname,
+            port=self.rpc_port,
             download_port=self.upload.port,
             type=self.host_type,
             idc=self.idc,
@@ -110,13 +114,18 @@ class PeerEngine:
     # ---- task API (ref StartFileTask / StartSeedTask) ----
 
     def make_meta(self, url: str, **kw) -> TaskMeta:
-        task_id = idgen.task_id(
-            url,
-            filters=kw.get("filters", ()),
-            tag=kw.get("tag", ""),
-            application=kw.get("application", ""),
-            digest=kw.get("digest", ""),
-        )
+        if url.startswith("d7y://cache/"):
+            # imported cache object: the URL carries its digest-keyed task id
+            # (see import_file) — recompute nothing, or two hosts disagree
+            task_id = url.rsplit("/", 1)[1]
+        else:
+            task_id = idgen.task_id(
+                url,
+                filters=kw.get("filters", ()),
+                tag=kw.get("tag", ""),
+                application=kw.get("application", ""),
+                digest=kw.get("digest", ""),
+            )
         return TaskMeta(
             task_id=task_id,
             url=url,
@@ -127,7 +136,13 @@ class PeerEngine:
         )
 
     async def download_task(
-        self, url: str, *, output: str | Path | None = None, seed: bool = False, **meta_kw
+        self,
+        url: str,
+        *,
+        output: str | Path | None = None,
+        seed: bool = False,
+        headers: dict[str, str] | None = None,
+        **meta_kw,
     ) -> TaskStorage:
         """Download (or reuse) a task; optionally export to a named file."""
         await self.start()
@@ -151,10 +166,68 @@ class PeerEngine:
                 storage=self.storage,
                 sources=self.sources,
                 config=self.conductor_config,
+                headers=headers,
             )
             ts = await conductor.run()
         if output is not None:
             await ts.export_to(output)
+        return ts
+
+    async def import_file(self, path: str | Path, *, tag: str = "", application: str = "") -> TaskStorage:
+        """Import a local file into the P2P cache (ref dfcache Import,
+        client/dfcache/dfcache.go:105 importTask): slice it into pieces in
+        local storage, then register with the scheduler as an instantly
+        successful peer so other peers can parent off this host (the
+        reference's AnnounceTask path, scheduler/service/service_v1.go).
+        Keyed by content digest (idgen.persistent_cache_task_id), so identical
+        bytes imported under any filename on any host dedupe to one task.
+        File I/O and hashing run off the event loop; pieces stream from disk
+        (multi-GB model files must not be held in RAM)."""
+        await self.start()
+        import asyncio
+
+        from dragonfly2_tpu.utils import digest as digestlib
+        from dragonfly2_tpu.utils.pieces import compute_piece_size, piece_count, piece_range
+
+        path = Path(path)
+
+        def _hash_and_size() -> tuple[str, int]:
+            with open(path, "rb") as f:
+                d = digestlib.compute_file("sha256", f)
+            return str(d), path.stat().st_size
+
+        dig, size = await asyncio.to_thread(_hash_and_size)
+        task_id = idgen.persistent_cache_task_id(dig, tag, application)
+        url = f"d7y://cache/{task_id}"
+        meta = TaskMeta(
+            task_id=task_id, url=url, digest=dig, tag=tag, application=application
+        )
+
+        ts = self.storage.find_completed_task(task_id)
+        if ts is None:
+            ts = self.storage.register_task(task_id, url=url, tag=tag, digest=dig)
+            piece_size = compute_piece_size(size)
+            n = piece_count(size, piece_size)
+            ts.set_task_info(
+                content_length=size, piece_size=piece_size, total_pieces=n, digest=dig
+            )
+            with open(path, "rb") as f:
+                for idx in range(n):
+                    r = piece_range(idx, piece_size, size)
+                    chunk = await asyncio.to_thread(f.read, r.length)
+                    await ts.write_piece(idx, chunk)
+            ts.mark_done()
+
+        # announce so the scheduler adds this peer as a ready parent
+        peer_id = idgen.peer_id(self.ip, self.hostname)
+        await self.scheduler.register_peer(peer_id, meta, self.host_info())
+        await self.scheduler.report_task_metadata(
+            meta.task_id, content_length=size,
+            piece_size=ts.meta.piece_size, digest=dig,
+        )
+        for idx in range(ts.meta.total_pieces):
+            await self.scheduler.report_piece_result(peer_id, idx, success=True)
+        await self.scheduler.report_peer_result(peer_id, success=True)
         return ts
 
     async def seed_task(self, task) -> None:

@@ -21,7 +21,10 @@ from dragonfly2_tpu.utils.proc import run_until_signalled
 
 logger = logging.getLogger("daemon")
 
-DAEMON_METHODS = ["download", "stat_task", "delete_task", "export_task", "host_info"]
+DAEMON_METHODS = [
+    "download", "stat_task", "delete_task", "export_task", "host_info",
+    "trigger_seed", "import_file",
+]
 
 
 class DaemonRpcAdapter:
@@ -38,6 +41,7 @@ class DaemonRpcAdapter:
             application=p.get("application", ""),
             digest=p.get("digest", ""),
             filters=tuple(p.get("filters", ())),
+            headers=p.get("headers") or None,
         )
         return {
             "task_id": ts.meta.task_id,
@@ -71,6 +75,35 @@ class DaemonRpcAdapter:
         hi = self.engine.host_info()
         return {"id": hi.id, "ip": hi.ip, "download_port": hi.download_port}
 
+    async def trigger_seed(self, p: dict) -> dict:
+        """Seed this task from origin (ref cdnsystemv1 ObtainSeeds served by
+        dfdaemon's seeder facade, client/daemon/rpcserver/seeder.go:49-53).
+        Called by the scheduler over TCP RPC; synchronous — returns when the
+        seed copy is complete so preheat jobs can report success."""
+        ts = await self.engine.download_task(
+            p["url"],
+            seed=True,
+            tag=p.get("tag", ""),
+            application=p.get("application", ""),
+            digest=p.get("digest", ""),
+            filters=tuple(p.get("filters", ())),
+            headers=p.get("headers") or None,
+        )
+        return {
+            "task_id": ts.meta.task_id,
+            "content_length": ts.meta.content_length,
+            "pieces": ts.finished_count(),
+            "done": ts.meta.done,
+        }
+
+    async def import_file(self, p: dict) -> dict:
+        """Import a local file into the P2P cache (ref dfcache Import,
+        client/dfcache/dfcache.go:105)."""
+        ts = await self.engine.import_file(
+            p["path"], tag=p.get("tag", ""), application=p.get("application", "")
+        )
+        return {"task_id": ts.meta.task_id, "pieces": ts.finished_count()}
+
 
 async def run_daemon(
     *,
@@ -83,6 +116,8 @@ async def run_daemon(
     idc: str = "",
     location: str = "",
     upload_port: int = 0,
+    rpc_port: int | None = None,
+    manager_addr: str | None = None,
     announce_interval: float = 30.0,
     ready_event: asyncio.Event | None = None,
 ) -> None:
@@ -102,16 +137,46 @@ async def run_daemon(
     server = RpcServer(unix_path=sock_path)
     server.register_service(DaemonRpcAdapter(engine), DAEMON_METHODS)
     await server.start()
-    logger.info("daemon rpc on %s, piece server on :%d", sock_path, engine.upload.port)
+
+    # Seed peers also listen on TCP so the scheduler can trigger_seed them
+    # (the reference's cdnsystem gRPC port, seed_peer.go:115). Normal peers
+    # may opt in with --rpc-port.
+    tcp_server = None
+    if rpc_port is not None or host_type == "seed":
+        tcp_server = RpcServer(host=ip, port=rpc_port or 0)
+        tcp_server.register_service(DaemonRpcAdapter(engine), DAEMON_METHODS)
+        await tcp_server.start()
+        engine.rpc_port = tcp_server.port
+    logger.info(
+        "daemon rpc on %s (tcp %s), piece server on :%d",
+        sock_path, engine.rpc_port or "-", engine.upload.port,
+    )
     print(f"DAEMON_READY {sock_path} {engine.upload.port}", flush=True)
 
+    manager = None
+    if manager_addr:
+        from dragonfly2_tpu.rpc.manager import RemoteManagerClient
+
+        manager = RemoteManagerClient(manager_addr)
+
     async def announce_loop() -> None:
-        """Keepalive + host stats to the scheduler (ref client/daemon/announcer)."""
+        """Keepalive + host stats to the scheduler (ref client/daemon/announcer:
+        AnnounceHost to scheduler + keepalive to manager)."""
         while True:
             try:
                 await scheduler.announce_host(engine.host_info(), _host_stats())
             except Exception:
                 logger.warning("announce failed", exc_info=True)
+            if manager is not None:
+                try:
+                    if host_type == "seed":
+                        await manager.update_seed_peer(
+                            engine.hostname, ip, engine.rpc_port,
+                            download_port=engine.upload.port,
+                            idc=idc, location=location,
+                        )
+                except Exception:
+                    logger.warning("manager keepalive failed", exc_info=True)
             await asyncio.sleep(announce_interval)
 
     announcer = asyncio.ensure_future(announce_loop())
@@ -120,8 +185,12 @@ async def run_daemon(
     finally:
         announcer.cancel()
         await server.stop()
+        if tcp_server is not None:
+            await tcp_server.stop()
         await engine.stop()
         await scheduler.close()
+        if manager is not None:
+            await manager.close()
         if os.path.exists(sock_path):
             os.unlink(sock_path)
 
@@ -155,6 +224,9 @@ def main() -> None:
     ap.add_argument("--idc", default="")
     ap.add_argument("--location", default="")
     ap.add_argument("--upload-port", type=int, default=0)
+    ap.add_argument("--rpc-port", type=int, default=None,
+                    help="TCP RPC port (seed peers always listen; 0 = ephemeral)")
+    ap.add_argument("--manager", default=None, help="manager address host:port")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
     logging.basicConfig(
@@ -172,6 +244,8 @@ def main() -> None:
             idc=args.idc,
             location=args.location,
             upload_port=args.upload_port,
+            rpc_port=args.rpc_port,
+            manager_addr=args.manager,
         )
     )
 

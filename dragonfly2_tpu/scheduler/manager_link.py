@@ -1,0 +1,232 @@
+"""Scheduler ↔ manager integration: registration, keepalive, dynconfig, jobs,
+and the seed-peer trigger client.
+
+Reference equivalents:
+- registration/keepalive: scheduler/scheduler.go:148 (GetScheduler +
+  KeepAlive stream to manager) — here periodic `keepalive` RPCs.
+- dynconfig: scheduler/config/dynconfig.go (manager-backed address book).
+- preheat worker: scheduler/job/job.go:105-160 (machinery consumer; here a
+  long-poll pull loop on the manager's per-cluster queue, 20 min task
+  timeout kept).
+- seed trigger: scheduler/resource/seed_peer.go:53-115 TriggerTask via the
+  cdnsystem client — here a `trigger_seed` RPC to a seed daemon, chosen from
+  scheduler-announced seed hosts first, manager address book as fallback.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+from typing import Optional
+
+from dragonfly2_tpu.rpc.core import RpcClient
+from dragonfly2_tpu.rpc.manager import RemoteManagerClient
+from dragonfly2_tpu.scheduler.resource import HostType, Task
+from dragonfly2_tpu.scheduler.service import SchedulerService
+from dragonfly2_tpu.utils.dynconfig import Dynconfig
+
+logger = logging.getLogger(__name__)
+
+PREHEAT_TIMEOUT = 20 * 60.0  # ref scheduler/job/job.go:44
+
+
+class SeedPeerConnector:
+    """Picks a live seed daemon and asks it to seed a task from origin."""
+
+    def __init__(self, service: SchedulerService, *, address_book: list[dict] | None = None):
+        self.service = service
+        self.address_book = address_book or []  # manager-fed fallback
+        self._clients: dict[str, RpcClient] = {}
+
+    def update_address_book(self, seed_peers: list[dict]) -> None:
+        self.address_book = seed_peers
+
+    def _candidates(self) -> list[str]:
+        """Seed RPC addresses: scheduler-announced seed hosts first (they are
+        fresher — direct announce beats manager round trip), then manager's."""
+        out = []
+        for host in self.service.pool.hosts.values():
+            if host.type == HostType.SEED and host.port:
+                out.append(f"{host.ip}:{host.port}")
+        for sp in self.address_book:
+            addr = f"{sp['ip']}:{sp['port']}"
+            if addr not in out and sp.get("port"):
+                out.append(addr)
+        return out
+
+    def _client(self, addr: str) -> RpcClient:
+        c = self._clients.get(addr)
+        if c is None:
+            c = self._clients[addr] = RpcClient(addr, retries=0)
+        return c
+
+    async def trigger(
+        self, url: str, *, tag: str = "", application: str = "",
+        digest: str = "", filters: tuple = (), headers: dict | None = None,
+        timeout: float = PREHEAT_TIMEOUT,
+    ) -> dict:
+        """Trigger a seed download; tries each candidate until one accepts.
+
+        `timeout` is the TOTAL budget: it is split across candidates so a
+        hung first seed still leaves time to fail over to a healthy one."""
+        candidates = self._candidates()
+        if not candidates:
+            raise RuntimeError("no seed peers available")
+        per_candidate = max(5.0, timeout / len(candidates))
+        last_err: Exception | None = None
+        for addr in candidates:
+            try:
+                return await self._client(addr).call(
+                    "trigger_seed",
+                    {"url": url, "tag": tag, "application": application,
+                     "digest": digest, "filters": list(filters),
+                     "headers": headers or {}},
+                    timeout=per_candidate,
+                )
+            except Exception as e:
+                logger.warning("seed trigger via %s failed: %s", addr, e)
+                last_err = e
+        raise last_err or RuntimeError("no seed peers available")
+
+    async def trigger_task(self, task: Task) -> None:
+        """SchedulerService.seed_trigger hook (ref TriggerTask)."""
+        await self.trigger(
+            task.url, tag=task.tag, application=task.application,
+            digest=task.digest, filters=task.filters,
+        )
+
+    async def close(self) -> None:
+        for c in self._clients.values():
+            await c.close()
+        self._clients.clear()
+
+
+class ManagerLink:
+    """Everything a scheduler does with the manager, in one lifecycle."""
+
+    def __init__(
+        self,
+        service: SchedulerService,
+        manager_addr: str,
+        *,
+        hostname: str = "",
+        ip: str = "127.0.0.1",
+        port: int = 0,
+        idc: str = "",
+        location: str = "",
+        cache_path: str | None = None,
+        keepalive_interval: float = 20.0,
+        dynconfig_interval: float = 60.0,
+    ):
+        self.service = service
+        self.manager = RemoteManagerClient(manager_addr)
+        self.hostname = hostname or socket.gethostname()
+        self.ip = ip
+        self.port = port
+        self.idc = idc
+        self.location = location
+        self.keepalive_interval = keepalive_interval
+        self.scheduler_id: int | None = None
+        self.cluster_id: int | None = None
+        self.seed_connector = SeedPeerConnector(service)
+        self.dynconfig = Dynconfig(
+            self._fetch_cluster_config,
+            cache_path=cache_path,
+            refresh_interval=dynconfig_interval,
+        )
+        self.dynconfig.register(self._on_config)
+        self._tasks: list[asyncio.Task] = []
+
+    async def _fetch_cluster_config(self) -> dict:
+        assert self.cluster_id is not None
+        return await self.manager.cluster_config(self.cluster_id)
+
+    def _on_config(self, cfg: dict) -> None:
+        self.seed_connector.update_address_book(cfg.get("seed_peers") or [])
+
+    async def start(self) -> None:
+        """Register with the manager, start keepalive + dynconfig + job loops,
+        and install the seed trigger on the service."""
+        row = await self.manager.update_scheduler(
+            self.hostname, self.ip, self.port, idc=self.idc, location=self.location,
+        )
+        self.scheduler_id = row["id"]
+        self.cluster_id = row["scheduler_cluster_id"]
+        try:
+            await self.dynconfig.load()
+        except Exception as e:
+            logger.warning("initial dynconfig load failed: %s", e)
+        self.dynconfig.start()
+        self.service.seed_trigger = self.seed_connector.trigger_task
+        self._tasks = [
+            asyncio.ensure_future(self._keepalive_loop()),
+            asyncio.ensure_future(self._job_loop()),
+        ]
+        logger.info(
+            "manager link up: scheduler_id=%s cluster_id=%s", self.scheduler_id, self.cluster_id
+        )
+
+    async def _keepalive_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.keepalive_interval)
+            try:
+                await self.manager.keepalive("scheduler", self.hostname, self.cluster_id)
+            except Exception as e:
+                logger.warning("manager keepalive failed: %s", e)
+
+    async def _job_loop(self) -> None:
+        """Preheat consumer (ref scheduler/job preheat handler)."""
+        queue = f"scheduler_cluster_{self.cluster_id}"
+        while True:
+            try:
+                item = await self.manager.pull_job(queue, timeout=30.0)
+            except Exception as e:
+                logger.warning("job pull failed: %s", e)
+                await asyncio.sleep(5.0)
+                continue
+            if item is None:
+                continue
+            await self._run_job(item)
+
+    async def _run_job(self, item: dict) -> None:
+        args = item.get("args") or {}
+        ok, detail = True, {}
+        if item.get("type") == "preheat":
+            urls = args.get("urls") or []
+            done, failed = 0, []
+            for url in urls:
+                try:
+                    # trigger() owns the PREHEAT_TIMEOUT budget and splits it
+                    # across seed candidates for failover
+                    await self.seed_connector.trigger(
+                        url, tag=args.get("tag", ""),
+                        filters=tuple(args.get("filters", ())),
+                        headers=args.get("headers") or None,
+                    )
+                    done += 1
+                except Exception as e:
+                    logger.warning("preheat of %s failed: %s", url, e)
+                    failed.append({"url": url, "error": str(e)})
+            ok = bool(urls) and not failed  # zero URLs is a bad job, not a success
+            detail = {"preheated": done, "failed": failed}
+            if not urls:
+                detail["error"] = "preheat job has no urls"
+        else:
+            ok = False
+            detail = {"error": f"unknown job type {item.get('type')!r}"}
+        try:
+            await self.manager.complete_job(
+                item["job_id"], success=ok, result=detail, cluster_id=item.get("cluster_id")
+            )
+        except Exception as e:
+            logger.warning("job completion report failed: %s", e)
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._tasks = []
+        await self.dynconfig.stop()
+        await self.seed_connector.close()
+        await self.manager.close()

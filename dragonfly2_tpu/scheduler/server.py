@@ -27,6 +27,10 @@ async def run_scheduler(
     telemetry_dir: str | None = None,
     evaluator: str = "base",
     gc_interval: float = 10.0,
+    manager_addr: str | None = None,
+    hostname: str = "",
+    idc: str = "",
+    location: str = "",
     ready_event: asyncio.Event | None = None,
 ) -> None:
     from dragonfly2_tpu.scheduler.evaluator import new_evaluator
@@ -36,6 +40,23 @@ async def run_scheduler(
     server = serve_scheduler(service, host=host, port=port)
     await server.start()
     logger.info("scheduler listening on %s", server.address)
+
+    link = None
+    if manager_addr:
+        from dragonfly2_tpu.scheduler.manager_link import ManagerLink
+
+        link = ManagerLink(
+            service, manager_addr,
+            hostname=hostname, ip=host, port=server.port,
+            idc=idc, location=location,
+        )
+        try:
+            await link.start()
+        except Exception:
+            # Scheduler still serves its cluster when the manager is down
+            # (ref: dynconfig disk cache exists for the same reason).
+            logger.exception("manager link failed to start; continuing standalone")
+            link = None
     print(f"SCHEDULER_READY {server.address}", flush=True)
 
     gc = GC()
@@ -45,6 +66,8 @@ async def run_scheduler(
         await run_until_signalled(ready_event)
     finally:
         gc.stop()
+        if link is not None:
+            await link.stop()
         if telemetry:
             telemetry.flush()
         await server.stop()
@@ -62,6 +85,10 @@ def main() -> None:
     ap.add_argument("--port", type=int, default=9000)
     ap.add_argument("--telemetry-dir", default=None)
     ap.add_argument("--evaluator", default="base", choices=["base", "ml"])
+    ap.add_argument("--manager", default=None, help="manager address host:port")
+    ap.add_argument("--hostname", default="")
+    ap.add_argument("--idc", default="")
+    ap.add_argument("--location", default="")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
     logging.basicConfig(
@@ -74,6 +101,10 @@ def main() -> None:
             port=args.port,
             telemetry_dir=args.telemetry_dir,
             evaluator=args.evaluator,
+            manager_addr=args.manager,
+            hostname=args.hostname,
+            idc=args.idc,
+            location=args.location,
         )
     )
 

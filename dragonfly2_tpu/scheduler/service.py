@@ -149,6 +149,8 @@ class SchedulerService:
                 self.seed_trigger is not None
                 and task.id not in self._seed_triggered
                 and host.type != HostType.SEED
+                # cache imports (d7y scheme) have no origin to seed from
+                and not task.url.startswith("d7y://")
             ):
                 self._seed_triggered.add(task.id)
                 asyncio.ensure_future(self._run_seed_trigger(task))
@@ -344,6 +346,13 @@ class SchedulerService:
             port=info.port, download_port=info.download_port,
             host_type=HostType(info.type), idc=info.idc, location=info.location,
         )
+        # Refresh connection endpoints: the host row may predate this announce
+        # (created by register_peer with no RPC port) and ports move on restart.
+        if info.port:
+            host.port = info.port
+        if info.download_port:
+            host.download_port = info.download_port
+        host.type = HostType(info.type)
         if stats:
             for k, v in stats.items():
                 if hasattr(host.stats, k):

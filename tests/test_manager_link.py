@@ -1,0 +1,248 @@
+"""Scheduler↔manager integration: registration, dynconfig, seed trigger over
+TCP RPC, preheat job end-to-end (REST create → worker pull → seed → SUCCESS),
+and dfcache-style import announcing the peer as an instant parent."""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+
+import aiohttp
+import pytest
+
+from dragonfly2_tpu.daemon.engine import InProcessSchedulerClient
+from dragonfly2_tpu.daemon.server import DAEMON_METHODS, DaemonRpcAdapter
+from dragonfly2_tpu.manager.server import ManagerServer
+from dragonfly2_tpu.rpc.core import RpcServer
+from dragonfly2_tpu.scheduler.manager_link import ManagerLink, SeedPeerConnector
+from dragonfly2_tpu.scheduler.service import SchedulerService
+
+from test_e2e import Origin, make_engine
+
+
+async def _seed_daemon_tcp(engine):
+    """Expose an engine's daemon RPC (incl. trigger_seed) on localhost TCP."""
+    server = RpcServer(host="127.0.0.1", port=0)
+    server.register_service(DaemonRpcAdapter(engine), DAEMON_METHODS)
+    await server.start()
+    engine.rpc_port = server.port
+    return server
+
+
+def test_preheat_end_to_end(run, tmp_path):
+    async def body():
+        payload = b"preheat-me" * 5000
+        async with Origin({"layer.bin": payload}) as origin:
+            manager = ManagerServer(db_path=str(tmp_path / "m.db"))
+            await manager.start()
+
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            seed = make_engine(tmp_path, client, "seed1", host_type="seed")
+            await seed.start()
+            seed_rpc = await _seed_daemon_tcp(seed)
+            # seed daemon registers itself with the manager (announce loop)
+            from dragonfly2_tpu.rpc.manager import RemoteManagerClient
+
+            mc = RemoteManagerClient(manager.address)
+            await mc.update_seed_peer(
+                "seed1", "127.0.0.1", seed.rpc_port, download_port=seed.upload.port
+            )
+
+            link = ManagerLink(
+                svc, manager.address, hostname="sch1", ip="127.0.0.1", port=9000,
+                keepalive_interval=0.2,
+            )
+            await link.start()
+            try:
+                assert link.cluster_id is not None
+                # dynconfig pulled the seed address book from the manager
+                assert link.seed_connector.address_book[0]["hostname"] == "seed1"
+
+                # create a preheat job via REST, as ops tooling would
+                async with aiohttp.ClientSession() as sess:
+                    async with sess.post(
+                        f"http://127.0.0.1:{manager.rest_port}/api/v1/jobs",
+                        json={
+                            "type": "preheat",
+                            "args": {"type": "file", "url": origin.url("layer.bin")},
+                            "scheduler_cluster_ids": [link.cluster_id],
+                        },
+                    ) as r:
+                        assert r.status == 201
+                        job = await r.json()
+
+                    # the link's job loop pulls, triggers the seed, completes
+                    for _ in range(100):
+                        async with sess.get(
+                            f"http://127.0.0.1:{manager.rest_port}/api/v1/jobs/{job['id']}"
+                        ) as r:
+                            st = await r.json()
+                        if st["state"] in ("SUCCESS", "FAILURE"):
+                            break
+                        await asyncio.sleep(0.1)
+                assert st["state"] == "SUCCESS", st
+                assert st["result"]["items"][0]["preheated"] == 1
+
+                # seed actually holds the bytes
+                ts = seed.storage.tasks()[0]
+                assert ts.meta.done
+                # scheduler keepalive keeps the instance active
+                await asyncio.sleep(0.5)
+                scheds = await mc.list_schedulers(ip="127.0.0.1")
+                assert scheds[0]["hostname"] == "sch1" and scheds[0]["state"] == "active"
+                await mc.close()
+            finally:
+                await link.stop()
+                await seed_rpc.stop()
+                await seed.stop()
+                await manager.stop()
+
+    run(body())
+
+
+def test_seed_connector_prefers_announced_hosts(run, tmp_path):
+    async def body():
+        payload = b"x" * 1024
+        async with Origin({"f": payload}) as origin:
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            seed = make_engine(tmp_path, client, "seed-a", host_type="seed")
+            await seed.start()
+            seed_rpc = await _seed_daemon_tcp(seed)
+            try:
+                # announce pushes the seed host (with TCP port) into the pool
+                svc.announce_host(seed.host_info())
+                conn = SeedPeerConnector(svc)
+                assert conn._candidates() == [f"127.0.0.1:{seed.rpc_port}"]
+                out = await conn.trigger(origin.url("f"))
+                assert out["done"] and out["pieces"] >= 1
+                await conn.close()
+            finally:
+                await seed_rpc.stop()
+                await seed.stop()
+
+    run(body())
+
+
+def test_seed_connector_fallback_to_address_book_and_failure(run):
+    async def body():
+        svc = SchedulerService()
+        conn = SeedPeerConnector(
+            svc, address_book=[{"ip": "127.0.0.1", "port": 1, "hostname": "dead"}]
+        )
+        assert conn._candidates() == ["127.0.0.1:1"]
+        with pytest.raises(Exception):
+            await conn.trigger("http://origin/f", timeout=1.0)
+        await conn.close()
+
+    run(body())
+
+
+def test_import_file_announces_instant_parent(run, tmp_path):
+    async def body():
+        svc = SchedulerService()
+        client = InProcessSchedulerClient(svc)
+        importer = make_engine(tmp_path, client, "importer")
+        await importer.start()
+        downloader = make_engine(tmp_path, client, "downloader")
+        await downloader.start()
+        try:
+            src = tmp_path / "model.bin"
+            src.write_bytes(b"weights" * 10000)
+            ts = await importer.import_file(src, tag="cache")
+            assert ts.meta.done
+            task = svc.pool.tasks[ts.meta.task_id]
+            assert task.has_available_peer()
+
+            # second engine fetches the cached task P2P (no origin exists at all)
+            ts2 = await downloader.download_task(
+                ts.meta.url, tag="cache", digest=ts.meta.digest
+            )
+            assert ts2.meta.done
+            exported = tmp_path / "out.bin"
+            await ts2.export_to(exported)
+            assert (
+                hashlib.sha256(exported.read_bytes()).hexdigest()
+                == hashlib.sha256(src.read_bytes()).hexdigest()
+            )
+        finally:
+            await importer.stop()
+            await downloader.stop()
+
+    run(body())
+
+
+def test_preheat_forwards_headers_and_empty_urls_fail(run, tmp_path):
+    async def body():
+        from aiohttp import web
+
+        hits = {"authed": 0, "denied": 0}
+
+        async def guarded(req):
+            if req.headers.get("Authorization") != "Bearer tok":
+                hits["denied"] += 1
+                raise web.HTTPUnauthorized()
+            hits["authed"] += 1
+            data = b"private" * 1000
+            rng = req.headers.get("Range")
+            if rng:
+                from dragonfly2_tpu.utils.pieces import parse_http_range
+
+                r = parse_http_range(rng, len(data))
+                return web.Response(status=206, body=data[r.start : r.start + r.length])
+            return web.Response(body=data)
+
+        app = web.Application()
+        app.router.add_get("/private.bin", guarded)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+
+        svc = SchedulerService()
+        client = InProcessSchedulerClient(svc)
+        seed = make_engine(tmp_path, client, "seed-h", host_type="seed")
+        await seed.start()
+        seed_rpc = await _seed_daemon_tcp(seed)
+        try:
+            svc.announce_host(seed.host_info())
+            conn = SeedPeerConnector(svc)
+            out = await conn.trigger(
+                f"http://127.0.0.1:{port}/private.bin",
+                headers={"Authorization": "Bearer tok"},
+            )
+            assert out["done"] and hits["authed"] >= 1
+            await conn.close()
+
+            # empty-urls preheat job must report FAILURE, not vacuous success
+            from dragonfly2_tpu.manager.server import ManagerServer
+
+            manager = ManagerServer(db_path=str(tmp_path / "m2.db"))
+            await manager.start()
+            link = ManagerLink(svc, manager.address, hostname="sch-h", ip="127.0.0.1", port=1)
+            await link.start()
+            try:
+                from dragonfly2_tpu.rpc.manager import RemoteManagerClient
+
+                mc = RemoteManagerClient(manager.address)
+                job = await mc.create_job(
+                    "preheat", {"urls": []}, scheduler_cluster_ids=[link.cluster_id]
+                )
+                for _ in range(50):
+                    st = await mc.job_state(job["id"])
+                    if st["state"] in ("SUCCESS", "FAILURE"):
+                        break
+                    await asyncio.sleep(0.1)
+                assert st["state"] == "FAILURE"
+                await mc.close()
+            finally:
+                await link.stop()
+                await manager.stop()
+        finally:
+            await seed_rpc.stop()
+            await seed.stop()
+            await runner.cleanup()
+
+    run(body())
