@@ -180,6 +180,8 @@ class PeerTaskConductor:
 
     async def _run_inner(self) -> TaskStorage:
         reg = await self.scheduler.register_peer(self.peer_id, self.meta, self.host)
+        if getattr(reg, "error", ""):
+            raise IOError(f"task {self.meta.task_id}: registration refused: {reg.error}")
         self.ts = self.storage.register_task(
             self.meta.task_id,
             url=self.meta.url,

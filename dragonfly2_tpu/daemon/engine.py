@@ -45,6 +45,15 @@ class InProcessSchedulerClient:
             peer_id, piece_index, success=success, cost_ms=cost_ms, parent_id=parent_id
         )
 
+    async def report_pieces(self, peer_id, piece_indices, *, cost_ms=0.0):
+        self._svc.report_pieces(peer_id, list(piece_indices), cost_ms=cost_ms)
+
+    async def announce_task(self, peer_id, meta, host, *, content_length, piece_size, piece_indices, digest=""):
+        self._svc.announce_task(
+            peer_id, meta, host, content_length=content_length,
+            piece_size=piece_size, piece_indices=list(piece_indices), digest=digest,
+        )
+
     async def report_peer_result(self, peer_id, *, success, bandwidth_bps=0.0):
         self._svc.report_peer_result(peer_id, success=success, bandwidth_bps=bandwidth_bps)
 
@@ -218,16 +227,14 @@ class PeerEngine:
                     await ts.write_piece(idx, chunk)
             ts.mark_done()
 
-        # announce so the scheduler adds this peer as a ready parent
+        # announce possession so the scheduler adds this peer as a ready
+        # parent — one RPC, no scheduling round (ref AnnounceTask)
         peer_id = idgen.peer_id(self.ip, self.hostname)
-        await self.scheduler.register_peer(peer_id, meta, self.host_info())
-        await self.scheduler.report_task_metadata(
-            meta.task_id, content_length=size,
-            piece_size=ts.meta.piece_size, digest=dig,
+        await self.scheduler.announce_task(
+            peer_id, meta, self.host_info(),
+            content_length=size, piece_size=ts.meta.piece_size,
+            piece_indices=list(range(ts.meta.total_pieces)), digest=dig,
         )
-        for idx in range(ts.meta.total_pieces):
-            await self.scheduler.report_piece_result(peer_id, idx, success=True)
-        await self.scheduler.report_peer_result(peer_id, success=True)
         return ts
 
     async def seed_task(self, task) -> None:

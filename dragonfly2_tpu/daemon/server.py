@@ -154,7 +154,10 @@ async def run_daemon(
     print(f"DAEMON_READY {sock_path} {engine.upload.port}", flush=True)
 
     manager = None
-    if manager_addr:
+    if manager_addr and host_type == "seed":
+        # only seed peers register with the manager (normal peers are known to
+        # their scheduler via announce; ref client keepalive is daemon→manager
+        # only for seed address books)
         from dragonfly2_tpu.rpc.manager import RemoteManagerClient
 
         manager = RemoteManagerClient(manager_addr)

@@ -24,6 +24,8 @@ SCHEDULER_METHODS = [
     "register_peer",
     "report_task_metadata",
     "report_piece_result",
+    "report_pieces",
+    "announce_task",
     "report_peer_result",
     "reschedule",
     "leave_peer",
@@ -71,6 +73,20 @@ class SchedulerRpcAdapter:
             success=p["success"],
             cost_ms=p.get("cost_ms", 0.0),
             parent_id=p.get("parent_id", ""),
+        )
+
+    async def report_pieces(self, p: dict) -> None:
+        self.svc.report_pieces(p["peer_id"], p["piece_indices"], cost_ms=p.get("cost_ms", 0.0))
+
+    async def announce_task(self, p: dict) -> None:
+        self.svc.announce_task(
+            p["peer_id"],
+            TaskMeta(**{**p["meta"], "filters": tuple(p["meta"].get("filters", ()))}),
+            HostInfo(**p["host"]),
+            content_length=p["content_length"],
+            piece_size=p["piece_size"],
+            piece_indices=p["piece_indices"],
+            digest=p.get("digest", ""),
         )
 
     async def report_peer_result(self, p: dict) -> None:
@@ -122,6 +138,20 @@ class RemoteSchedulerClient:
             "report_piece_result",
             {"peer_id": peer_id, "piece_index": piece_index, "success": success,
              "cost_ms": cost_ms, "parent_id": parent_id},
+        )
+
+    async def report_pieces(self, peer_id, piece_indices, *, cost_ms=0.0):
+        await self._rpc.call(
+            "report_pieces",
+            {"peer_id": peer_id, "piece_indices": list(piece_indices), "cost_ms": cost_ms},
+        )
+
+    async def announce_task(self, peer_id, meta, host, *, content_length, piece_size, piece_indices, digest=""):
+        await self._rpc.call(
+            "announce_task",
+            {"peer_id": peer_id, "meta": asdict(meta), "host": asdict(host),
+             "content_length": content_length, "piece_size": piece_size,
+             "piece_indices": list(piece_indices), "digest": digest},
         )
 
     async def report_peer_result(self, peer_id, *, success, bandwidth_bps=0.0):

@@ -1,0 +1,88 @@
+"""Scheduler announcer: periodic telemetry upload to the trainer.
+
+Reference equivalent: scheduler/announcer/announcer.go:124-259 — a ticker
+(default every 7 days, 1 h timeout, config/constants.go:183-190) opens one
+Train stream and uploads the download CSV as TrainMLPRequest chunks and the
+topology CSV as TrainGNNRequest chunks. Here: one train_open session per
+cycle, columnar arrays chunked by row count, then train_close kicks training.
+(The manager-keepalive half of the reference announcer lives in
+scheduler.manager_link.)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+import numpy as np
+
+from dragonfly2_tpu.rpc.trainer import RemoteTrainerClient
+from dragonfly2_tpu.telemetry import TelemetryStorage
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_INTERVAL = 7 * 24 * 3600.0  # ref DefaultTrainerInterval
+UPLOAD_TIMEOUT = 3600.0             # ref DefaultTrainerUploadTimeout
+CHUNK_ROWS = 4096
+
+
+class TrainerAnnouncer:
+    def __init__(
+        self,
+        telemetry: TelemetryStorage,
+        trainer_addr: str,
+        *,
+        hostname: str = "",
+        scheduler_id: int = 0,
+        interval: float = DEFAULT_INTERVAL,
+        clear_after_upload: bool = True,
+    ):
+        self.telemetry = telemetry
+        self.trainer = RemoteTrainerClient(trainer_addr)
+        self.hostname = hostname
+        self.scheduler_id = scheduler_id
+        self.interval = interval
+        self.clear_after_upload = clear_after_upload
+        self._task: asyncio.Task | None = None
+        self.uploads = 0
+
+    async def upload_once(self) -> dict:
+        """One full cycle: open session, chunk both stores, close."""
+        downloads = self.telemetry.downloads.load_all()
+        probes = self.telemetry.probes.load_all()
+        token = await self.trainer.train_open(self.hostname, self.scheduler_id)
+        rows = 0
+        for kind, arr in (("downloads", downloads), ("probes", probes)):
+            for start in range(0, len(arr), CHUNK_ROWS):
+                rows = await self.trainer.train_chunk(
+                    token, kind, arr[start : start + CHUNK_ROWS]
+                )
+        await self.trainer.train_close(token)
+        if self.clear_after_upload:
+            # dataset handed off; rotation backups served their checkpoint role
+            self.telemetry.clear()
+        self.uploads += 1
+        logger.info("uploaded %d telemetry rows to trainer", rows)
+        return {"rows": rows, "downloads": len(downloads), "probes": len(probes)}
+
+    def start(self) -> None:
+        if self._task is None:
+            self._task = asyncio.ensure_future(self._run())
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval)
+            try:
+                await asyncio.wait_for(self.upload_once(), UPLOAD_TIMEOUT)
+            except Exception as e:
+                logger.warning("trainer upload failed: %s", e)
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        await self.trainer.close()

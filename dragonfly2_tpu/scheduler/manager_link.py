@@ -118,6 +118,7 @@ class ManagerLink:
         cache_path: str | None = None,
         keepalive_interval: float = 20.0,
         dynconfig_interval: float = 60.0,
+        model_watch_interval: float = 60.0,
     ):
         self.service = service
         self.manager = RemoteManagerClient(manager_addr)
@@ -127,6 +128,8 @@ class ManagerLink:
         self.idc = idc
         self.location = location
         self.keepalive_interval = keepalive_interval
+        self.model_watch_interval = model_watch_interval
+        self._active_model_version: str | None = None
         self.scheduler_id: int | None = None
         self.cluster_id: int | None = None
         self.seed_connector = SeedPeerConnector(service)
@@ -163,6 +166,14 @@ class ManagerLink:
             asyncio.ensure_future(self._keepalive_loop()),
             asyncio.ensure_future(self._job_loop()),
         ]
+        if hasattr(self.service.evaluator, "attach_scorer"):
+            try:
+                await self._check_model()  # pick up an existing model at boot
+            except Exception as e:
+                # best-effort: a bad artifact or RPC blip must not fail start()
+                # after the background loops are already running
+                logger.warning("boot-time model check failed: %s", e)
+            self._tasks.append(asyncio.ensure_future(self._model_watch_loop()))
         logger.info(
             "manager link up: scheduler_id=%s cluster_id=%s", self.scheduler_id, self.cluster_id
         )
@@ -195,14 +206,21 @@ class ManagerLink:
         if item.get("type") == "preheat":
             urls = args.get("urls") or []
             done, failed = 0, []
+            # PREHEAT_TIMEOUT covers the WHOLE job (ref 20 min per preheat
+            # handler) and must finish inside the manager's job lease, or the
+            # lease reaper requeues it and every layer re-seeds from origin.
+            deadline = asyncio.get_running_loop().time() + PREHEAT_TIMEOUT
             for url in urls:
+                remaining = deadline - asyncio.get_running_loop().time()
+                if remaining <= 0:
+                    failed.append({"url": url, "error": "preheat job budget exhausted"})
+                    continue
                 try:
-                    # trigger() owns the PREHEAT_TIMEOUT budget and splits it
-                    # across seed candidates for failover
                     await self.seed_connector.trigger(
                         url, tag=args.get("tag", ""),
                         filters=tuple(args.get("filters", ())),
                         headers=args.get("headers") or None,
+                        timeout=remaining,
                     )
                     done += 1
                 except Exception as e:
@@ -221,6 +239,42 @@ class ManagerLink:
             )
         except Exception as e:
             logger.warning("job completion report failed: %s", e)
+
+    async def _model_watch_loop(self) -> None:
+        """Hot-swap the ml evaluator's scorer when the trainer activates a new
+        GNN version in the registry (closes the reference's unfinished
+        telemetry→train→register→infer loop, SURVEY.md §3.4)."""
+        while True:
+            await asyncio.sleep(self.model_watch_interval)
+            try:
+                await self._check_model()
+            except Exception as e:
+                logger.warning("model watch failed: %s", e)
+
+    async def _check_model(self) -> None:
+        row = await self.manager.active_model("gnn", self.scheduler_id or 0)
+        if row is None or row["version"] == self._active_model_version:
+            return
+        path = row.get("artifact_path", "")
+        try:
+            scorer, node_index = await asyncio.to_thread(self._load_scorer, path)
+        except FileNotFoundError:
+            logger.warning("active model %s artifact missing at %r", row["version"], path)
+            return
+        self.service.evaluator.attach_scorer(scorer, node_index)
+        self._active_model_version = row["version"]
+        logger.info("ml evaluator upgraded to model %s (%d hosts)", row["version"], len(node_index))
+
+    @staticmethod
+    def _load_scorer(path: str):
+        from dragonfly2_tpu.models.scorer import GNNScorer
+        from dragonfly2_tpu.trainer import artifacts
+
+        model, params = artifacts.load_gnn(path)
+        graph, host_index = artifacts.load_graph(path)
+        scorer = GNNScorer(model, params)
+        scorer.refresh(graph)
+        return scorer, host_index
 
     async def stop(self) -> None:
         for t in self._tasks:

@@ -28,6 +28,8 @@ async def run_scheduler(
     evaluator: str = "base",
     gc_interval: float = 10.0,
     manager_addr: str | None = None,
+    trainer_addr: str | None = None,
+    trainer_interval: float | None = None,
     hostname: str = "",
     idc: str = "",
     location: str = "",
@@ -54,9 +56,25 @@ async def run_scheduler(
             await link.start()
         except Exception:
             # Scheduler still serves its cluster when the manager is down
-            # (ref: dynconfig disk cache exists for the same reason).
+            # (ref: dynconfig disk cache exists for the same reason). Tear the
+            # half-started link down so no background loops leak.
             logger.exception("manager link failed to start; continuing standalone")
+            try:
+                await link.stop()
+            except Exception:
+                pass
             link = None
+    announcer = None
+    if trainer_addr and telemetry is not None:
+        from dragonfly2_tpu.scheduler.announcer import DEFAULT_INTERVAL, TrainerAnnouncer
+
+        announcer = TrainerAnnouncer(
+            telemetry, trainer_addr,
+            hostname=hostname,
+            scheduler_id=(link.scheduler_id or 0) if link else 0,
+            interval=trainer_interval or DEFAULT_INTERVAL,
+        )
+        announcer.start()
     print(f"SCHEDULER_READY {server.address}", flush=True)
 
     gc = GC()
@@ -66,6 +84,8 @@ async def run_scheduler(
         await run_until_signalled(ready_event)
     finally:
         gc.stop()
+        if announcer is not None:
+            await announcer.stop()
         if link is not None:
             await link.stop()
         if telemetry:
@@ -86,6 +106,9 @@ def main() -> None:
     ap.add_argument("--telemetry-dir", default=None)
     ap.add_argument("--evaluator", default="base", choices=["base", "ml"])
     ap.add_argument("--manager", default=None, help="manager address host:port")
+    ap.add_argument("--trainer", default=None, help="trainer address host:port")
+    ap.add_argument("--trainer-interval", type=float, default=None,
+                    help="telemetry upload cadence in seconds (default 7 days)")
     ap.add_argument("--hostname", default="")
     ap.add_argument("--idc", default="")
     ap.add_argument("--location", default="")
@@ -102,6 +125,8 @@ def main() -> None:
             telemetry_dir=args.telemetry_dir,
             evaluator=args.evaluator,
             manager_addr=args.manager,
+            trainer_addr=args.trainer,
+            trainer_interval=args.trainer_interval,
             hostname=args.hostname,
             idc=args.idc,
             location=args.location,

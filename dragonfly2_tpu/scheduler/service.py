@@ -88,6 +88,7 @@ class RegisterResult:
     piece_size: int | None = None
     total_pieces: int | None = None
     digest: str = ""
+    error: str = ""  # non-empty: registration refused (e.g. cache gone)
 
 
 class SchedulerService:
@@ -145,12 +146,21 @@ class SchedulerService:
         # Unstarted task: hand it to a seed peer if we have one, else this
         # peer goes back-to-source (ref downloadTaskBySeedPeer, :1134).
         if not task.has_available_peer(blocklist={peer.id}):
+            if task.url.startswith("d7y://"):
+                # cache imports have no origin: with every holder gone there
+                # is nothing to go back to — refuse cleanly instead of
+                # pointing the peer at an unfetchable scheme
+                ensure_received()
+                if peer.fsm.can("fail"):
+                    peer.fsm.fire("fail")
+                return RegisterResult(
+                    scope=SizeScope.UNKNOWN.value, task_id=task.id,
+                    error="cache content unavailable: no peer holds this task",
+                )
             if (
                 self.seed_trigger is not None
                 and task.id not in self._seed_triggered
                 and host.type != HostType.SEED
-                # cache imports (d7y scheme) have no origin to seed from
-                and not task.url.startswith("d7y://")
             ):
                 self._seed_triggered.add(task.id)
                 asyncio.ensure_future(self._run_seed_trigger(task))
@@ -258,6 +268,62 @@ class SchedulerService:
                 if parent is not None:
                     parent.host.upload_failed_count += 1
                 peer.block_parents.add(parent_id)
+
+    def announce_task(
+        self,
+        peer_id: str,
+        meta: TaskMeta,
+        host_info: HostInfo,
+        *,
+        content_length: int,
+        piece_size: int,
+        piece_indices: list[int],
+        digest: str = "",
+    ) -> None:
+        """A peer announces it already HOLDS task content (ref AnnounceTask,
+        scheduler/service/service_v1.go — the dfcache import path): create the
+        resource rows, set metadata, mark pieces finished, and drive the peer
+        FSM straight to Succeeded so it serves as a parent. One RPC, no
+        scheduling round."""
+        host = self.pool.load_or_create_host(
+            host_info.id, host_info.ip, host_info.hostname,
+            port=host_info.port, download_port=host_info.download_port,
+            host_type=HostType(host_info.type), idc=host_info.idc,
+            location=host_info.location,
+        )
+        task = self.pool.load_or_create_task(
+            meta.task_id, meta.url, digest=meta.digest or digest,
+            tag=meta.tag, application=meta.application, filters=tuple(meta.filters),
+        )
+        task.set_metadata(content_length, piece_size)
+        if digest:
+            task.digest = digest
+        if task.fsm.can("download"):
+            task.fsm.fire("download")
+        peer = self.pool.create_peer(peer_id, task, host)
+        for ev in ("register", "download"):
+            if peer.fsm.can(ev):
+                peer.fsm.fire(ev)
+        for idx in piece_indices:
+            peer.finished_pieces.set(idx)
+        if peer.fsm.can("succeed"):
+            peer.fsm.fire("succeed")
+        if task.fsm.can("succeed"):
+            task.fsm.fire("succeed")
+
+    def report_pieces(self, peer_id: str, piece_indices: list[int], *, cost_ms: float = 0.0) -> None:
+        """Bulk success report: one call for N pieces (import/announce-task
+        path — O(pieces) RPC round trips otherwise)."""
+        peer = self.pool.peer(peer_id)
+        if peer is None:
+            return
+        peer.touch()
+        if piece_indices and peer.fsm.can("download"):
+            peer.fsm.fire("download")
+        for idx in piece_indices:
+            peer.finished_pieces.set(idx)
+        if cost_ms:
+            peer.add_piece_cost(cost_ms)
 
     async def reschedule(self, peer_id: str) -> RegisterResult:
         """Child lost its parents; run another round (ref reschedule path)."""

@@ -1,0 +1,103 @@
+"""Model artifact (de)serialization for the registry.
+
+One artifact = one directory: params.msgpack (flax serialized pytree) +
+config.json (model hyperparameters + type + version). The manager's model
+registry rows point at these via artifact_path (manager/models/model.go:28-45
+kept evaluation metrics in the DB and the artifact elsewhere; same split).
+The scheduler's ml evaluator loads an artifact straight into a scorer.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any
+
+import flax.serialization
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dragonfly2_tpu.models.graphsage import TopoScorer
+from dragonfly2_tpu.models.mlp import BandwidthMLP
+
+
+def save_artifact(
+    directory: str | Path, *, model_type: str, version: str, params: Any, config: dict
+) -> Path:
+    d = Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    (d / "params.msgpack").write_bytes(flax.serialization.to_bytes(params))
+    (d / "config.json").write_text(
+        json.dumps({"type": model_type, "version": version, **config})
+    )
+    return d
+
+
+def load_config(directory: str | Path) -> dict:
+    return json.loads((Path(directory) / "config.json").read_text())
+
+
+def load_gnn(directory: str | Path) -> tuple[TopoScorer, Any]:
+    cfg = load_config(directory)
+    assert cfg["type"] == "gnn", cfg
+    model = TopoScorer(
+        hidden=cfg["hidden"], embed_dim=cfg["embed_dim"], num_layers=cfg["num_layers"]
+    )
+    from dragonfly2_tpu.models.features import FEATURE_DIM, NODE_FEATURE_DIM
+    from dragonfly2_tpu.models.graphsage import TopoGraph
+    from dragonfly2_tpu.trainer.synthetic import EDGE_FEATURE_DIM
+
+    # template pytree with the right structure for from_bytes
+    g = TopoGraph(
+        jnp.zeros((8, NODE_FEATURE_DIM)), jnp.zeros((8, 4), jnp.int32),
+        jnp.zeros((8, 4)), jnp.zeros((8, 4, EDGE_FEATURE_DIM)),
+    )
+    template = model.init(
+        jax.random.PRNGKey(0), g, jnp.zeros((2,), jnp.int32),
+        jnp.zeros((2,), jnp.int32), jnp.zeros((2, FEATURE_DIM)),
+    )
+    params = flax.serialization.from_bytes(
+        template, (Path(directory) / "params.msgpack").read_bytes()
+    )
+    return model, params
+
+
+def save_graph(directory: str | Path, graph: Any, host_index: dict[bytes, int]) -> None:
+    """Snapshot the topology graph + host→row mapping beside the GNN params —
+    the scheduler's ml evaluator needs both to refresh scorer embeddings and
+    translate live host ids into graph rows."""
+    d = Path(directory)
+    np.savez_compressed(
+        d / "graph.npz",
+        node_feats=np.asarray(graph.node_feats),
+        neighbors=np.asarray(graph.neighbors),
+        mask=np.asarray(graph.mask),
+        edge_feats=np.asarray(graph.edge_feats),
+    )
+    (d / "hosts.json").write_text(
+        json.dumps({k.decode("utf-8", "replace"): v for k, v in host_index.items()})
+    )
+
+
+def load_graph(directory: str | Path) -> tuple[Any, dict[str, int]]:
+    from dragonfly2_tpu.models.graphsage import TopoGraph
+
+    d = Path(directory)
+    z = np.load(d / "graph.npz")
+    graph = TopoGraph(z["node_feats"], z["neighbors"], z["mask"], z["edge_feats"])
+    host_index = json.loads((d / "hosts.json").read_text())
+    return graph, {k: int(v) for k, v in host_index.items()}
+
+
+def load_mlp(directory: str | Path) -> tuple[BandwidthMLP, Any]:
+    cfg = load_config(directory)
+    assert cfg["type"] == "mlp", cfg
+    model = BandwidthMLP(hidden=tuple(cfg["hidden"]))
+    from dragonfly2_tpu.models.features import FEATURE_DIM
+
+    template = model.init(jax.random.PRNGKey(0), jnp.zeros((2, FEATURE_DIM)))
+    params = flax.serialization.from_bytes(
+        template, (Path(directory) / "params.msgpack").read_bytes()
+    )
+    return model, params

@@ -1,0 +1,73 @@
+"""Trainer process entry (the service the reference left as config+metrics).
+
+`python -m dragonfly2_tpu.trainer.server --port 9300 --manager 127.0.0.1:9200
+--model-dir /var/lib/df/models`
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+
+from dragonfly2_tpu.rpc.core import RpcServer
+from dragonfly2_tpu.rpc.trainer import register_trainer
+from dragonfly2_tpu.trainer.service import TrainerConfig, TrainerService
+from dragonfly2_tpu.utils.proc import run_until_signalled
+
+logger = logging.getLogger("trainer")
+
+
+async def run_trainer(
+    *,
+    host: str = "127.0.0.1",
+    port: int = 9300,
+    model_dir: str = "/tmp/dragonfly2_tpu_models",
+    manager_addr: str | None = None,
+    gnn_steps: int = 300,
+    ready_event: asyncio.Event | None = None,
+) -> None:
+    manager = None
+    if manager_addr:
+        from dragonfly2_tpu.rpc.manager import RemoteManagerClient
+
+        manager = RemoteManagerClient(manager_addr)
+    service = TrainerService(
+        TrainerConfig(model_dir=model_dir, gnn_steps=gnn_steps), manager=manager
+    )
+    server = RpcServer(host=host, port=port)
+    register_trainer(server, service)
+    await server.start()
+    logger.info("trainer listening on %s", server.address)
+    print(f"TRAINER_READY {server.address}", flush=True)
+    try:
+        await run_until_signalled(ready_event)
+    finally:
+        await server.stop()
+        if manager is not None:
+            await manager.close()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description="dragonfly2_tpu trainer")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=9300)
+    ap.add_argument("--model-dir", default="/tmp/dragonfly2_tpu_models")
+    ap.add_argument("--manager", default=None)
+    ap.add_argument("--gnn-steps", type=int, default=300)
+    ap.add_argument("-v", "--verbose", action="store_true")
+    args = ap.parse_args()
+    logging.basicConfig(
+        level=logging.DEBUG if args.verbose else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    asyncio.run(
+        run_trainer(
+            host=args.host, port=args.port, model_dir=args.model_dir,
+            manager_addr=args.manager, gnn_steps=args.gnn_steps,
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

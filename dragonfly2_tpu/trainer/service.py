@@ -1,0 +1,214 @@
+"""Trainer service: receives telemetry datasets, trains, registers models.
+
+Completes the reference's unfinished ML loop (SURVEY.md §3.4): the reference
+defined the Train client-stream contract (pkg/rpc/trainer/server/server.go:59,
+TrainMLPRequest/TrainGNNRequest chunks) and a trainer/ skeleton with config +
+metrics but no training loop, and the manager's CreateModel was a TODO stub
+(manager/rpcserver/manager_server_v2.go:739-743). Here:
+
+  train_open → train_chunk* → train_close   (the client-stream, unrolled over
+  our unary RPC; chunks are npz-serialized columnar telemetry arrays)
+
+then a background task builds the dataset (trainer.dataset), trains the MLP
+bandwidth predictor (config 1) and — when probe records exist — the GraphSAGE
+topology scorer (config 2/3, sharded over whatever mesh is live), writes
+artifacts, and registers + activates versions in the manager's model registry.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+import logging
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Optional
+
+import numpy as np
+
+from dragonfly2_tpu.trainer import artifacts, dataset as datasetlib, train_gnn, train_mlp
+
+logger = logging.getLogger(__name__)
+
+
+def pack_records(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return buf.getvalue()
+
+
+def unpack_records(data: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+@dataclass
+class TrainSession:
+    token: str
+    scheduler_hostname: str = ""
+    scheduler_id: int = 0
+    downloads: list[np.ndarray] = field(default_factory=list)
+    probes: list[np.ndarray] = field(default_factory=list)
+    opened_at: float = field(default_factory=time.time)
+
+
+@dataclass
+class TrainerConfig:
+    model_dir: str = "/tmp/dragonfly2_tpu_models"
+    mlp: train_mlp.MLPTrainConfig = field(default_factory=train_mlp.MLPTrainConfig)
+    gnn: train_gnn.GNNTrainConfig = field(default_factory=train_gnn.GNNTrainConfig)
+    gnn_steps: int = 300
+    min_pairs: int = 16        # skip training below this much signal
+    min_probe_rows: int = 8
+    # Rolling dataset pool: sessions accumulate (newest kept up to the cap) so
+    # schedulers on short upload cadences still reach training mass; 0 = train
+    # strictly on each upload in isolation.
+    pool_rows: int = 500_000
+
+
+class TrainerService:
+    def __init__(self, config: TrainerConfig | None = None, *, manager: Any = None):
+        """manager: RemoteManagerClient (or None to skip registry)."""
+        self.cfg = config or TrainerConfig()
+        self.manager = manager
+        self._pool_downloads: list[np.ndarray] = []
+        self._pool_probes: list[np.ndarray] = []
+        self._sessions: dict[str, TrainSession] = {}
+        self._next = 0
+        self._training: asyncio.Task | None = None
+        self.last_result: dict | None = None
+        self.trains_started = 0
+        self.trains_succeeded = 0
+
+    # ---- RPC surface (adapter passes payload dicts straight through) ----
+
+    async def train_open(self, p: dict) -> dict:
+        self._next += 1
+        token = f"sess-{self._next}-{int(time.time())}"
+        self._sessions[token] = TrainSession(
+            token,
+            scheduler_hostname=p.get("hostname", ""),
+            scheduler_id=p.get("scheduler_id", 0),
+        )
+        return {"token": token}
+
+    async def train_chunk(self, p: dict) -> dict:
+        sess = self._sessions.get(p["token"])
+        if sess is None:
+            raise KeyError(f"unknown train session {p['token']!r}")
+        arr = unpack_records(p["data"])
+        if p["kind"] == "downloads":
+            sess.downloads.append(arr)
+        elif p["kind"] == "probes":
+            sess.probes.append(arr)
+        else:
+            raise ValueError(f"unknown dataset kind {p['kind']!r}")
+        return {"rows": int(sum(len(a) for a in sess.downloads + sess.probes))}
+
+    async def train_close(self, p: dict) -> dict:
+        sess = self._sessions.pop(p["token"], None)
+        if sess is None:
+            raise KeyError(f"unknown train session {p['token']!r}")
+        if self._training is not None and not self._training.done():
+            # one training run at a time; a second upload queues behind it
+            await self._training
+        self.trains_started += 1
+        self._training = asyncio.ensure_future(self._train(sess))
+        return {"queued": True}
+
+    async def status(self, p: Any = None) -> dict:
+        running = self._training is not None and not self._training.done()
+        return {
+            "training": running,
+            "trains_started": self.trains_started,
+            "trains_succeeded": self.trains_succeeded,
+            "last_result": self.last_result,
+        }
+
+    async def wait_idle(self) -> None:
+        if self._training is not None:
+            await self._training
+
+    # ---- training driver ----
+
+    async def _train(self, sess: TrainSession) -> None:
+        try:
+            result = await asyncio.to_thread(self._train_sync, sess)
+            self.last_result = result
+            self.trains_succeeded += 1
+            if self.manager is not None:
+                await self._register_models(sess, result)
+        except Exception:
+            logger.exception("training run failed")
+            self.last_result = {"error": "training failed"}
+
+    def _pool_add(self, pool: list[np.ndarray], arrays: list[np.ndarray]) -> np.ndarray:
+        pool.extend(a for a in arrays if len(a))
+        total = sum(len(a) for a in pool)
+        while len(pool) > 1 and total - len(pool[0]) >= self.cfg.pool_rows:
+            total -= len(pool.pop(0))  # evict oldest sessions beyond the cap
+        return np.concatenate(pool) if pool else np.zeros(0)
+
+    def _train_sync(self, sess: TrainSession) -> dict:
+        if self.cfg.pool_rows > 0:
+            downloads = self._pool_add(self._pool_downloads, sess.downloads)
+            probes = self._pool_add(self._pool_probes, sess.probes)
+        else:
+            downloads = np.concatenate(sess.downloads) if sess.downloads else np.zeros(0)
+            probes = np.concatenate(sess.probes) if sess.probes else np.zeros(0)
+        ds = datasetlib.build_dataset(downloads, probes)
+        version = f"v{int(time.time())}"
+        out: dict[str, Any] = {"version": version, "num_pairs": ds.num_pairs, "num_nodes": ds.num_nodes}
+
+        if ds.num_pairs >= self.cfg.min_pairs:
+            tr, ev = datasetlib.split_pairs(ds.pairs)
+            t0 = time.perf_counter()
+            params, evaluation = train_mlp.train(self.cfg.mlp, tr, eval_pairs=ev, log=logger.info)
+            evaluation["train_seconds"] = round(time.perf_counter() - t0, 2)
+            path = artifacts.save_artifact(
+                Path(self.cfg.model_dir) / f"mlp-{version}",
+                model_type="mlp", version=version, params=params,
+                config={"hidden": list(self.cfg.mlp.hidden)},
+            )
+            out["mlp"] = {"artifact": str(path), "evaluation": evaluation}
+
+        if ds.num_pairs >= self.cfg.min_pairs and len(probes) >= self.cfg.min_probe_rows:
+            cfg = self.cfg.gnn
+            t0 = time.perf_counter()
+            state, losses = train_gnn.train(
+                cfg, ds.graph, ds.pairs, steps=self.cfg.gnn_steps, log=logger.info
+            )
+            evaluation = {
+                "final_loss": losses[-1] if losses else float("nan"),
+                "steps": self.cfg.gnn_steps,
+                "train_seconds": round(time.perf_counter() - t0, 2),
+                "steps_per_sec": round(self.cfg.gnn_steps / max(1e-9, time.perf_counter() - t0), 2),
+            }
+            path = artifacts.save_artifact(
+                Path(self.cfg.model_dir) / f"gnn-{version}",
+                model_type="gnn", version=version, params=state.params,
+                config={
+                    "hidden": cfg.hidden, "embed_dim": cfg.embed_dim,
+                    "num_layers": cfg.num_layers,
+                },
+            )
+            artifacts.save_graph(path, ds.graph, ds.host_index)
+            out["gnn"] = {"artifact": str(path), "evaluation": evaluation}
+        return out
+
+    async def _register_models(self, sess: TrainSession, result: dict) -> None:
+        """Finish the reference's CreateModel stub: version rows + activation."""
+        for mtype in ("mlp", "gnn"):
+            info = result.get(mtype)
+            if not info:
+                continue
+            try:
+                row = await self.manager.create_model(
+                    mtype, result["version"],
+                    scheduler_id=sess.scheduler_id,
+                    evaluation=info["evaluation"],
+                    artifact_path=info["artifact"],
+                )
+                await self.manager.activate_model(row["id"])
+            except Exception:
+                logger.exception("model registry update failed for %s", mtype)

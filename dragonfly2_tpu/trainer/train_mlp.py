@@ -1,0 +1,75 @@
+"""MLP bandwidth-predictor training (north-star config 1).
+
+Trains models.mlp.BandwidthMLP on (child, parent) pair features from the
+scheduler's download records — the path the reference sketched as
+TrainMLPRequest CSV chunks (scheduler/announcer/announcer.go:193) into a
+trainer that was never written. Single-host JAX (CPU or one chip): the model
+is tiny; data parallelism buys nothing here, so no mesh.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from dragonfly2_tpu.models.mlp import BandwidthMLP
+from dragonfly2_tpu.trainer.synthetic import PairBatch
+
+
+@dataclass
+class MLPTrainConfig:
+    hidden: tuple[int, ...] = (256, 256, 128)
+    batch_size: int = 4096
+    learning_rate: float = 1e-3
+    steps: int = 500
+
+
+def make_model(cfg: MLPTrainConfig) -> BandwidthMLP:
+    return BandwidthMLP(hidden=tuple(cfg.hidden))
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _train_step(model: BandwidthMLP, tx: Any, params: Any, opt_state: Any, x: jnp.ndarray, y: jnp.ndarray):
+    def loss_fn(p):
+        pred = model.apply(p, x)
+        return jnp.mean((pred - y) ** 2)
+
+    loss, grads = jax.value_and_grad(loss_fn)(params)
+    updates, opt_state = tx.update(grads, opt_state, params)
+    return optax.apply_updates(params, updates), opt_state, loss
+
+
+def train(
+    cfg: MLPTrainConfig,
+    pairs: PairBatch,
+    *,
+    eval_pairs: PairBatch | None = None,
+    seed: int = 0,
+    log: Callable[[str], None] = lambda s: None,
+) -> tuple[Any, dict[str, float]]:
+    """Returns (params, evaluation dict with train/eval mse)."""
+    model = make_model(cfg)
+    rng = np.random.default_rng(seed)
+    params = model.init(jax.random.PRNGKey(seed), jnp.zeros((8, pairs.feats.shape[1])))
+    tx = optax.adam(cfg.learning_rate)
+    opt_state = tx.init(params)
+    n = len(pairs.child)
+    loss = jnp.zeros(())
+    for i in range(cfg.steps):
+        idx = rng.integers(0, n, size=min(cfg.batch_size, n))
+        x = jnp.asarray(pairs.feats[idx])
+        y = jnp.asarray(pairs.label[idx])
+        params, opt_state, loss = _train_step(model, tx, params, opt_state, x, y)
+        if (i + 1) % 100 == 0:
+            log(f"mlp step {i + 1}/{cfg.steps} loss={float(loss):.5f}")
+    evaluation = {"train_mse": float(loss)}
+    if eval_pairs is not None and len(eval_pairs.child):
+        pred = model.apply(params, jnp.asarray(eval_pairs.feats))
+        evaluation["eval_mse"] = float(jnp.mean((pred - jnp.asarray(eval_pairs.label)) ** 2))
+    return params, evaluation

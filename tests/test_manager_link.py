@@ -246,3 +246,18 @@ def test_preheat_forwards_headers_and_empty_urls_fail(run, tmp_path):
             await runner.cleanup()
 
     run(body())
+
+
+def test_cache_task_with_no_holders_refused_cleanly(run, tmp_path):
+    async def body():
+        svc = SchedulerService()
+        client = InProcessSchedulerClient(svc)
+        downloader = make_engine(tmp_path, client, "dl")
+        await downloader.start()
+        try:
+            with pytest.raises(IOError, match="registration refused"):
+                await downloader.download_task("d7y://cache/deadbeef" + "0" * 56)
+        finally:
+            await downloader.stop()
+
+    run(body())
