@@ -119,6 +119,7 @@ async def run_daemon(
     rpc_port: int | None = None,
     manager_addr: str | None = None,
     announce_interval: float = 30.0,
+    probe_interval: float | None = None,
     ready_event: asyncio.Event | None = None,
 ) -> None:
     scheduler = RemoteSchedulerClient(scheduler_addr)
@@ -182,11 +183,18 @@ async def run_daemon(
                     logger.warning("manager keepalive failed", exc_info=True)
             await asyncio.sleep(announce_interval)
 
+    from dragonfly2_tpu.daemon.prober import DEFAULT_PROBE_INTERVAL, Prober
+
+    prober = Prober(
+        scheduler, engine.host_id, interval=probe_interval or DEFAULT_PROBE_INTERVAL
+    )
+    prober.start()
     announcer = asyncio.ensure_future(announce_loop())
     try:
         await run_until_signalled(ready_event)
     finally:
         announcer.cancel()
+        await prober.stop()
         await server.stop()
         if tcp_server is not None:
             await tcp_server.stop()
@@ -230,6 +238,8 @@ def main() -> None:
     ap.add_argument("--rpc-port", type=int, default=None,
                     help="TCP RPC port (seed peers always listen; 0 = ephemeral)")
     ap.add_argument("--manager", default=None, help="manager address host:port")
+    ap.add_argument("--probe-interval", type=float, default=None,
+                    help="RTT probe cadence in seconds (default 20 min)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
     logging.basicConfig(
@@ -249,6 +259,7 @@ def main() -> None:
             upload_port=args.upload_port,
             rpc_port=args.rpc_port,
             manager_addr=args.manager,
+            probe_interval=args.probe_interval,
         )
     )
 

@@ -31,6 +31,7 @@ SCHEDULER_METHODS = [
     "leave_peer",
     "announce_host",
     "stat_task",
+    "sync_probes",
 ]
 
 
@@ -106,6 +107,9 @@ class SchedulerRpcAdapter:
     async def stat_task(self, p: dict) -> dict | None:
         return self.svc.stat_task(p["task_id"])
 
+    async def sync_probes(self, p: dict) -> list[dict]:
+        return self.svc.sync_probes(p["host_id"], p.get("results", []))
+
 
 def serve_scheduler(service: SchedulerService, **server_kw: Any) -> RpcServer:
     server = RpcServer(**server_kw)
@@ -171,6 +175,9 @@ class RemoteSchedulerClient:
 
     async def stat_task(self, task_id: str):
         return await self._rpc.call("stat_task", {"task_id": task_id})
+
+    async def sync_probes(self, host_id: str, results: list[dict]):
+        return await self._rpc.call("sync_probes", {"host_id": host_id, "results": results})
 
     async def healthy(self) -> bool:
         return await self._rpc.healthy()

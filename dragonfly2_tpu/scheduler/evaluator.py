@@ -36,8 +36,13 @@ _SIGMA_FACTOR = 3.0
 _SMALL_SAMPLE_MEAN_FACTOR = 20.0
 
 
-def build_pair_features(child: Peer, parents: Sequence[Peer]) -> np.ndarray:
-    """Feature matrix [len(parents), FEATURE_DIM] per models.features schema."""
+def build_pair_features(
+    child: Peer, parents: Sequence[Peer], topology=None
+) -> np.ndarray:
+    """Feature matrix [len(parents), FEATURE_DIM] per models.features schema.
+
+    topology: scheduler.networktopology.NetworkTopology (or None) — fills
+    rtt_norm from live probe data."""
     n = len(parents)
     f = np.zeros((n, FEATURE_DIM), dtype=np.float32)
     task = child.task
@@ -50,7 +55,8 @@ def build_pair_features(child: Peer, parents: Sequence[Peer]) -> np.ndarray:
         f[i, 3] = 1.0 if h.type == HostType.SEED else 0.0
         f[i, 4] = 1.0 if h.idc and h.idc == child_host.idc else 0.0
         f[i, 5] = location_affinity(h.location, child_host.location)
-        f[i, 6] = 0.0  # rtt_norm — filled from network topology when present
+        rtt = topology.avg_rtt_ms(child_host.id, h.id) if topology is not None else None
+        f[i, 6] = min(rtt, 1000.0) / 1000.0 if rtt is not None else 0.0
         costs = p.piece_costs_ms
         f[i, 7] = (sum(costs) / len(costs) / 30_000.0) if costs else 0.0
         f[i, 8] = 0.0  # bandwidth history (telemetry-fed)
@@ -72,11 +78,12 @@ class Evaluator:
     """Base linear evaluator + bad-node detection. Subclass for `ml`."""
 
     name = "base"
+    topology = None  # NetworkTopology, attached by the scheduler service
 
     def evaluate(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:
         if not parents:
             return np.zeros(0, dtype=np.float32)
-        feats = build_pair_features(child, parents)
+        feats = build_pair_features(child, parents, self.topology)
         return feats @ BASE_WEIGHTS
 
     def is_bad_node(self, peer: Peer) -> bool:
@@ -123,7 +130,7 @@ class MLEvaluator(Evaluator):
         known = np.array([i is not None for i in parent_idx]) & (child_idx is not None)
         if not known.any():
             return base
-        feats = build_pair_features(child, parents)
+        feats = build_pair_features(child, parents, self.topology)
         try:
             ml = self._scorer.score(
                 feats,

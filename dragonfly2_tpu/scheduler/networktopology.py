@@ -1,0 +1,149 @@
+"""Network topology: the RTT probe graph, in memory.
+
+Finishes what the reference stubbed: its probe graph lived in Redis
+(networktopology:src:dst keys with a probe FIFO per edge,
+scheduler/networktopology/network_topology.go:38-122, probes.go:33-150), the
+`SyncProbes` RPC was `return nil` (scheduler_server_v2.go:153-156), and
+`Probes.Enqueue` was a TODO. Here:
+
+- per-(src, dst) probe FIFO (bounded deque, ref default queue length 5) with
+  avg/std/min RTT and probed counters
+- `sync_probes(...)`: daemons report a round of RTT measurements and receive
+  the next target list in the same call (the reference's intended bidi stream,
+  unrolled over unary RPC)
+- every completed round appends NetworkTopology telemetry records — the GNN's
+  edge list (storage/types.go:233 analog, normalized per-edge rows)
+
+No Redis: the topology is scheduler-local state like the resource pool; it
+GCs with host eviction and is rebuilt continuously by live probes.
+"""
+
+from __future__ import annotations
+
+import random
+import statistics
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Optional
+
+from dragonfly2_tpu.telemetry import TelemetryStorage
+
+DEFAULT_QUEUE_LENGTH = 5   # ref config DefaultProbeQueueLength
+DEFAULT_PROBE_COUNT = 10   # targets handed out per sync (ref FindProbedHosts cap)
+
+
+@dataclass
+class ProbeTarget:
+    host_id: str
+    ip: str
+    port: int  # upload (piece server) port — what daemons can reach
+
+
+class EdgeProbes:
+    """Bounded FIFO of RTT samples for one (src, dst) edge (ref probes.go)."""
+
+    __slots__ = ("rtts_ms", "probed_count", "updated_at")
+
+    def __init__(self, maxlen: int = DEFAULT_QUEUE_LENGTH):
+        self.rtts_ms: deque[float] = deque(maxlen=maxlen)
+        self.probed_count = 0
+        self.updated_at = 0.0
+
+    def enqueue(self, rtt_ms: float) -> None:
+        self.rtts_ms.append(rtt_ms)
+        self.probed_count += 1
+        self.updated_at = time.time()
+
+    @property
+    def avg_ms(self) -> float:
+        return statistics.fmean(self.rtts_ms) if self.rtts_ms else 0.0
+
+    @property
+    def std_ms(self) -> float:
+        return statistics.pstdev(self.rtts_ms) if len(self.rtts_ms) > 1 else 0.0
+
+    @property
+    def min_ms(self) -> float:
+        return min(self.rtts_ms) if self.rtts_ms else 0.0
+
+
+class NetworkTopology:
+    def __init__(
+        self,
+        *,
+        telemetry: TelemetryStorage | None = None,
+        queue_length: int = DEFAULT_QUEUE_LENGTH,
+        probe_count: int = DEFAULT_PROBE_COUNT,
+        rng: random.Random | None = None,
+    ):
+        self.telemetry = telemetry
+        self.queue_length = queue_length
+        self.probe_count = probe_count
+        self._edges: dict[tuple[str, str], EdgeProbes] = {}
+        self._rng = rng or random.Random()
+
+    # ---- store ----
+
+    def enqueue(self, src_host_id: str, dst_host_id: str, rtt_ms: float) -> None:
+        key = (src_host_id, dst_host_id)
+        edge = self._edges.get(key)
+        if edge is None:
+            edge = self._edges[key] = EdgeProbes(self.queue_length)
+        edge.enqueue(rtt_ms)
+        if self.telemetry is not None:
+            self.telemetry.probes.append(
+                src_host_id=src_host_id.encode()[:64],
+                dst_host_id=dst_host_id.encode()[:64],
+                rtt_mean_ms=edge.avg_ms,
+                rtt_std_ms=edge.std_ms,
+                rtt_min_ms=edge.min_ms,
+                probe_count=edge.probed_count,
+            )
+
+    def avg_rtt_ms(self, src_host_id: str, dst_host_id: str) -> Optional[float]:
+        """Average RTT on the directed edge; falls back to the reverse edge
+        (RTT is roughly symmetric and either end may have probed first)."""
+        edge = self._edges.get((src_host_id, dst_host_id))
+        if edge is None or not edge.rtts_ms:
+            edge = self._edges.get((dst_host_id, src_host_id))
+        return edge.avg_ms if edge is not None and edge.rtts_ms else None
+
+    def edge_count(self) -> int:
+        return len(self._edges)
+
+    def forget_host(self, host_id: str) -> int:
+        """Drop edges touching a GC'd host."""
+        dead = [k for k in self._edges if host_id in k]
+        for k in dead:
+            del self._edges[k]
+        return len(dead)
+
+    # ---- sync protocol ----
+
+    def sync_probes(
+        self, src_host_id: str, results: list[dict], hosts: dict, *,
+        exclude: set[str] | None = None,
+    ) -> list[ProbeTarget]:
+        """One round: ingest `results` ({dst_host_id, rtt_ms, success}), then
+        pick the next probe targets for this source — least-recently-probed
+        first so coverage is uniform, random tiebreak."""
+        for r in results:
+            if r.get("success", True):
+                self.enqueue(src_host_id, r["dst_host_id"], float(r["rtt_ms"]))
+        exclude = exclude or set()
+        candidates = [
+            h for hid, h in hosts.items()
+            if hid != src_host_id and hid not in exclude and h.download_port
+        ]
+        self._rng.shuffle(candidates)
+        candidates.sort(
+            key=lambda h: self._edges.get((src_host_id, h.id), _NEVER).updated_at
+        )
+        return [
+            ProbeTarget(h.id, h.ip, h.download_port)
+            for h in candidates[: self.probe_count]
+        ]
+
+
+_NEVER = EdgeProbes()  # updated_at 0.0 — sorts unprobed hosts first

@@ -101,10 +101,14 @@ class SchedulerService:
         gc_policy: GCPolicy | None = None,
         seed_trigger: Callable[[Task], Awaitable[None]] | None = None,
     ):
+        from dragonfly2_tpu.scheduler.networktopology import NetworkTopology
+
         self.pool = ResourcePool(gc_policy)
         self.evaluator = evaluator or new_evaluator("base")
         self.scheduling = Scheduling(self.evaluator, scheduling_config)
         self.telemetry = telemetry
+        self.topology = NetworkTopology(telemetry=telemetry)
+        self.evaluator.topology = self.topology  # rtt_norm feature source
         self.seed_trigger = seed_trigger
         self._seed_triggered: set[str] = set()
 
@@ -157,19 +161,24 @@ class SchedulerService:
                     scope=SizeScope.UNKNOWN.value, task_id=task.id,
                     error="cache content unavailable: no peer holds this task",
                 )
-            if (
-                self.seed_trigger is not None
-                and task.id not in self._seed_triggered
-                and host.type != HostType.SEED
-            ):
+            seed_incoming = task.id in self._seed_triggered
+            if self.seed_trigger is not None and not seed_incoming and host.type != HostType.SEED:
                 self._seed_triggered.add(task.id)
                 asyncio.ensure_future(self._run_seed_trigger(task))
-            ensure_received()
-            if peer.fsm.can("back_to_source"):
-                peer.fsm.fire("back_to_source")
-            return RegisterResult(
-                scope=SizeScope.UNKNOWN.value, task_id=task.id, back_to_source=True
-            )
+                seed_incoming = True
+            if not seed_incoming or host.type == HostType.SEED:
+                # Seed hosts fetch the origin by definition; normal peers do
+                # too when there is no seed infrastructure to wait for.
+                ensure_received()
+                if peer.fsm.can("back_to_source"):
+                    peer.fsm.fire("back_to_source")
+                return RegisterResult(
+                    scope=SizeScope.UNKNOWN.value, task_id=task.id, back_to_source=True
+                )
+            # A seed download is starting (or in flight): fall through to the
+            # NORMAL scheduling round — its retry loop waits for the seed to
+            # appear in the DAG and still escalates to back-to-source after
+            # the retry budget (ref downloadTaskBySeedPeer → schedule()).
 
         scope = task.size_scope()
         common = dict(
@@ -390,7 +399,7 @@ class SchedulerService:
             back_to_source=peer.fsm.is_(PEER_BACK_TO_SOURCE) or peer.state == PEER_SUCCEEDED and not parents,
         )
         if parents:
-            feats = build_pair_features(peer, parents)
+            feats = build_pair_features(peer, parents, self.topology)
             for p, f in zip(parents, feats):
                 self.telemetry.downloads.append(
                     parent_peer_id=p.id.encode()[:64],
@@ -441,6 +450,14 @@ class SchedulerService:
         for pid in list(host.peer_ids):
             self.leave_peer(pid)
         del self.pool.hosts[host_id]
+        self.topology.forget_host(host_id)
+
+    # ---- network topology probes (ref SyncProbes, finished here) ----
+
+    def sync_probes(self, src_host_id: str, results: list[dict]) -> list[dict]:
+        """Ingest a probe round from a daemon and hand back the next targets."""
+        targets = self.topology.sync_probes(src_host_id, results, self.pool.hosts)
+        return [{"host_id": t.host_id, "ip": t.ip, "port": t.port} for t in targets]
 
     def stat_task(self, task_id: str) -> dict[str, Any] | None:
         task = self.pool.tasks.get(task_id)

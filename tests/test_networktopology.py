@@ -1,0 +1,143 @@
+"""Network topology: probe store, sync protocol, daemon prober, RTT feature
+(finishes the reference's SyncProbes stub, scheduler_server_v2.go:153-156)."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.daemon.engine import InProcessSchedulerClient
+from dragonfly2_tpu.daemon.prober import Prober, measure_rtt_ms
+from dragonfly2_tpu.scheduler.evaluator import build_pair_features
+from dragonfly2_tpu.scheduler.networktopology import NetworkTopology
+from dragonfly2_tpu.scheduler.service import HostInfo, SchedulerService
+from dragonfly2_tpu.telemetry import TelemetryStorage
+
+from test_e2e import Origin, make_engine
+
+
+def _host(svc: SchedulerService, name: str, port: int = 0, download_port: int = 1000):
+    info = HostInfo(id=name, ip="127.0.0.1", hostname=name, port=port, download_port=download_port)
+    svc.announce_host(info)
+    return info
+
+
+def test_edge_fifo_and_stats(tmp_path):
+    store = TelemetryStorage(tmp_path)
+    topo = NetworkTopology(telemetry=store, queue_length=3)
+    for rtt in (10.0, 20.0, 30.0, 40.0):  # FIFO holds the newest 3
+        topo.enqueue("a", "b", rtt)
+    assert topo.avg_rtt_ms("a", "b") == pytest.approx(30.0)
+    # reverse-edge fallback
+    assert topo.avg_rtt_ms("b", "a") == pytest.approx(30.0)
+    assert topo.avg_rtt_ms("a", "zzz") is None
+    # telemetry: one record per enqueue with running stats
+    recs = store.probes.load_all()
+    assert len(recs) == 4
+    assert recs[-1]["probe_count"] == 4
+    assert recs[-1]["rtt_mean_ms"] == pytest.approx(30.0)
+    assert topo.forget_host("b") == 1
+    assert topo.edge_count() == 0
+
+
+def test_sync_probes_targets_least_recently_probed():
+    svc = SchedulerService()
+    for i in range(5):
+        _host(svc, f"h{i}")
+    topo = svc.topology
+    topo.probe_count = 3
+    t1 = svc.sync_probes("h0", [])
+    assert len(t1) == 3 and all(t["host_id"] != "h0" for t in t1)
+    # report results; probed edges rotate to the back on the next round
+    results = [{"dst_host_id": t["host_id"], "rtt_ms": 5.0, "success": True} for t in t1]
+    t2 = svc.sync_probes("h0", results)
+    probed = {t["host_id"] for t in t1}
+    fresh = {t["host_id"] for t in t2}
+    # the 1 never-probed host must be in the next round
+    never = {f"h{i}" for i in range(1, 5)} - probed
+    assert never <= fresh
+    assert svc.topology.edge_count() == 3
+    # failed probes are not stored
+    svc.sync_probes("h0", [{"dst_host_id": "h1", "rtt_ms": 0.0, "success": False}])
+    assert topo.avg_rtt_ms("h0", "h1") is None or topo.avg_rtt_ms("h0", "h1") > 0
+
+
+def test_rtt_flows_into_pair_features():
+    svc = SchedulerService()
+    _host(svc, "child-h")
+    _host(svc, "parent-h")
+    svc.topology.enqueue("child-h", "parent-h", 150.0)
+
+    from dragonfly2_tpu.scheduler.service import TaskMeta
+
+    async def setup():
+        reg = await svc.register_peer(
+            "peer-c", TaskMeta(task_id="t" * 64, url="http://o/f"),
+            HostInfo(id="child-h", ip="127.0.0.1", hostname="child-h"),
+        )
+        await svc.register_peer(
+            "peer-p", TaskMeta(task_id="t" * 64, url="http://o/f"),
+            HostInfo(id="parent-h", ip="127.0.0.1", hostname="parent-h"),
+        )
+
+    asyncio.run(setup())
+    child = svc.pool.peer("peer-c")
+    parent = svc.pool.peer("peer-p")
+    f = build_pair_features(child, [parent], svc.topology)
+    assert f[0, 6] == pytest.approx(0.15)  # 150ms / 1000
+    f_no = build_pair_features(child, [parent], None)
+    assert f_no[0, 6] == 0.0
+
+
+def test_measure_rtt_against_live_server(run):
+    async def body():
+        server = await asyncio.start_server(lambda r, w: w.close(), "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        try:
+            rtt = await measure_rtt_ms("127.0.0.1", port)
+            assert rtt is not None and 0 < rtt < 1000
+        finally:
+            server.close()
+            await server.wait_closed()
+        # unreachable port -> None
+        assert await measure_rtt_ms("127.0.0.1", 1) is None
+
+    run(body())
+
+
+def test_prober_end_to_end_builds_topology(run, tmp_path):
+    """Two live engines probe each other through the scheduler; the topology
+    graph and probe telemetry fill with real localhost RTTs."""
+
+    async def body():
+        store = TelemetryStorage(tmp_path / "telemetry")
+        svc = SchedulerService(telemetry=store)
+        client = InProcessSchedulerClient(svc)
+        e1 = make_engine(tmp_path, client, "n1")
+        e2 = make_engine(tmp_path, client, "n2")
+        await e1.start()
+        await e2.start()
+        try:
+            svc.announce_host(e1.host_info())
+            svc.announce_host(e2.host_info())
+            p1 = Prober(client, e1.host_id, interval=999)
+            p2 = Prober(client, e2.host_id, interval=999)
+            ok1 = await p1.probe_once()
+            ok2 = await p2.probe_once()
+            assert ok1 == 1 and ok2 == 1  # each probed the other
+            assert svc.topology.edge_count() == 2
+            rtt = svc.topology.avg_rtt_ms(e1.host_id, e2.host_id)
+            assert rtt is not None and 0 < rtt < 1000
+            recs = store.probes.load_all()
+            assert len(recs) == 2
+            assert set(map(bytes, recs["src_host_id"])) == {
+                e1.host_id.encode(), e2.host_id.encode()
+            }
+        finally:
+            await e1.stop()
+            await e2.stop()
+
+    run(body())
