@@ -309,8 +309,12 @@ class PeerTaskConductor:
         await self._write_source_piece(idx, bytes(buf), t0)
 
     async def _write_source_piece(self, idx: int, data: bytes, t0: float) -> None:
+        from dragonfly2_tpu.daemon import metrics
+
         await self.ts.write_piece(idx, data)
         self.bytes_from_source += len(data)
+        metrics.PIECE_DOWNLOAD_TOTAL.inc(source="back_to_source")
+        metrics.DOWNLOAD_BYTES.inc(len(data))
         await self.scheduler.report_piece_result(
             self.peer_id, idx, success=True, cost_ms=(time.monotonic() - t0) * 1000
         )
@@ -477,6 +481,10 @@ class PeerTaskConductor:
             return
         state.record(True, cost)
         self.bytes_from_parents += len(data)
+        from dragonfly2_tpu.daemon import metrics
+
+        metrics.PIECE_DOWNLOAD_TOTAL.inc(source="parent")
+        metrics.DOWNLOAD_BYTES.inc(len(data))
         await self.scheduler.report_piece_result(
             self.peer_id, idx, success=True, cost_ms=cost, parent_id=state.info.peer_id
         )

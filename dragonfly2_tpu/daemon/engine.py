@@ -157,8 +157,14 @@ class PeerEngine:
         **meta_kw,
     ) -> TaskStorage:
         """Download (or reuse) a task; optionally export to a named file."""
+        from dragonfly2_tpu.daemon import metrics
+        from dragonfly2_tpu.observability.tracing import default_tracer
+
         await self.start()
         meta = self.make_meta(url, **meta_kw)
+        metrics.TASK_TOTAL.inc(type="seed" if seed else "file")
+        if seed:
+            metrics.SEED_TASK_TOTAL.inc()
 
         ts = self.storage.find_completed_task(meta.task_id)
         if ts is not None and ts.verify():
@@ -180,7 +186,18 @@ class PeerEngine:
                 config=self.conductor_config,
                 headers=headers,
             )
-            ts = await conductor.run()
+            metrics.CONCURRENT_TASKS.inc()
+            try:
+                with default_tracer().span(
+                    "daemon.peer_task", task_id=meta.task_id, peer_id=peer_id, url=url
+                ):
+                    ts = await conductor.run()
+            except Exception:
+                metrics.TASK_RESULT_TOTAL.inc(success="false")
+                raise
+            finally:
+                metrics.CONCURRENT_TASKS.dec()
+            metrics.TASK_RESULT_TOTAL.inc(success="true")
         if output is not None:
             await ts.export_to(output)
         return ts

@@ -1,0 +1,32 @@
+"""Peer-daemon Prometheus metrics (ref client/daemon/metrics/metrics.go).
+
+Counters for task outcomes, piece sources (p2p parent vs back-to-source),
+byte traffic both directions, and proxy decisions; gauges for in-flight work.
+"""
+
+from __future__ import annotations
+
+from dragonfly2_tpu.observability.metrics import default_registry
+
+_r = default_registry()
+
+TASK_TOTAL = _r.counter(
+    "task_total", "Download tasks started", subsystem="dfdaemon", labels=("type",)
+)
+TASK_RESULT_TOTAL = _r.counter(
+    "task_result_total", "Download task completions", subsystem="dfdaemon", labels=("success",)
+)
+PIECE_DOWNLOAD_TOTAL = _r.counter(
+    "piece_download_total", "Pieces fetched", subsystem="dfdaemon", labels=("source",)
+)
+DOWNLOAD_BYTES = _r.counter(
+    "download_bytes_total", "Bytes downloaded (p2p + source)", subsystem="dfdaemon"
+)
+UPLOAD_BYTES = _r.counter(
+    "upload_bytes_total", "Piece bytes served to children", subsystem="dfdaemon"
+)
+CONCURRENT_TASKS = _r.gauge("concurrent_tasks", "Tasks in flight", subsystem="dfdaemon")
+PROXY_REQUEST_TOTAL = _r.counter(
+    "proxy_request_total", "Proxy requests", subsystem="dfdaemon", labels=("via",)
+)
+SEED_TASK_TOTAL = _r.counter("seed_task_total", "Seed tasks triggered", subsystem="dfdaemon")

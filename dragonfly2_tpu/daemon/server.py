@@ -117,6 +117,7 @@ async def run_daemon(
     location: str = "",
     upload_port: int = 0,
     rpc_port: int | None = None,
+    metrics_port: int | None = None,
     manager_addr: str | None = None,
     announce_interval: float = 30.0,
     probe_interval: float | None = None,
@@ -148,6 +149,12 @@ async def run_daemon(
         tcp_server.register_service(DaemonRpcAdapter(engine), DAEMON_METHODS)
         await tcp_server.start()
         engine.rpc_port = tcp_server.port
+    debug = None
+    if metrics_port is not None:
+        from dragonfly2_tpu.observability.server import start_debug_server
+
+        debug = await start_debug_server(host=ip, port=metrics_port)
+        logger.info("daemon metrics on %s:%d", ip, debug.port)
     logger.info(
         "daemon rpc on %s (tcp %s), piece server on :%d",
         sock_path, engine.rpc_port or "-", engine.upload.port,
@@ -195,6 +202,8 @@ async def run_daemon(
     finally:
         announcer.cancel()
         await prober.stop()
+        if debug is not None:
+            await debug.stop()
         await server.stop()
         if tcp_server is not None:
             await tcp_server.stop()
@@ -235,6 +244,8 @@ def main() -> None:
     ap.add_argument("--idc", default="")
     ap.add_argument("--location", default="")
     ap.add_argument("--upload-port", type=int, default=0)
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    help="dedicated debug/metrics port (off by default)")
     ap.add_argument("--rpc-port", type=int, default=None,
                     help="TCP RPC port (seed peers always listen; 0 = ephemeral)")
     ap.add_argument("--manager", default=None, help="manager address host:port")
@@ -258,6 +269,7 @@ def main() -> None:
             location=args.location,
             upload_port=args.upload_port,
             rpc_port=args.rpc_port,
+            metrics_port=args.metrics_port,
             manager_addr=args.manager,
             probe_interval=args.probe_interval,
         )

@@ -42,6 +42,8 @@ class UploadServer:
         self._runner: web.AppRunner | None = None
 
     def _app(self) -> web.Application:
+        # no /metrics here: the upload port is the public p2p data path;
+        # metrics live on the daemon's dedicated debug port (observability.server)
         app = web.Application()
         app.router.add_get("/download/{prefix}/{task_id}", self._handle_download)
         app.router.add_get("/metadata/{task_id}", self._handle_metadata)
@@ -114,6 +116,9 @@ class UploadServer:
         data = await ts.read_range(rng)
         self.bytes_served += len(data)
         self.pieces_served += 1
+        from dragonfly2_tpu.daemon import metrics
+
+        metrics.UPLOAD_BYTES.inc(len(data))
         return web.Response(
             status=206,
             body=data,

@@ -30,6 +30,7 @@ class ManagerServer:
         host: str = "127.0.0.1",
         port: int = 0,
         rest_port: int | None = 0,
+        metrics_port: int | None = None,
         keepalive_ttl: float = 60.0,
     ):
         self.db = Database(db_path)
@@ -38,6 +39,8 @@ class ManagerServer:
         self.rpc = RpcServer(host=host, port=port)
         register_manager(self.rpc, ManagerRpcAdapter(self.service, self.jobs))
         self.rest_port = rest_port
+        self.metrics_port = metrics_port
+        self._debug = None
         self._rest_runner = None
         self._reaper: asyncio.Task | None = None
         self._lease_reaper: asyncio.Task | None = None
@@ -53,6 +56,11 @@ class ManagerServer:
             self._rest_runner, self.rest_port = await start_rest(
                 self.service, self.jobs, host=self.rpc.host, port=self.rest_port
             )
+        if self.metrics_port is not None:
+            from dragonfly2_tpu.observability.server import start_debug_server
+
+            self._debug = await start_debug_server(host=self.rpc.host, port=self.metrics_port)
+            self.metrics_port = self._debug.port
         self._reaper = asyncio.ensure_future(self.service.run_reaper())
         self._lease_reaper = asyncio.ensure_future(self._run_lease_reaper())
         logger.info("manager rpc on %s rest on :%s", self.rpc.address, self.rest_port)
@@ -71,6 +79,8 @@ class ManagerServer:
         for t in (self._reaper, self._lease_reaper):
             if t is not None:
                 t.cancel()
+        if self._debug is not None:
+            await self._debug.stop()
         if self._rest_runner is not None:
             await self._rest_runner.cleanup()
         await self.rpc.stop()
@@ -80,7 +90,7 @@ class ManagerServer:
 async def amain(args: argparse.Namespace) -> None:
     server = ManagerServer(
         db_path=args.db, host=args.host, port=args.port, rest_port=args.rest_port,
-        keepalive_ttl=args.keepalive_ttl,
+        metrics_port=args.metrics_port, keepalive_ttl=args.keepalive_ttl,
     )
     await server.start()
     print(f"manager ready rpc={server.address} rest={server.rest_port}", flush=True)
@@ -94,6 +104,7 @@ def main() -> None:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=9200)
     p.add_argument("--rest-port", type=int, default=9201)
+    p.add_argument("--metrics-port", type=int, default=None)
     p.add_argument("--keepalive-ttl", type=float, default=60.0)
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args()

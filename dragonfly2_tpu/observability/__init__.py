@@ -1,0 +1,28 @@
+"""Observability: metrics registry (Prometheus text exposition) + span tracing.
+
+Parity with the reference's Prometheus-per-service + OpenTelemetry-everywhere
+stance (SURVEY.md §5; scheduler/metrics/metrics.go:46-179,
+client/daemon/metrics/metrics.go, cmd/dependency/dependency.go:39,73 jaeger
+bootstrap) — built dependency-free: a small typed registry with text
+exposition, and a contextvar-based tracer writing JSON-lines spans.
+"""
+
+from dragonfly2_tpu.observability.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    default_registry,
+)
+from dragonfly2_tpu.observability.tracing import Span, Tracer, default_tracer
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "default_registry",
+    "Span",
+    "Tracer",
+    "default_tracer",
+]

@@ -1,0 +1,78 @@
+"""Debug/metrics HTTP endpoint shared by all services.
+
+The reference gives every service a dedicated metrics port plus pprof/statsview
+(cmd/dependency/dependency.go:95-102). Equivalent here: a tiny aiohttp app with
+  GET /metrics      Prometheus text exposition
+  GET /healthz      liveness
+  GET /debug/spans  last finished tracing spans as JSON
+started via `start_debug_server(port=...)` from any service composition root.
+"""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from dragonfly2_tpu.observability.metrics import MetricsRegistry, default_registry
+from dragonfly2_tpu.observability.tracing import Tracer, default_tracer
+
+
+def make_debug_app(
+    registry: MetricsRegistry | None = None, tracer: Tracer | None = None
+) -> web.Application:
+    from dragonfly2_tpu.observability.metrics import metrics_http_handler
+
+    reg = registry or default_registry()
+    tr = tracer or default_tracer()
+    app = web.Application()
+    metrics = metrics_http_handler(reg)
+
+    async def healthz(_req: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def spans(_req: web.Request) -> web.Response:
+        return web.json_response([s.to_dict() for s in tr.finished()])
+
+    app.router.add_get("/metrics", metrics)
+    app.router.add_get("/healthz", healthz)
+    app.router.add_get("/debug/spans", spans)
+    return app
+
+
+class DebugServer:
+    def __init__(
+        self,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        registry: MetricsRegistry | None = None,
+        tracer: Tracer | None = None,
+    ):
+        self.host = host
+        self.port = port
+        self._app = make_debug_app(registry, tracer)
+        self._runner: web.AppRunner | None = None
+
+    async def start(self) -> None:
+        self._runner = web.AppRunner(self._app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        if self.port == 0:
+            self.port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+            self._runner = None
+
+
+async def start_debug_server(
+    *,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    registry: MetricsRegistry | None = None,
+    tracer: Tracer | None = None,
+) -> DebugServer:
+    srv = DebugServer(host=host, port=port, registry=registry, tracer=tracer)
+    await srv.start()
+    return srv

@@ -1,0 +1,197 @@
+"""Contextvar span tracer with JSON-lines export and wire propagation.
+
+Parity with the reference's OpenTelemetry usage (otelgrpc interceptors on
+every RPC chain, explicit spans with typed attributes on peer tasks and
+preheat jobs — peertask_conductor.go:182-208, manager/job/preheat.go:91-93,
+client/config/constants_otel.go). Dependency-free design:
+
+- `Tracer.span(name, **attrs)` opens a child of the current contextvar span;
+  nesting follows Python async context automatically.
+- Trace context propagates across processes as a `{"trace_id", "span_id"}`
+  dict carried in RPC payloads / HTTP headers (W3C-traceparent-shaped ids).
+- Finished spans go to an exporter: in-memory ring (tests, /debug) and/or
+  JSON-lines file (the jaeger-exporter stand-in — one dict per span with
+  trace_id, span_id, parent_id, name, start, duration_ms, attrs, status).
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import os
+import secrets
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Mapping, Optional
+
+_current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar(
+    "dragonfly_current_span", default=None
+)
+
+TRACEPARENT_HEADER = "traceparent"
+
+
+def _gen_trace_id() -> str:
+    return secrets.token_hex(16)
+
+
+def _gen_span_id() -> str:
+    return secrets.token_hex(8)
+
+
+@dataclass
+class SpanContext:
+    trace_id: str
+    span_id: str
+
+    def to_dict(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id}
+
+    def traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-01"
+
+    @classmethod
+    def from_dict(cls, d: Mapping[str, Any] | None) -> Optional["SpanContext"]:
+        if not d or "trace_id" not in d:
+            return None
+        return cls(trace_id=str(d["trace_id"]), span_id=str(d.get("span_id", "")))
+
+    @classmethod
+    def from_traceparent(cls, header: str | None) -> Optional["SpanContext"]:
+        if not header:
+            return None
+        parts = header.split("-")
+        if len(parts) != 4:
+            return None
+        return cls(trace_id=parts[1], span_id=parts[2])
+
+
+class Span:
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "start", "end",
+        "attrs", "status", "error", "_tracer", "_token",
+    )
+
+    def __init__(
+        self,
+        tracer: "Tracer",
+        name: str,
+        trace_id: str,
+        parent_id: str,
+        attrs: dict[str, Any],
+    ):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = _gen_span_id()
+        self.parent_id = parent_id
+        self.start = time.time()
+        self.end = 0.0
+        self.attrs = attrs
+        self.status = "ok"
+        self.error = ""
+        self._tracer = tracer
+        self._token: Optional[contextvars.Token] = None
+
+    @property
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def set_attr(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def __enter__(self) -> "Span":
+        self._token = _current_span.set(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if exc is not None:
+            self.status = "error"
+            self.error = f"{exc_type.__name__}: {exc}"
+        self.end = time.time()
+        if self._token is not None:
+            _current_span.reset(self._token)
+        self._tracer._export(self)
+
+    def to_dict(self) -> dict:
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_id": self.parent_id,
+            "name": self.name,
+            "start": self.start,
+            "duration_ms": round((self.end - self.start) * 1000, 3),
+            "attrs": self.attrs,
+            "status": self.status,
+            "error": self.error,
+        }
+
+
+@dataclass
+class Tracer:
+    """Per-process tracer. `service` tags every span; spans export to an
+    in-memory ring always, and to a JSON-lines file when `path` is set
+    (DRAGONFLY_TRACE_FILE env overrides)."""
+
+    service: str = "dragonfly"
+    path: str = ""
+    ring_size: int = 2048
+    _ring: deque = field(default_factory=lambda: deque(maxlen=2048), repr=False)
+    _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
+    _fh: Any = field(default=None, repr=False)
+
+    def __post_init__(self) -> None:
+        self._ring = deque(maxlen=self.ring_size)
+        self.path = self.path or os.environ.get("DRAGONFLY_TRACE_FILE", "")
+
+    def span(self, name: str, parent: SpanContext | None = None, **attrs: Any) -> Span:
+        """Open a span. Parent resolution: explicit remote context > current
+        contextvar span > new root."""
+        cur = _current_span.get()
+        if parent is not None:
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        elif cur is not None:
+            trace_id, parent_id = cur.trace_id, cur.span_id
+        else:
+            trace_id, parent_id = _gen_trace_id(), ""
+        attrs.setdefault("service", self.service)
+        return Span(self, name, trace_id, parent_id, attrs)
+
+    @staticmethod
+    def current() -> Optional[Span]:
+        return _current_span.get()
+
+    @staticmethod
+    def current_context() -> Optional[SpanContext]:
+        s = _current_span.get()
+        return s.context if s is not None else None
+
+    def _export(self, span: Span) -> None:
+        with self._lock:
+            self._ring.append(span)
+            if self.path:
+                if self._fh is None:
+                    # line-buffered writes, flushed by the OS page cache; no
+                    # per-span fsync/flush so exporting never stalls the
+                    # event loop on a contended disk
+                    self._fh = open(self.path, "a", encoding="utf-8", buffering=1 << 16)
+                self._fh.write(json.dumps(span.to_dict()) + "\n")
+
+    def finished(self) -> list[Span]:
+        with self._lock:
+            return list(self._ring)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                self._fh.flush()
+                self._fh.close()
+                self._fh = None
+
+
+_default = Tracer()
+
+
+def default_tracer() -> Tracer:
+    return _default

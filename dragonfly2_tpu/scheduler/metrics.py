@@ -1,0 +1,40 @@
+"""Scheduler Prometheus metrics (ref scheduler/metrics/metrics.go:46-179).
+
+Family names mirror the reference's dragonfly_scheduler_* metrics where the
+concept carries over: peer registrations, piece/peer results by outcome,
+scheduling round latency (the north-star p50 parent-scoring budget), traffic,
+and live resource gauges.
+"""
+
+from __future__ import annotations
+
+from dragonfly2_tpu.observability.metrics import default_registry
+
+_r = default_registry()
+
+REGISTER_PEER_TOTAL = _r.counter(
+    "register_peer_total", "Peer registrations", subsystem="scheduler", labels=("scope",)
+)
+SCHEDULE_DURATION = _r.histogram(
+    "schedule_duration_seconds",
+    "Latency of one candidate-parent scheduling round (filter+score)",
+    subsystem="scheduler",
+)
+PIECE_RESULT_TOTAL = _r.counter(
+    "piece_result_total", "Piece results reported", subsystem="scheduler", labels=("success",)
+)
+PEER_RESULT_TOTAL = _r.counter(
+    "peer_result_total", "Peer download completions", subsystem="scheduler", labels=("success",)
+)
+BACK_TO_SOURCE_TOTAL = _r.counter(
+    "back_to_source_total", "Peers escalated to back-to-source", subsystem="scheduler"
+)
+DOWNLOAD_TRAFFIC_BYTES = _r.counter(
+    "download_traffic_bytes_total", "Bytes reported via piece results", subsystem="scheduler"
+)
+PEERS_GAUGE = _r.gauge("peers", "Live peers in the resource pool", subsystem="scheduler")
+TASKS_GAUGE = _r.gauge("tasks", "Live tasks in the resource pool", subsystem="scheduler")
+HOSTS_GAUGE = _r.gauge("hosts", "Live hosts in the resource pool", subsystem="scheduler")
+PROBES_SYNCED_TOTAL = _r.counter(
+    "probes_synced_total", "Network-topology probe results ingested", subsystem="scheduler"
+)

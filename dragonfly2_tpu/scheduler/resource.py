@@ -366,6 +366,9 @@ class ResourcePool:
     def peer(self, peer_id: str) -> Peer | None:
         return self._peer_index.get(peer_id)
 
+    def peer_count(self) -> int:
+        return len(self._peer_index)
+
     def delete_peer(self, peer_id: str) -> None:
         peer = self._peer_index.pop(peer_id, None)
         if peer is not None:

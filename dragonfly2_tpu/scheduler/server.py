@@ -26,6 +26,7 @@ async def run_scheduler(
     port: int = 9000,
     telemetry_dir: str | None = None,
     evaluator: str = "base",
+    metrics_port: int | None = None,
     gc_interval: float = 10.0,
     manager_addr: str | None = None,
     trainer_addr: str | None = None,
@@ -42,6 +43,13 @@ async def run_scheduler(
     server = serve_scheduler(service, host=host, port=port)
     await server.start()
     logger.info("scheduler listening on %s", server.address)
+
+    debug = None
+    if metrics_port is not None:
+        from dragonfly2_tpu.observability.server import start_debug_server
+
+        debug = await start_debug_server(host=host, port=metrics_port)
+        logger.info("scheduler metrics on %s:%d", host, debug.port)
 
     link = None
     if manager_addr:
@@ -84,6 +92,8 @@ async def run_scheduler(
         await run_until_signalled(ready_event)
     finally:
         gc.stop()
+        if debug is not None:
+            await debug.stop()
         if announcer is not None:
             await announcer.stop()
         if link is not None:
@@ -94,7 +104,12 @@ async def run_scheduler(
 
 
 def _sweep(service: SchedulerService) -> None:
+    from dragonfly2_tpu.scheduler import metrics
+
     removed = service.pool.gc()
+    metrics.PEERS_GAUGE.set(service.pool.peer_count())
+    metrics.TASKS_GAUGE.set(len(service.pool.tasks))
+    metrics.HOSTS_GAUGE.set(len(service.pool.hosts))
     if any(removed.values()):
         logger.info("gc removed %s", removed)
 
@@ -104,6 +119,7 @@ def main() -> None:
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=9000)
     ap.add_argument("--telemetry-dir", default=None)
+    ap.add_argument("--metrics-port", type=int, default=None)
     ap.add_argument("--evaluator", default="base", choices=["base", "ml"])
     ap.add_argument("--manager", default=None, help="manager address host:port")
     ap.add_argument("--trainer", default=None, help="trainer address host:port")
@@ -124,6 +140,7 @@ def main() -> None:
             port=args.port,
             telemetry_dir=args.telemetry_dir,
             evaluator=args.evaluator,
+            metrics_port=args.metrics_port,
             manager_addr=args.manager,
             trainer_addr=args.trainer,
             trainer_interval=args.trainer_interval,

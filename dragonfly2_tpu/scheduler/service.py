@@ -20,6 +20,8 @@ from typing import Any, Awaitable, Callable, Optional
 
 import numpy as np
 
+from dragonfly2_tpu.observability.tracing import default_tracer
+from dragonfly2_tpu.scheduler import metrics
 from dragonfly2_tpu.scheduler.evaluator import Evaluator, build_pair_features, new_evaluator
 from dragonfly2_tpu.scheduler.resource import (
     GCPolicy,
@@ -188,6 +190,7 @@ class SchedulerService:
             total_pieces=task.total_pieces,
             digest=task.digest,
         )
+        metrics.REGISTER_PEER_TOTAL.inc(scope=scope.value)
         if scope == SizeScope.EMPTY:
             ensure_received()
             return RegisterResult(scope=scope.value, **common)
@@ -204,8 +207,11 @@ class SchedulerService:
                 )
         # NORMAL (or SMALL fallback): full scheduling round
         ensure_received()
-        outcome = await self.scheduling.schedule_candidate_parents(peer)
+        with default_tracer().span("scheduler.schedule", task_id=task.id, peer_id=peer.id), \
+                metrics.SCHEDULE_DURATION.time():
+            outcome = await self.scheduling.schedule_candidate_parents(peer)
         if outcome.back_to_source:
+            metrics.BACK_TO_SOURCE_TOTAL.inc()
             return RegisterResult(
                 scope=SizeScope.NORMAL.value, task_id=task.id, back_to_source=True,
                 content_length=task.content_length, piece_size=task.piece_size,
@@ -261,6 +267,16 @@ class SchedulerService:
         if peer is None:
             return
         peer.touch()
+        metrics.PIECE_RESULT_TOTAL.inc(success=str(success).lower())
+        task = peer.task
+        if success and task.piece_size:
+            if task.content_length:
+                # final piece is usually partial
+                nbytes = min(task.piece_size, task.content_length - piece_index * task.piece_size)
+            else:
+                nbytes = task.piece_size
+            if nbytes > 0:
+                metrics.DOWNLOAD_TRAFFIC_BYTES.inc(nbytes)
         if success:
             if peer.fsm.can("download"):
                 peer.fsm.fire("download")
@@ -340,8 +356,11 @@ class SchedulerService:
         if peer is None:
             raise KeyError(peer_id)
         task = peer.task
-        outcome = await self.scheduling.schedule_candidate_parents(peer, blocklist=peer.block_parents)
+        with default_tracer().span("scheduler.reschedule", task_id=task.id, peer_id=peer.id), \
+                metrics.SCHEDULE_DURATION.time():
+            outcome = await self.scheduling.schedule_candidate_parents(peer, blocklist=peer.block_parents)
         if outcome.back_to_source:
+            metrics.BACK_TO_SOURCE_TOTAL.inc()
             return RegisterResult(
                 scope=task.size_scope().value, task_id=task.id, back_to_source=True,
                 content_length=task.content_length, piece_size=task.piece_size,
@@ -363,6 +382,7 @@ class SchedulerService:
         peer = self.pool.peer(peer_id)
         if peer is None:
             return
+        metrics.PEER_RESULT_TOTAL.inc(success=str(success).lower())
         task = peer.task
         if success:
             if peer.fsm.can("succeed"):
@@ -457,6 +477,8 @@ class SchedulerService:
     def sync_probes(self, src_host_id: str, results: list[dict]) -> list[dict]:
         """Ingest a probe round from a daemon and hand back the next targets."""
         targets = self.topology.sync_probes(src_host_id, results, self.pool.hosts)
+        if results:
+            metrics.PROBES_SYNCED_TOTAL.inc(len(results))
         return [{"host_id": t.host_id, "ip": t.ip, "port": t.port} for t in targets]
 
     def stat_task(self, task_id: str) -> dict[str, Any] | None:

@@ -1,0 +1,142 @@
+"""Metrics registry, Prometheus rendering, tracing spans, debug server."""
+
+import asyncio
+import json
+
+import pytest
+
+from dragonfly2_tpu.observability.metrics import MetricsRegistry
+from dragonfly2_tpu.observability.tracing import SpanContext, Tracer
+
+
+def test_counter_and_gauge_render():
+    reg = MetricsRegistry(namespace="t")
+    c = reg.counter("requests_total", "reqs", subsystem="svc", labels=("code",))
+    c.inc(code="200")
+    c.inc(2, code="500")
+    g = reg.gauge("inflight", "in flight")
+    g.labels().set(7)
+    text = reg.render_text()
+    assert 't_svc_requests_total{code="200"} 1' in text
+    assert 't_svc_requests_total{code="500"} 2' in text
+    assert "t_inflight 7" in text
+    assert "# TYPE t_svc_requests_total counter" in text
+    assert c.value == 3
+
+
+def test_counter_rejects_decrease_and_label_mismatch():
+    reg = MetricsRegistry("t")
+    c = reg.counter("x", labels=("a",))
+    with pytest.raises(ValueError):
+        c.inc(-1, a="1")
+    with pytest.raises(ValueError):
+        c.inc(b="1")
+
+
+def test_histogram_buckets_and_summary():
+    reg = MetricsRegistry("t")
+    h = reg.histogram("lat", "latency", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 5.0, 50.0):
+        h.observe(v)
+    text = reg.render_text()
+    assert 't_lat_bucket{le="0.1"} 1' in text
+    assert 't_lat_bucket{le="1"} 2' in text
+    assert 't_lat_bucket{le="10"} 3' in text
+    assert 't_lat_bucket{le="+Inf"} 4' in text
+    assert "t_lat_count 4" in text
+    child = h.labels()
+    assert child.count == 4
+    assert child.total == pytest.approx(55.55)
+
+
+def test_histogram_timer():
+    reg = MetricsRegistry("t")
+    h = reg.histogram("dur")
+    with h.time():
+        pass
+    assert h.labels().count == 1
+
+
+def test_registry_dedupes_families():
+    reg = MetricsRegistry("t")
+    a = reg.counter("same")
+    b = reg.counter("same")
+    assert a is b
+    with pytest.raises(ValueError):
+        reg.gauge("same")
+
+
+def test_tracer_nesting_and_export(tmp_path):
+    path = tmp_path / "spans.jsonl"
+    tr = Tracer(service="test", path=str(path))
+    with tr.span("outer", task="t1") as outer:
+        with tr.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+            assert Tracer.current() is inner
+        assert Tracer.current() is outer
+    assert Tracer.current() is None
+    tr.close()  # spans are write-buffered; close flushes
+    spans = [json.loads(l) for l in path.read_text().splitlines()]
+    assert [s["name"] for s in spans] == ["inner", "outer"]
+    assert spans[0]["trace_id"] == spans[1]["trace_id"]
+    assert spans[1]["attrs"]["task"] == "t1"
+    tr.close()
+
+
+def test_tracer_error_status_and_remote_parent():
+    tr = Tracer(service="test")
+    remote = SpanContext(trace_id="a" * 32, span_id="b" * 16)
+    with pytest.raises(RuntimeError):
+        with tr.span("handler", parent=remote):
+            raise RuntimeError("boom")
+    spans = tr.finished()
+    assert spans[-1].status == "error"
+    assert spans[-1].trace_id == "a" * 32
+    assert spans[-1].parent_id == "b" * 16
+    # wire round-trip
+    ctx = spans[-1].context
+    assert SpanContext.from_dict(ctx.to_dict()).trace_id == ctx.trace_id
+    tp = ctx.traceparent()
+    assert SpanContext.from_traceparent(tp).span_id == ctx.span_id
+
+
+def test_debug_server_endpoints():
+    from aiohttp import ClientSession
+
+    from dragonfly2_tpu.observability.server import start_debug_server
+
+    reg = MetricsRegistry("t")
+    reg.counter("hits").inc(5)
+    tr = Tracer(service="dbg")
+    with tr.span("something"):
+        pass
+
+    async def run():
+        srv = await start_debug_server(registry=reg, tracer=tr)
+        try:
+            async with ClientSession() as sess:
+                async with sess.get(f"http://127.0.0.1:{srv.port}/metrics") as r:
+                    assert r.status == 200
+                    assert "t_hits 5" in await r.text()
+                async with sess.get(f"http://127.0.0.1:{srv.port}/healthz") as r:
+                    assert (await r.json())["status"] == "ok"
+                async with sess.get(f"http://127.0.0.1:{srv.port}/debug/spans") as r:
+                    spans = await r.json()
+                    assert spans[-1]["name"] == "something"
+        finally:
+            await srv.stop()
+
+    asyncio.run(run())
+
+
+def test_service_metrics_registered_in_default_registry():
+    from dragonfly2_tpu.daemon import metrics as dm
+    from dragonfly2_tpu.observability.metrics import default_registry
+    from dragonfly2_tpu.scheduler import metrics as sm
+
+    reg = default_registry()
+    assert reg.get(sm.SCHEDULE_DURATION.name) is sm.SCHEDULE_DURATION
+    assert reg.get(dm.DOWNLOAD_BYTES.name) is dm.DOWNLOAD_BYTES
+    text = reg.render_text()
+    assert "dragonfly_scheduler_schedule_duration_seconds" in text
