@@ -147,6 +147,55 @@ class PeerEngine:
             filters=tuple(kw.get("filters", ())),
         )
 
+    async def _reuse_or_conduct(
+        self,
+        meta: TaskMeta,
+        headers: dict[str, str] | None,
+        *,
+        seed: bool = False,
+    ):
+        """Shared reuse/purge/conductor logic for download_task + stream_task.
+
+        Returns (ts, producer): producer is None on the reuse fast path, else
+        a running conductor future; ts has metadata set (Content-Length known)
+        by the time this returns."""
+        import asyncio
+
+        ts = self.storage.find_completed_task(meta.task_id)
+        if ts is not None and await asyncio.to_thread(ts.verify):
+            # verify() hashes the whole file — off the event loop
+            logger.info("task %s: reuse fast path", meta.task_id[:12])
+            return ts, None
+        if ts is not None:
+            # completed-but-corrupt local copy: purge so the conductor
+            # re-fetches instead of short-circuiting on the full bitset
+            logger.warning("task %s: local copy corrupt, purging", meta.task_id[:12])
+            self.storage.delete_task(meta.task_id)
+        peer_id = idgen.peer_id(self.ip, self.hostname, seed=seed)
+        conductor = PeerTaskConductor(
+            peer_id=peer_id,
+            meta=meta,
+            host=self.host_info(),
+            scheduler=self.scheduler,
+            storage=self.storage,
+            sources=self.sources,
+            config=self.conductor_config,
+            headers=headers,
+        )
+        producer = asyncio.ensure_future(conductor.run())
+        # Wait until the conductor registered storage + metadata. Polling:
+        # the TaskStorage (and its progress event) does not exist until the
+        # conductor registers with the scheduler, so there is nothing to
+        # subscribe to yet; registration is a couple of RPC round-trips.
+        while True:
+            ts = self.storage.get(meta.task_id)
+            if ts is not None and ts.meta.total_pieces >= 0:
+                return ts, producer
+            if producer.done():
+                producer.result()  # raise the failure
+                raise IOError(f"task {meta.task_id}: no metadata after completion")
+            await asyncio.sleep(0.01)
+
     async def download_task(
         self,
         url: str,
@@ -166,32 +215,14 @@ class PeerEngine:
         if seed:
             metrics.SEED_TASK_TOTAL.inc()
 
-        ts = self.storage.find_completed_task(meta.task_id)
-        if ts is not None and ts.verify():
-            logger.info("task %s: reuse fast path", meta.task_id[:12])
-        else:
-            if ts is not None:
-                # completed-but-corrupt local copy: purge so the conductor
-                # re-fetches instead of short-circuiting on the full bitset
-                logger.warning("task %s: local copy corrupt, purging", meta.task_id[:12])
-                self.storage.delete_task(meta.task_id)
-            peer_id = idgen.peer_id(self.ip, self.hostname, seed=seed)
-            conductor = PeerTaskConductor(
-                peer_id=peer_id,
-                meta=meta,
-                host=self.host_info(),
-                scheduler=self.scheduler,
-                storage=self.storage,
-                sources=self.sources,
-                config=self.conductor_config,
-                headers=headers,
-            )
+        ts, producer = await self._reuse_or_conduct(meta, headers, seed=seed)
+        if producer is not None:
             metrics.CONCURRENT_TASKS.inc()
             try:
                 with default_tracer().span(
-                    "daemon.peer_task", task_id=meta.task_id, peer_id=peer_id, url=url
+                    "daemon.peer_task", task_id=meta.task_id, url=url
                 ):
-                    ts = await conductor.run()
+                    ts = await producer
             except Exception:
                 metrics.TASK_RESULT_TOTAL.inc(success="false")
                 raise
@@ -201,6 +232,47 @@ class PeerEngine:
         if output is not None:
             await ts.export_to(output)
         return ts
+
+    async def stream_task(
+        self,
+        url: str,
+        *,
+        headers: dict[str, str] | None = None,
+        **meta_kw,
+    ):
+        """Start (or reuse) a task and return (content_length, async-iterator)
+        yielding the body in piece order as pieces land — the daemon's
+        StartStreamTask path (ref peertask_manager.go:52, used by the proxy
+        transport, transport.go:58-119). Returns as soon as task metadata is
+        known, so a proxy can send response headers before the download
+        finishes."""
+        from dragonfly2_tpu.daemon import metrics
+
+        await self.start()
+        meta = self.make_meta(url, **meta_kw)
+        metrics.TASK_TOTAL.inc(type="stream")
+
+        ts, producer = await self._reuse_or_conduct(meta, headers)
+
+        async def body(ts=ts, producer=producer):
+            if producer is not None:
+                metrics.CONCURRENT_TASKS.inc()
+            try:
+                async for chunk in ts.stream_ordered(watch=producer):
+                    yield chunk
+                if producer is not None:
+                    await producer  # surface trailing failures (digest check)
+                metrics.TASK_RESULT_TOTAL.inc(success="true")
+            except BaseException:
+                metrics.TASK_RESULT_TOTAL.inc(success="false")
+                if producer is not None and not producer.done():
+                    producer.cancel()
+                raise
+            finally:
+                if producer is not None:
+                    metrics.CONCURRENT_TASKS.dec()
+
+        return ts.meta.content_length, body()
 
     async def import_file(self, path: str | Path, *, tag: str = "", application: str = "") -> TaskStorage:
         """Import a local file into the P2P cache (ref dfcache Import,

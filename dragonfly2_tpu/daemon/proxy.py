@@ -1,0 +1,355 @@
+"""HTTP proxy + registry mirror: route downloads through the P2P engine.
+
+Parity with reference client/daemon/proxy (proxy.go:288 ServeHTTP,
+:527-535 mirrorRegistry, :632-635 shouldUseDragonflyForMirror,
+proxy_manager.go:42-52 rules) and client/daemon/transport
+(transport.go:58-119 RoundTrip → StartStreamTask): an explicit-proxy server
+that converts matching GET requests into P2P stream tasks, passes everything
+else through, tunnels CONNECT (no TLS MITM — the reference's cert-forging
+path, cert.go, is out of scope for the mTLS-lite build), and doubles as a
+registry mirror for container-image acceleration: origin-form requests are
+rewritten onto a configured upstream registry, with immutable blob fetches
+(`/v2/<name>/blobs/sha256:...`) riding the P2P engine keyed by digest.
+
+Raw asyncio (not aiohttp.web) because a proxy must handle CONNECT and
+absolute-form request targets, which web frameworks do not model.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import re
+from dataclasses import dataclass, field
+from typing import Optional
+from urllib.parse import urlsplit
+
+import aiohttp
+
+logger = logging.getLogger(__name__)
+
+_HOP_HEADERS = {
+    "connection", "keep-alive", "proxy-authenticate", "proxy-authorization",
+    "proxy-connection", "te", "trailers", "transfer-encoding", "upgrade",
+}
+_BLOB_RE = re.compile(r"^/v2/.+/blobs/(sha256:[0-9a-f]{64})$")
+
+
+@dataclass
+class ProxyRule:
+    """One routing rule, first match wins (ref proxy_manager.go rules).
+
+    regex matches the full request URL. use_p2p routes through the engine;
+    direct forces pass-through; redirect rewrites scheme://host before
+    routing (ref proxy rule Redirect field)."""
+
+    regex: str
+    use_p2p: bool = True
+    direct: bool = False
+    redirect: str = ""
+    filtered_query_params: tuple = ()
+
+    def __post_init__(self):
+        self._re = re.compile(self.regex)
+
+    def matches(self, url: str) -> bool:
+        return self._re.search(url) is not None
+
+
+@dataclass
+class RegistryMirrorConfig:
+    """Registry-mirror target (ref config registryMirror.url)."""
+
+    base_url: str  # e.g. "http://127.0.0.1:5000"
+    use_p2p_for_blobs: bool = True
+
+    def __post_init__(self):
+        # a trailing slash would break the prefix-strip in _decide and make
+        # _BLOB_RE silently never match
+        self.base_url = self.base_url.rstrip("/")
+
+
+@dataclass
+class ProxyConfig:
+    rules: list[ProxyRule] = field(default_factory=list)
+    registry_mirror: Optional[RegistryMirrorConfig] = None
+    # requests below this size are not worth a scheduler round-trip; the
+    # reference proxies everything matched, so default 0 keeps parity
+    min_p2p_size: int = 0
+
+
+class ProxyServer:
+    """Explicit HTTP proxy + registry mirror in front of a PeerEngine."""
+
+    def __init__(
+        self,
+        engine,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        config: ProxyConfig | None = None,
+    ):
+        self.engine = engine
+        self.host = host
+        self.port = port
+        self.cfg = config or ProxyConfig()
+        self._server: asyncio.AbstractServer | None = None
+        self._session: aiohttp.ClientSession | None = None
+
+    # ---- lifecycle ----
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        logger.info("proxy listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    def _http(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(auto_decompress=False)
+        return self._session
+
+    # ---- connection handling ----
+
+    async def _handle_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        try:
+            request = await self._read_request(reader)
+            if request is None:
+                return
+            method, target, headers = request
+            if method == "CONNECT":
+                await self._handle_connect(target, reader, writer)
+                return
+            if target.startswith("http://") or target.startswith("https://"):
+                url = target
+            elif self.cfg.registry_mirror is not None:
+                # origin-form request: we are someone's registry mirror
+                url = self.cfg.registry_mirror.base_url.rstrip("/") + target
+            else:
+                await self._respond_simple(writer, 400, b"proxy expects absolute-form URI")
+                return
+            await self._route(method, url, headers, reader, writer)
+        except (ConnectionResetError, BrokenPipeError, asyncio.IncompleteReadError):
+            pass
+        except Exception:
+            logger.exception("proxy connection failed")
+            try:
+                await self._respond_simple(writer, 502, b"proxy error")
+            except Exception:
+                pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    @staticmethod
+    async def _read_request(reader: asyncio.StreamReader):
+        """Parse request line + headers (body handling is per-route).
+
+        Header names are lower-cased on parse: HTTP field names are
+        case-insensitive and every later lookup (Range, Content-Length,
+        Transfer-Encoding) relies on a canonical form."""
+        line = await reader.readline()
+        if not line:
+            return None
+        try:
+            method, target, _version = line.decode("latin1").rstrip("\r\n").split(" ", 2)
+        except ValueError:
+            return None
+        headers: dict[str, str] = {}
+        while True:
+            hline = await reader.readline()
+            if hline in (b"\r\n", b"\n", b""):
+                break
+            if b":" in hline:
+                k, v = hline.decode("latin1").split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        return method, target, headers
+
+    # ---- CONNECT tunnel ----
+
+    async def _handle_connect(
+        self, target: str, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        from dragonfly2_tpu.daemon import metrics
+
+        host, _, port_s = target.rpartition(":")  # rpartition: IPv6 literals
+        if not host:
+            host, port_s = target, ""
+        host = host.strip("[]")
+        try:
+            port = int(port_s or 443)
+        except ValueError:
+            await self._respond_simple(writer, 400, b"bad CONNECT target")
+            return
+        try:
+            upstream_r, upstream_w = await asyncio.open_connection(host, port)
+        except OSError as e:
+            await self._respond_simple(writer, 502, f"connect failed: {e}".encode())
+            return
+        metrics.PROXY_REQUEST_TOTAL.inc(via="tunnel")
+        writer.write(b"HTTP/1.1 200 Connection established\r\n\r\n")
+        await writer.drain()
+
+        async def pipe(src: asyncio.StreamReader, dst: asyncio.StreamWriter) -> None:
+            try:
+                while True:
+                    data = await src.read(64 << 10)
+                    if not data:
+                        break
+                    dst.write(data)
+                    await dst.drain()
+            except (ConnectionResetError, BrokenPipeError):
+                pass
+            finally:
+                try:
+                    dst.close()
+                except Exception:
+                    pass
+
+        await asyncio.gather(pipe(reader, upstream_w), pipe(upstream_r, writer))
+
+    # ---- routing ----
+
+    def _decide(self, method: str, url: str) -> tuple[str, str]:
+        """Return (route, effective_url); route in {p2p, passthrough}."""
+        if method != "GET":
+            return "passthrough", url
+        mirror = self.cfg.registry_mirror
+        if mirror is not None and url.startswith(mirror.base_url):
+            path = url[len(mirror.base_url):]
+            if mirror.use_p2p_for_blobs and _BLOB_RE.match(urlsplit(path).path):
+                return "p2p", url
+            return "passthrough", url
+        for rule in self.cfg.rules:
+            if rule.matches(url):
+                if rule.redirect:
+                    parts = urlsplit(url)
+                    url = rule.redirect.rstrip("/") + parts.path + (
+                        f"?{parts.query}" if parts.query else ""
+                    )
+                if rule.direct or not rule.use_p2p:
+                    return "passthrough", url
+                return "p2p", url
+        return "passthrough", url
+
+    async def _route(
+        self,
+        method: str,
+        url: str,
+        headers: dict[str, str],
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+    ) -> None:
+        from dragonfly2_tpu.daemon import metrics
+
+        route, url = self._decide(method, url)
+        # read any request body up front (it precedes routing: the p2p route
+        # may fall back to passthrough, which must still forward the body)
+        body = await self._read_body(reader, headers)
+        fwd = {k: v for k, v in headers.items() if k not in _HOP_HEADERS}
+        fwd.pop("host", None)
+        if body:
+            fwd["content-length"] = str(len(body))
+        if route == "p2p" and "range" not in fwd:
+            metrics.PROXY_REQUEST_TOTAL.inc(via="p2p")
+            try:
+                stream = await self._open_p2p(url, fwd)
+            except Exception as e:
+                # pass-through fallback (ref transport.go:170 WithCondition
+                # fallback) — only possible before response bytes are written
+                logger.warning("p2p route for %s failed (%s); falling back", url, e)
+                stream = None
+            if stream is not None:
+                await self._serve_p2p(stream, writer)
+                return
+        metrics.PROXY_REQUEST_TOTAL.inc(via="passthrough")
+        await self._serve_passthrough(method, url, fwd, body, writer)
+
+    @staticmethod
+    async def _read_body(reader: asyncio.StreamReader, headers: dict[str, str]) -> bytes:
+        """Consume the request body: Content-Length or chunked."""
+        if "chunked" in headers.get("transfer-encoding", "").lower():
+            chunks = []
+            while True:
+                size_line = await reader.readline()
+                size = int(size_line.split(b";")[0].strip() or b"0", 16)
+                if size == 0:
+                    # drain trailers until blank line
+                    while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+                        pass
+                    return b"".join(chunks)
+                chunks.append(await reader.readexactly(size))
+                await reader.readexactly(2)  # CRLF after each chunk
+        length = int(headers.get("content-length", 0) or 0)
+        if length > 0:
+            return await reader.readexactly(length)
+        return b""
+
+    async def _open_p2p(self, url: str, headers: dict[str, str]):
+        """Start the stream task; raises (→ fallback) before any response
+        bytes are written."""
+        digest = ""
+        m = _BLOB_RE.match(urlsplit(url).path)
+        if m:
+            digest = m.group(1)
+        return await self.engine.stream_task(url, headers=headers, digest=digest)
+
+    async def _serve_p2p(self, stream, writer: asyncio.StreamWriter) -> None:
+        length, body = stream
+        writer.write(
+            b"HTTP/1.1 200 OK\r\n"
+            + f"Content-Length: {length}\r\n".encode()
+            + b"Content-Type: application/octet-stream\r\n"
+            + b"X-Dragonfly-Via: p2p\r\n"
+            + b"Connection: close\r\n\r\n"
+        )
+        await writer.drain()
+        # headers are out: any failure past this point aborts the connection
+        # (no second response can be written)
+        async for chunk in body:
+            writer.write(chunk)
+            await writer.drain()
+
+    async def _serve_passthrough(
+        self,
+        method: str,
+        url: str,
+        headers: dict[str, str],
+        body: bytes,
+        writer: asyncio.StreamWriter,
+    ) -> None:
+        async with self._http().request(
+            method, url, headers=headers, data=body or None, allow_redirects=False
+        ) as resp:
+            writer.write(f"HTTP/1.1 {resp.status} {resp.reason}\r\n".encode())
+            for k, v in resp.headers.items():
+                if k.lower() in _HOP_HEADERS or k.lower() == "content-length":
+                    continue
+                writer.write(f"{k}: {v}\r\n".encode("latin1"))
+            data_known = resp.headers.get("Content-Length")
+            if data_known is not None:
+                writer.write(f"Content-Length: {data_known}\r\n".encode())
+                writer.write(b"Connection: close\r\n\r\n")
+                await writer.drain()
+                async for chunk in resp.content.iter_chunked(64 << 10):
+                    writer.write(chunk)
+                    await writer.drain()
+            else:
+                # unknown length: close-delimited response
+                writer.write(b"Connection: close\r\n\r\n")
+                await writer.drain()
+                async for chunk in resp.content.iter_chunked(64 << 10):
+                    writer.write(chunk)
+                    await writer.drain()

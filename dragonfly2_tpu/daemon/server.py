@@ -118,6 +118,9 @@ async def run_daemon(
     upload_port: int = 0,
     rpc_port: int | None = None,
     metrics_port: int | None = None,
+    proxy_port: int | None = None,
+    proxy_rules: list | None = None,
+    registry_mirror: str | None = None,
     manager_addr: str | None = None,
     announce_interval: float = 30.0,
     probe_interval: float | None = None,
@@ -149,6 +152,23 @@ async def run_daemon(
         tcp_server.register_service(DaemonRpcAdapter(engine), DAEMON_METHODS)
         await tcp_server.start()
         engine.rpc_port = tcp_server.port
+    proxy = None
+    if proxy_port is not None:
+        from dragonfly2_tpu.daemon.proxy import (
+            ProxyConfig,
+            ProxyRule,
+            ProxyServer,
+            RegistryMirrorConfig,
+        )
+
+        pcfg = ProxyConfig(
+            rules=[r if isinstance(r, ProxyRule) else ProxyRule(regex=r) for r in (proxy_rules or [])],
+            registry_mirror=RegistryMirrorConfig(base_url=registry_mirror) if registry_mirror else None,
+        )
+        proxy = ProxyServer(engine, host=ip, port=proxy_port, config=pcfg)
+        await proxy.start()
+        logger.info("proxy on %s:%d", ip, proxy.port)
+
     debug = None
     if metrics_port is not None:
         from dragonfly2_tpu.observability.server import start_debug_server
@@ -202,6 +222,8 @@ async def run_daemon(
     finally:
         announcer.cancel()
         await prober.stop()
+        if proxy is not None:
+            await proxy.stop()
         if debug is not None:
             await debug.stop()
         await server.stop()
@@ -246,6 +268,12 @@ def main() -> None:
     ap.add_argument("--upload-port", type=int, default=0)
     ap.add_argument("--metrics-port", type=int, default=None,
                     help="dedicated debug/metrics port (off by default)")
+    ap.add_argument("--proxy-port", type=int, default=None,
+                    help="HTTP proxy / registry-mirror port (off by default)")
+    ap.add_argument("--proxy-rule", action="append", default=[],
+                    help="URL regex routed through P2P (repeatable)")
+    ap.add_argument("--registry-mirror", default=None,
+                    help="upstream registry base URL for mirror mode")
     ap.add_argument("--rpc-port", type=int, default=None,
                     help="TCP RPC port (seed peers always listen; 0 = ephemeral)")
     ap.add_argument("--manager", default=None, help="manager address host:port")
@@ -270,6 +298,9 @@ def main() -> None:
             upload_port=args.upload_port,
             rpc_port=args.rpc_port,
             metrics_port=args.metrics_port,
+            proxy_port=args.proxy_port,
+            proxy_rules=args.proxy_rule,
+            registry_mirror=args.registry_mirror,
             manager_addr=args.manager,
             probe_interval=args.probe_interval,
         )

@@ -49,8 +49,14 @@ class TaskStorage:
         self.meta = meta
         self._bitset = Bitset(meta.finished_pieces)
         self._lock = asyncio.Lock()
+        self._progress = asyncio.Event()  # replaced on every notify
         if not self.data_path.exists():
             self.data_path.touch()
+
+    def _notify_progress(self) -> None:
+        """Wake stream readers: a piece landed or metadata changed."""
+        ev, self._progress = self._progress, asyncio.Event()
+        ev.set()
 
     # ---- metadata ----
 
@@ -73,6 +79,7 @@ class TaskStorage:
         with open(self.data_path, "r+b") as f:
             f.truncate(content_length)
         self.save_metadata()
+        self._notify_progress()
 
     # ---- pieces ----
 
@@ -109,6 +116,7 @@ class TaskStorage:
             if self._bitset.set(index):
                 self.meta.piece_digests[str(index)] = d
                 self.save_metadata()
+        self._notify_progress()
         return d
 
     async def read_piece(self, index: int) -> bytes:
@@ -126,6 +134,35 @@ class TaskStorage:
     def mark_done(self) -> None:
         self.meta.done = True
         self.save_metadata()
+        self._notify_progress()
+
+    async def stream_ordered(self, *, watch: "asyncio.Future | None" = None):
+        """Yield piece bytes in index order as they arrive (the daemon's
+        StartStreamTask shape, ref peertask_manager.go:52): piece i is yielded
+        as soon as it is finished locally, so a proxy/stream consumer sees
+        first bytes before the tail of the file lands. `watch` is an optional
+        producer future (the conductor): if it fails, the stream raises
+        instead of hanging."""
+        idx = 0
+        while True:
+            if self.meta.total_pieces >= 0 and idx >= self.meta.total_pieces:
+                return
+            if self.meta.total_pieces >= 0 and self.has_piece(idx):
+                yield await self.read_piece(idx)
+                idx += 1
+                continue
+            ev = self._progress  # capture BEFORE re-check to not miss a notify
+            if self.meta.total_pieces >= 0 and self.has_piece(idx):
+                continue
+            if watch is not None and watch.done():
+                watch.result()  # raises the producer's error
+                if self.meta.total_pieces >= 0 and self.has_piece(idx):
+                    continue
+                raise IOError(f"producer finished but piece {idx} never arrived")
+            try:
+                await asyncio.wait_for(ev.wait(), timeout=0.5)
+            except asyncio.TimeoutError:
+                pass  # periodic re-check (covers producer death + lost wakeups)
 
     def verify(self) -> bool:
         """Full-content digest check against task digest (if known)."""

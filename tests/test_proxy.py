@@ -1,0 +1,315 @@
+"""Proxy + registry mirror + stream-task tests (ref client/daemon/proxy,
+transport; tested the in-process way, SURVEY.md §4)."""
+
+import asyncio
+import hashlib
+
+import aiohttp
+import pytest
+from aiohttp import web
+
+from dragonfly2_tpu.daemon.engine import InProcessSchedulerClient, PeerEngine
+from dragonfly2_tpu.daemon.proxy import (
+    ProxyConfig,
+    ProxyRule,
+    ProxyServer,
+    RegistryMirrorConfig,
+)
+from dragonfly2_tpu.scheduler.service import SchedulerService
+from tests.test_e2e import Origin, fast_conductor, make_engine
+
+PAYLOAD = bytes(range(256)) * 2048  # 512 KiB
+
+
+def proxy_session(proxy: ProxyServer) -> aiohttp.ClientSession:
+    return aiohttp.ClientSession()
+
+
+async def proxy_get(proxy: ProxyServer, url: str, headers: dict | None = None):
+    async with aiohttp.ClientSession() as sess:
+        async with sess.get(
+            url, proxy=f"http://127.0.0.1:{proxy.port}", headers=headers or {}
+        ) as resp:
+            return resp.status, dict(resp.headers), await resp.read()
+
+
+class TestProxyRules:
+    def test_decide_first_match_wins(self):
+        cfg = ProxyConfig(
+            rules=[
+                ProxyRule(regex=r"\.bin$", use_p2p=True),
+                ProxyRule(regex=r"example\.com", direct=True),
+            ]
+        )
+        p = ProxyServer(engine=None, config=cfg)
+        assert p._decide("GET", "http://example.com/a.bin")[0] == "p2p"
+        assert p._decide("GET", "http://example.com/a.txt")[0] == "passthrough"
+        assert p._decide("GET", "http://other.com/x")[0] == "passthrough"
+        # non-GET never rides p2p
+        assert p._decide("POST", "http://example.com/a.bin")[0] == "passthrough"
+
+    def test_decide_redirect_rewrites_host(self):
+        cfg = ProxyConfig(
+            rules=[ProxyRule(regex=r"cdn\.example\.com", redirect="http://mirror.local:9999")]
+        )
+        p = ProxyServer(engine=None, config=cfg)
+        route, url = p._decide("GET", "http://cdn.example.com/file.bin?x=1")
+        assert route == "p2p"
+        assert url == "http://mirror.local:9999/file.bin?x=1"
+
+    def test_decide_registry_blobs(self):
+        cfg = ProxyConfig(
+            registry_mirror=RegistryMirrorConfig(base_url="http://127.0.0.1:5000")
+        )
+        p = ProxyServer(engine=None, config=cfg)
+        blob = "http://127.0.0.1:5000/v2/library/nginx/blobs/sha256:" + "a" * 64
+        manifest = "http://127.0.0.1:5000/v2/library/nginx/manifests/latest"
+        assert p._decide("GET", blob)[0] == "p2p"
+        assert p._decide("GET", manifest)[0] == "passthrough"
+
+    def test_mirror_base_url_trailing_slash_normalized(self):
+        cfg = RegistryMirrorConfig(base_url="http://127.0.0.1:5000/")
+        assert cfg.base_url == "http://127.0.0.1:5000"
+        p = ProxyServer(engine=None, config=ProxyConfig(registry_mirror=cfg))
+        blob = "http://127.0.0.1:5000/v2/x/blobs/sha256:" + "b" * 64
+        assert p._decide("GET", blob)[0] == "p2p"
+
+
+class TestProxyE2E:
+    def test_p2p_route_serves_via_engine(self, run, tmp_path):
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            async with Origin({"model.bin": PAYLOAD}) as origin:
+                engine = make_engine(tmp_path, client, "proxypeer")
+                await engine.start()
+                proxy = ProxyServer(
+                    engine,
+                    config=ProxyConfig(rules=[ProxyRule(regex=r"\.bin$")]),
+                )
+                await proxy.start()
+                try:
+                    status, headers, data = await proxy_get(proxy, origin.url("model.bin"))
+                    assert status == 200
+                    assert data == PAYLOAD
+                    assert headers.get("X-Dragonfly-Via") == "p2p"
+                    assert int(headers["Content-Length"]) == len(PAYLOAD)
+                    # the engine stored it as a task → second fetch reuses
+                    reqs = origin.requests
+                    status, headers, data2 = await proxy_get(proxy, origin.url("model.bin"))
+                    assert data2 == PAYLOAD
+                    assert origin.requests == reqs  # served from local storage
+                finally:
+                    await proxy.stop()
+                    await engine.stop()
+
+        run(body())
+
+    def test_passthrough_route(self, run, tmp_path):
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            async with Origin({"page.txt": b"hello proxy"}) as origin:
+                engine = make_engine(tmp_path, client, "proxypeer2")
+                await engine.start()
+                proxy = ProxyServer(engine, config=ProxyConfig())  # no rules
+                await proxy.start()
+                try:
+                    status, headers, data = await proxy_get(proxy, origin.url("page.txt"))
+                    assert status == 200
+                    assert data == b"hello proxy"
+                    assert "X-Dragonfly-Via" not in headers
+                finally:
+                    await proxy.stop()
+                    await engine.stop()
+
+        run(body())
+
+    def test_lowercase_range_header_skips_p2p(self, run, tmp_path):
+        async def body():
+            class MustNotBeUsed:
+                async def stream_task(self, url, **kw):  # pragma: no cover
+                    raise AssertionError("ranged request must not ride p2p")
+
+            data = b"0123456789abcdef"
+            async with Origin({"r.bin": data}) as origin:
+                proxy = ProxyServer(
+                    MustNotBeUsed(), config=ProxyConfig(rules=[ProxyRule(regex=r"\.bin$")])
+                )
+                await proxy.start()
+                try:
+                    # raw socket: send a lowercase range header (case-insensitive per RFC)
+                    reader, writer = await asyncio.open_connection("127.0.0.1", proxy.port)
+                    writer.write(
+                        f"GET {origin.url('r.bin')} HTTP/1.1\r\n"
+                        f"range: bytes=0-3\r\n\r\n".encode()
+                    )
+                    await writer.drain()
+                    resp = await reader.read()
+                    writer.close()
+                    assert b"206" in resp.split(b"\r\n", 1)[0]
+                    assert resp.endswith(b"0123")
+                finally:
+                    await proxy.stop()
+
+        run(body())
+
+    def test_chunked_post_body_forwarded(self, run, tmp_path):
+        async def body():
+            seen = {}
+            app = web.Application()
+
+            async def echo(req):
+                seen["body"] = await req.read()
+                return web.Response(text="ok")
+
+            app.router.add_post("/echo", echo)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+
+            proxy = ProxyServer(None, config=ProxyConfig())
+            await proxy.start()
+            try:
+                reader, writer = await asyncio.open_connection("127.0.0.1", proxy.port)
+                writer.write(
+                    f"POST http://127.0.0.1:{port}/echo HTTP/1.1\r\n"
+                    "Transfer-Encoding: chunked\r\n\r\n"
+                    "5\r\nhello\r\n6\r\n world\r\n0\r\n\r\n".encode()
+                )
+                await writer.drain()
+                resp = await reader.read()
+                writer.close()
+                assert b"200" in resp.split(b"\r\n", 1)[0]
+                assert seen["body"] == b"hello world"
+            finally:
+                await proxy.stop()
+                await runner.cleanup()
+
+        run(body())
+
+    def test_p2p_fallback_to_passthrough_on_engine_failure(self, run, tmp_path):
+        async def body():
+            class BrokenEngine:
+                async def stream_task(self, url, **kw):
+                    raise IOError("engine down")
+
+            async with Origin({"f.bin": b"fallback bytes"}) as origin:
+                proxy = ProxyServer(
+                    BrokenEngine(), config=ProxyConfig(rules=[ProxyRule(regex=r"\.bin$")])
+                )
+                await proxy.start()
+                try:
+                    status, _h, data = await proxy_get(proxy, origin.url("f.bin"))
+                    assert status == 200
+                    assert data == b"fallback bytes"
+                finally:
+                    await proxy.stop()
+
+        run(body())
+
+    def test_registry_mirror_blob_and_manifest(self, run, tmp_path):
+        blob_bytes = PAYLOAD[: 128 * 1024]
+        blob_digest = "sha256:" + hashlib.sha256(blob_bytes).hexdigest()
+
+        async def body():
+            # fake OCI registry
+            app = web.Application()
+
+            async def manifest(_req):
+                return web.json_response({"schemaVersion": 2}, content_type="application/vnd.oci.image.manifest.v1+json")
+
+            async def blob(req):
+                rng = req.headers.get("Range")
+                if rng:
+                    from dragonfly2_tpu.utils.pieces import parse_http_range
+
+                    r = parse_http_range(rng, len(blob_bytes))
+                    return web.Response(
+                        status=206,
+                        body=blob_bytes[r.start : r.start + r.length],
+                        headers={"Content-Range": f"bytes {r.start}-{r.end}/{len(blob_bytes)}"},
+                    )
+                return web.Response(body=blob_bytes)
+
+            app.router.add_get("/v2/library/app/manifests/latest", manifest)
+            app.router.add_get(f"/v2/library/app/blobs/{blob_digest}", blob)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            reg_port = site._server.sockets[0].getsockname()[1]
+
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            engine = make_engine(tmp_path, client, "mirrorpeer")
+            await engine.start()
+            proxy = ProxyServer(
+                engine,
+                config=ProxyConfig(
+                    registry_mirror=RegistryMirrorConfig(
+                        base_url=f"http://127.0.0.1:{reg_port}"
+                    )
+                ),
+            )
+            await proxy.start()
+            try:
+                # clients talk to the mirror in origin-form, like containerd
+                # with a mirror endpoint configured
+                async with aiohttp.ClientSession() as sess:
+                    base = f"http://127.0.0.1:{proxy.port}"
+                    async with sess.get(f"{base}/v2/library/app/manifests/latest") as r:
+                        assert r.status == 200
+                        assert (await r.json())["schemaVersion"] == 2
+                    async with sess.get(f"{base}/v2/library/app/blobs/{blob_digest}") as r:
+                        assert r.status == 200
+                        got = await r.read()
+                        assert got == blob_bytes
+                        assert r.headers.get("X-Dragonfly-Via") == "p2p"
+            finally:
+                await proxy.stop()
+                await engine.stop()
+                await runner.cleanup()
+
+        run(body())
+
+
+class TestStreamTask:
+    def test_stream_yields_full_content(self, run, tmp_path):
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            async with Origin({"s.bin": PAYLOAD}) as origin:
+                engine = make_engine(tmp_path, client, "streampeer")
+                await engine.start()
+                try:
+                    length, it = await engine.stream_task(origin.url("s.bin"))
+                    assert length == len(PAYLOAD)
+                    got = b"".join([c async for c in it])
+                    assert got == PAYLOAD
+                    # reuse path streams from storage
+                    length2, it2 = await engine.stream_task(origin.url("s.bin"))
+                    assert b"".join([c async for c in it2]) == PAYLOAD
+                finally:
+                    await engine.stop()
+
+        run(body())
+
+    def test_stream_failure_propagates(self, run, tmp_path):
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            async with Origin({}) as origin:  # 404 origin
+                engine = make_engine(tmp_path, client, "streamfail")
+                await engine.start()
+                try:
+                    with pytest.raises(Exception):
+                        length, it = await engine.stream_task(origin.url("missing.bin"))
+                        async for _ in it:
+                            pass
+                finally:
+                    await engine.stop()
+
+        run(body())
