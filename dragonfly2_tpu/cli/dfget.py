@@ -50,21 +50,48 @@ def spawn_daemon(sock: str, scheduler: str, storage: str | None, *, seed: bool =
     )
 
 
+async def wait_daemon(sock: str, timeout: float) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if await _daemon_alive(sock):
+            return True
+        await asyncio.sleep(0.1)
+    return False
+
+
+async def ensure_daemon(
+    sock: str,
+    scheduler: str | None,
+    storage: str | None,
+    *,
+    no_spawn: bool = False,
+    spawn_timeout: float = 15.0,
+    seed: bool = False,
+) -> bool:
+    """Shared alive/spawn/wait flow for all thin CLIs (ref checkAndSpawnDaemon).
+    Prints the failure reason and returns False when no daemon is usable."""
+    if await _daemon_alive(sock):
+        return True
+    if no_spawn:
+        print(f"error: no daemon at {sock} (and --no-spawn set)", file=sys.stderr)
+        return False
+    if not scheduler:
+        print("error: daemon not running; --scheduler required to spawn one", file=sys.stderr)
+        return False
+    spawn_daemon(sock, scheduler, storage, seed=seed)
+    if not await wait_daemon(sock, spawn_timeout):
+        print("error: daemon failed to start", file=sys.stderr)
+        return False
+    return True
+
+
 async def download(args: argparse.Namespace) -> int:
     sock = args.sock
-    if not await _daemon_alive(sock):
-        if args.no_spawn:
-            print(f"error: no daemon at {sock} (and --no-spawn set)", file=sys.stderr)
-            return 1
-        spawn_daemon(sock, args.scheduler, args.storage)
-        deadline = time.monotonic() + args.spawn_timeout
-        while time.monotonic() < deadline:
-            if await _daemon_alive(sock):
-                break
-            await asyncio.sleep(0.1)
-        else:
-            print("error: daemon failed to start", file=sys.stderr)
-            return 1
+    if not await ensure_daemon(
+        sock, args.scheduler, args.storage,
+        no_spawn=args.no_spawn, spawn_timeout=args.spawn_timeout,
+    ):
+        return 1
 
     client = RpcClient(sock, timeout=args.timeout)
     try:

@@ -121,6 +121,8 @@ async def run_daemon(
     proxy_port: int | None = None,
     proxy_rules: list | None = None,
     registry_mirror: str | None = None,
+    object_storage_port: int | None = None,
+    object_storage_root: str | None = None,
     manager_addr: str | None = None,
     announce_interval: float = 30.0,
     probe_interval: float | None = None,
@@ -168,6 +170,15 @@ async def run_daemon(
         proxy = ProxyServer(engine, host=ip, port=proxy_port, config=pcfg)
         await proxy.start()
         logger.info("proxy on %s:%d", ip, proxy.port)
+
+    objgw = None
+    if object_storage_port is not None:
+        from dragonfly2_tpu.daemon.objectgw import ObjectGateway
+        from dragonfly2_tpu.objectstorage import new_backend
+
+        backend = new_backend("fs", root=object_storage_root or (str(storage_root) + "-objects"))
+        objgw = ObjectGateway(engine, backend, host=ip, port=object_storage_port)
+        await objgw.start()
 
     debug = None
     if metrics_port is not None:
@@ -224,6 +235,8 @@ async def run_daemon(
         await prober.stop()
         if proxy is not None:
             await proxy.stop()
+        if objgw is not None:
+            await objgw.stop()
         if debug is not None:
             await debug.stop()
         await server.stop()
@@ -274,6 +287,10 @@ def main() -> None:
                     help="URL regex routed through P2P (repeatable)")
     ap.add_argument("--registry-mirror", default=None,
                     help="upstream registry base URL for mirror mode")
+    ap.add_argument("--object-storage-port", type=int, default=None,
+                    help="dfstore object gateway port (off by default)")
+    ap.add_argument("--object-storage-root", default=None,
+                    help="fs backend root (default: <storage>-objects)")
     ap.add_argument("--rpc-port", type=int, default=None,
                     help="TCP RPC port (seed peers always listen; 0 = ephemeral)")
     ap.add_argument("--manager", default=None, help="manager address host:port")
@@ -301,6 +318,8 @@ def main() -> None:
             proxy_port=args.proxy_port,
             proxy_rules=args.proxy_rule,
             registry_mirror=args.registry_mirror,
+            object_storage_port=args.object_storage_port,
+            object_storage_root=args.object_storage_root,
             manager_addr=args.manager,
             probe_interval=args.probe_interval,
         )

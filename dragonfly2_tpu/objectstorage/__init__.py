@@ -1,0 +1,22 @@
+"""Unified object-storage client (ref pkg/objectstorage: objectstorage.go:65-105
+s3.go/oss.go/obs.go) — bucket + object CRUD, metadata, and presigned-style
+source URLs behind one interface, with a filesystem backend for clusters
+without S3-compatible storage (and for tests; this container has no egress)."""
+
+from dragonfly2_tpu.objectstorage.backend import (
+    Bucket,
+    LocalFSBackend,
+    ObjectMetadata,
+    ObjectStorageBackend,
+    ObjectStorageError,
+    new_backend,
+)
+
+__all__ = [
+    "Bucket",
+    "LocalFSBackend",
+    "ObjectMetadata",
+    "ObjectStorageBackend",
+    "ObjectStorageError",
+    "new_backend",
+]

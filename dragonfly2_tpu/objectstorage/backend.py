@@ -1,0 +1,305 @@
+"""Object-storage backends.
+
+Interface parity with reference pkg/objectstorage/objectstorage.go:65-105
+(GetBucketMetadata/CreateBucket/ListBucketMetadatas, GetObject/PutObject/
+DeleteObject/IsObjectExist/GetObjectMetadatas, GetSignURL) re-shaped async.
+Backends: `fs` (local filesystem, always available) and `s3` (gated on boto3,
+which is not baked into this image — the class raises a clear error at
+construction instead of at first use).
+
+The filesystem layout is `root/<bucket>/<key>` with a sidecar
+`root/.meta/<bucket>/<key>.json` carrying digest/content-type/custom
+metadata, so `presign_get` can hand the P2P engine a plain `file://` URL
+(the gateway's GetObject rides the engine with the backend as origin, the
+way the reference signs an S3 URL and StartStreamTasks it,
+client/daemon/objectstorage/objectstorage.go).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import json
+import shutil
+import time
+import uuid
+from dataclasses import asdict, dataclass, field
+from pathlib import Path
+from typing import AsyncIterator, Union
+
+
+class ObjectStorageError(Exception):
+    def __init__(self, message: str, *, code: str = "internal"):
+        super().__init__(message)
+        self.code = code
+
+
+@dataclass
+class ObjectMetadata:
+    key: str
+    content_length: int
+    digest: str = ""  # "sha256:<hex>"
+    etag: str = ""
+    content_type: str = "application/octet-stream"
+    last_modified: float = 0.0
+    user_metadata: dict = field(default_factory=dict)
+
+
+@dataclass
+class Bucket:
+    name: str
+    created_at: float = 0.0
+
+
+class ObjectStorageBackend:
+    """Async object-store interface; all methods raise ObjectStorageError
+    with code in {not_found, already_exists, invalid} on expected failures."""
+
+    name = ""
+
+    # buckets
+    async def create_bucket(self, bucket: str) -> None:
+        raise NotImplementedError
+
+    async def delete_bucket(self, bucket: str) -> None:
+        raise NotImplementedError
+
+    async def list_buckets(self) -> list[Bucket]:
+        raise NotImplementedError
+
+    async def bucket_exists(self, bucket: str) -> bool:
+        raise NotImplementedError
+
+    # objects
+    async def put_object(
+        self,
+        bucket: str,
+        key: str,
+        data: Union[bytes, AsyncIterator[bytes]],
+        *,
+        content_type: str = "application/octet-stream",
+        user_metadata: dict | None = None,
+    ) -> ObjectMetadata:
+        raise NotImplementedError
+
+    async def get_object(self, bucket: str, key: str) -> bytes:
+        raise NotImplementedError
+
+    async def stat_object(self, bucket: str, key: str) -> ObjectMetadata:
+        raise NotImplementedError
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        raise NotImplementedError
+
+    async def object_exists(self, bucket: str, key: str) -> bool:
+        try:
+            await self.stat_object(bucket, key)
+            return True
+        except ObjectStorageError:
+            return False
+
+    async def list_objects(self, bucket: str, prefix: str = "") -> list[ObjectMetadata]:
+        raise NotImplementedError
+
+    def presign_get(self, bucket: str, key: str) -> str:
+        """A URL the daemon's source registry can fetch (back-to-source
+        origin for P2P object distribution)."""
+        raise NotImplementedError
+
+
+def _safe_key(key: str) -> str:
+    # forbid traversal and degenerate segments; keys may contain slashes
+    # (pseudo-dirs) but every segment must be a real path component
+    segments = key.split("/")
+    if not key or key.startswith("/") or any(s in ("", ".", "..") for s in segments):
+        raise ObjectStorageError(f"invalid key: {key!r}", code="invalid")
+    return key
+
+
+class LocalFSBackend(ObjectStorageBackend):
+    name = "fs"
+
+    def __init__(self, root: str | Path):
+        self.root = Path(root)
+        self.root.mkdir(parents=True, exist_ok=True)
+        self._meta_root = self.root / ".meta"
+
+    # ---- helpers ----
+
+    def _bucket_dir(self, bucket: str) -> Path:
+        if not bucket or "/" in bucket or bucket.startswith("."):
+            raise ObjectStorageError(f"invalid bucket name: {bucket!r}", code="invalid")
+        return self.root / bucket
+
+    def _obj_path(self, bucket: str, key: str) -> Path:
+        return self._bucket_dir(bucket) / _safe_key(key)
+
+    def _meta_path(self, bucket: str, key: str) -> Path:
+        return self._meta_root / bucket / (_safe_key(key) + ".json")
+
+    def _require_bucket(self, bucket: str) -> Path:
+        d = self._bucket_dir(bucket)
+        if not d.is_dir():
+            raise ObjectStorageError(f"bucket {bucket} not found", code="not_found")
+        return d
+
+    # ---- buckets ----
+
+    async def create_bucket(self, bucket: str) -> None:
+        d = self._bucket_dir(bucket)
+        if d.exists():
+            raise ObjectStorageError(f"bucket {bucket} exists", code="already_exists")
+        d.mkdir(parents=True)
+
+    async def delete_bucket(self, bucket: str) -> None:
+        d = self._require_bucket(bucket)
+        if any(d.iterdir()):
+            raise ObjectStorageError(f"bucket {bucket} not empty", code="invalid")
+        d.rmdir()
+        shutil.rmtree(self._meta_root / bucket, ignore_errors=True)
+
+    async def list_buckets(self) -> list[Bucket]:
+        out = []
+        for d in sorted(self.root.iterdir()):
+            if d.is_dir() and not d.name.startswith("."):
+                out.append(Bucket(name=d.name, created_at=d.stat().st_mtime))
+        return out
+
+    async def bucket_exists(self, bucket: str) -> bool:
+        try:
+            return self._bucket_dir(bucket).is_dir()
+        except ObjectStorageError:
+            return False
+
+    # ---- objects ----
+
+    async def put_object(
+        self,
+        bucket: str,
+        key: str,
+        data: Union[bytes, AsyncIterator[bytes]],
+        *,
+        content_type: str = "application/octet-stream",
+        user_metadata: dict | None = None,
+    ) -> ObjectMetadata:
+        """Store an object from bytes or an async byte-chunk iterator (large
+        payloads stream to disk with incremental hashing — never fully
+        buffered in RAM)."""
+        self._require_bucket(bucket)
+        path = self._obj_path(bucket, key)
+        # temp files live in a dedicated dir outside any bucket so they can
+        # never collide with (or shadow) real object keys
+        tmp_dir = self.root / ".tmp"
+        tmp_dir.mkdir(exist_ok=True)
+        tmp = tmp_dir / uuid.uuid4().hex
+
+        h = hashlib.sha256()
+        length = 0
+        fh = await asyncio.to_thread(open, tmp, "wb")
+        try:
+            if isinstance(data, (bytes, bytearray)):
+                h.update(data)
+                length = len(data)
+                await asyncio.to_thread(fh.write, data)
+            else:
+                async for chunk in data:
+                    h.update(chunk)
+                    length += len(chunk)
+                    await asyncio.to_thread(fh.write, chunk)
+        finally:
+            fh.close()
+
+        hexdigest = h.hexdigest()
+        meta = ObjectMetadata(
+            key=key,
+            content_length=length,
+            digest=f"sha256:{hexdigest}",
+            etag=hexdigest[:32],
+            content_type=content_type,
+            last_modified=time.time(),
+            user_metadata=dict(user_metadata or {}),
+        )
+
+        def _publish() -> None:
+            # data first, then meta sidecar: both renames are atomic; the
+            # tiny data-new/meta-old overwrite window only mis-reports the
+            # digest, which the P2P path detects and falls back on
+            path.parent.mkdir(parents=True, exist_ok=True)
+            tmp.replace(path)
+            mp = self._meta_path(bucket, key)
+            mp.parent.mkdir(parents=True, exist_ok=True)
+            mtmp = tmp_dir / (uuid.uuid4().hex + ".json")
+            mtmp.write_text(json.dumps(asdict(meta)))
+            mtmp.replace(mp)
+
+        try:
+            await asyncio.to_thread(_publish)
+        except OSError as e:
+            tmp.unlink(missing_ok=True)
+            raise ObjectStorageError(f"store {bucket}/{key} failed: {e}", code="invalid")
+        return meta
+
+    async def get_object(self, bucket: str, key: str) -> bytes:
+        path = self._obj_path(bucket, key)
+        if not path.is_file():
+            raise ObjectStorageError(f"object {bucket}/{key} not found", code="not_found")
+        return await asyncio.to_thread(path.read_bytes)
+
+    async def stat_object(self, bucket: str, key: str) -> ObjectMetadata:
+        path = self._obj_path(bucket, key)
+        if not path.is_file():
+            raise ObjectStorageError(f"object {bucket}/{key} not found", code="not_found")
+        mp = self._meta_path(bucket, key)
+        text = await asyncio.to_thread(lambda: mp.read_text() if mp.is_file() else "")
+        if text:
+            return ObjectMetadata(**json.loads(text))
+        st = path.stat()
+        return ObjectMetadata(key=key, content_length=st.st_size, last_modified=st.st_mtime)
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        path = self._obj_path(bucket, key)
+        # idempotent like S3 DeleteObject
+        path.unlink(missing_ok=True)
+        self._meta_path(bucket, key).unlink(missing_ok=True)
+
+    async def list_objects(self, bucket: str, prefix: str = "") -> list[ObjectMetadata]:
+        d = self._require_bucket(bucket)
+        out = []
+        for p in sorted(d.rglob("*")):
+            if not p.is_file():
+                continue
+            key = p.relative_to(d).as_posix()
+            if key.startswith(prefix):
+                out.append(await self.stat_object(bucket, key))
+        return out
+
+    def presign_get(self, bucket: str, key: str) -> str:
+        return self._obj_path(bucket, key).resolve().as_uri()
+
+
+class S3Backend(ObjectStorageBackend):  # pragma: no cover - gated on boto3
+    """S3/OSS/OBS-compatible backend (ref pkg/objectstorage/s3.go). boto3 is
+    not baked into this image; constructing this without it raises with a
+    clear message instead of failing on first use."""
+
+    name = "s3"
+
+    def __init__(self, *, endpoint: str, access_key: str, secret_key: str, region: str = ""):
+        try:
+            import boto3  # noqa: F401
+        except ImportError as e:
+            raise ObjectStorageError(
+                "s3 backend requires boto3, which is not installed in this "
+                "environment; use the fs backend or install boto3"
+            ) from e
+        raise NotImplementedError("S3 backend wiring lands with a boto3-equipped runtime")
+
+
+_BACKENDS = {"fs": LocalFSBackend, "s3": S3Backend}
+
+
+def new_backend(name: str, **kwargs) -> ObjectStorageBackend:
+    cls = _BACKENDS.get(name)
+    if cls is None:
+        raise ObjectStorageError(f"unknown object-storage backend {name!r}", code="invalid")
+    return cls(**kwargs)

@@ -1,0 +1,188 @@
+"""Object storage backend + gateway + dfstore SDK tests
+(ref pkg/objectstorage + client/daemon/objectstorage + client/dfstore)."""
+
+import asyncio
+
+import pytest
+
+from dragonfly2_tpu.cli.dfstore import DfUrl, Dfstore, DfstoreError
+from dragonfly2_tpu.daemon.engine import InProcessSchedulerClient
+from dragonfly2_tpu.daemon.objectgw import ObjectGateway
+from dragonfly2_tpu.objectstorage import (
+    LocalFSBackend,
+    ObjectStorageError,
+    new_backend,
+)
+from dragonfly2_tpu.scheduler.service import SchedulerService
+from tests.test_e2e import make_engine
+
+PAYLOAD = bytes(range(256)) * 1024  # 256 KiB
+
+
+class TestLocalFSBackend:
+    def test_bucket_lifecycle(self, run, tmp_path):
+        async def body():
+            b = LocalFSBackend(tmp_path)
+            await b.create_bucket("models")
+            assert await b.bucket_exists("models")
+            with pytest.raises(ObjectStorageError) as ei:
+                await b.create_bucket("models")
+            assert ei.value.code == "already_exists"
+            assert [x.name for x in await b.list_buckets()] == ["models"]
+            await b.delete_bucket("models")
+            assert not await b.bucket_exists("models")
+
+        run(body())
+
+    def test_object_crud_and_metadata(self, run, tmp_path):
+        async def body():
+            b = LocalFSBackend(tmp_path)
+            await b.create_bucket("bk")
+            meta = await b.put_object("bk", "dir/obj.bin", PAYLOAD, user_metadata={"k": "v"})
+            assert meta.content_length == len(PAYLOAD)
+            assert meta.digest.startswith("sha256:")
+            assert await b.get_object("bk", "dir/obj.bin") == PAYLOAD
+            st = await b.stat_object("bk", "dir/obj.bin")
+            assert st.digest == meta.digest
+            assert st.user_metadata == {"k": "v"}
+            objs = await b.list_objects("bk", prefix="dir/")
+            assert [o.key for o in objs] == ["dir/obj.bin"]
+            assert await b.object_exists("bk", "dir/obj.bin")
+            await b.delete_object("bk", "dir/obj.bin")
+            assert not await b.object_exists("bk", "dir/obj.bin")
+            # idempotent delete
+            await b.delete_object("bk", "dir/obj.bin")
+
+        run(body())
+
+    def test_key_traversal_rejected(self, run, tmp_path):
+        async def body():
+            b = LocalFSBackend(tmp_path)
+            await b.create_bucket("bk")
+            for bad in ("../etc/passwd", "/abs", "a/../../x", "", "a/", "a//b", "./x"):
+                with pytest.raises(ObjectStorageError):
+                    await b.put_object("bk", bad, b"x")
+
+        run(body())
+
+    def test_tmp_suffix_keys_are_real_objects(self, run, tmp_path):
+        async def body():
+            b = LocalFSBackend(tmp_path)
+            await b.create_bucket("bk")
+            await b.put_object("bk", "a.tmp", b"tmpfile")
+            await b.put_object("bk", "a", b"realfile")
+            assert await b.get_object("bk", "a.tmp") == b"tmpfile"
+            assert await b.get_object("bk", "a") == b"realfile"
+            assert [o.key for o in await b.list_objects("bk")] == ["a", "a.tmp"]
+
+        run(body())
+
+    def test_streaming_put(self, run, tmp_path):
+        async def body():
+            b = LocalFSBackend(tmp_path)
+            await b.create_bucket("bk")
+
+            async def chunks():
+                for i in range(8):
+                    yield bytes([i]) * 1000
+
+            meta = await b.put_object("bk", "big", chunks())
+            assert meta.content_length == 8000
+            data = await b.get_object("bk", "big")
+            assert len(data) == 8000 and data[:1000] == b"\x00" * 1000
+            import hashlib
+
+            assert meta.digest == "sha256:" + hashlib.sha256(data).hexdigest()
+
+        run(body())
+
+    def test_presign_is_file_url(self, run, tmp_path):
+        async def body():
+            b = LocalFSBackend(tmp_path)
+            await b.create_bucket("bk")
+            await b.put_object("bk", "o.bin", b"data")
+            url = b.presign_get("bk", "o.bin")
+            assert url.startswith("file://")
+
+        run(body())
+
+    def test_backend_registry(self, tmp_path):
+        b = new_backend("fs", root=tmp_path)
+        assert isinstance(b, LocalFSBackend)
+        with pytest.raises(ObjectStorageError):
+            new_backend("gcs")
+
+
+class TestDfUrl:
+    def test_parse(self):
+        u = DfUrl.parse("df://bucket/a/b/c.bin")
+        assert u.bucket == "bucket" and u.key == "a/b/c.bin"
+        assert DfUrl.parse("df://b").key == ""
+        with pytest.raises(DfstoreError):
+            DfUrl.parse("s3://x/y")
+
+
+class TestGatewayAndSDK:
+    def test_put_get_roundtrip_via_p2p(self, run, tmp_path):
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            engine = make_engine(tmp_path, client, "gwpeer")
+            await engine.start()
+            backend = LocalFSBackend(tmp_path / "objects")
+            gw = ObjectGateway(engine, backend)
+            await gw.start()
+            store = Dfstore(f"http://127.0.0.1:{gw.port}")
+            try:
+                await store.create_bucket("models")
+                out = await store.put_object("models", "w.bin", PAYLOAD, seed=True)
+                assert out["content_length"] == len(PAYLOAD)
+                assert out["seeded"] is True
+
+                got = await store.get_object("models", "w.bin")
+                assert got == PAYLOAD
+
+                st = await store.stat_object("models", "w.bin")
+                assert st["content_length"] == len(PAYLOAD)
+                assert st["digest"].startswith("sha256:")
+                assert await store.is_object_exist("models", "w.bin")
+                assert not await store.is_object_exist("models", "nope.bin")
+
+                objs = await store.list_objects("models")
+                assert [o["key"] for o in objs] == ["w.bin"]
+
+                # direct (bypass p2p) read matches
+                got2 = await store.get_object("models", "w.bin", direct=True)
+                assert got2 == PAYLOAD
+
+                await store.delete_object("models", "w.bin")
+                assert not await store.is_object_exist("models", "w.bin")
+            finally:
+                await store.close()
+                await gw.stop()
+                await engine.stop()
+
+        run(body())
+
+    def test_get_missing_object_404(self, run, tmp_path):
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            engine = make_engine(tmp_path, client, "gwpeer2")
+            await engine.start()
+            backend = LocalFSBackend(tmp_path / "objects")
+            gw = ObjectGateway(engine, backend)
+            await gw.start()
+            store = Dfstore(f"http://127.0.0.1:{gw.port}")
+            try:
+                await store.create_bucket("b")
+                with pytest.raises(DfstoreError):
+                    await store.get_object("b", "missing")
+                with pytest.raises(DfstoreError):
+                    await store.put_object("nobucket", "k", b"x")
+            finally:
+                await store.close()
+                await gw.stop()
+                await engine.stop()
+
+        run(body())
