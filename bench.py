@@ -50,6 +50,50 @@ def bench_scoring(rounds: int = 2000, candidates: int = 40) -> tuple[float, floa
     return rounds / total, float(np.percentile(lat, 50) * 1000)
 
 
+def bench_native_scoring(rounds: int = 5000, candidates: int = 40) -> tuple[float, float]:
+    """The production serving path (north-star config 5): C++ scorer with
+    cached embeddings, no JAX on the hot path. Returns (rounds/s, p50 ms);
+    (0, 0) when no C++ toolchain is available."""
+    import shutil
+
+    if shutil.which("g++") is None:
+        return 0.0, 0.0
+    import jax.numpy as jnp
+
+    from dragonfly2_tpu.models.graphsage import TopoGraph
+    from dragonfly2_tpu.native import NativeScorer, export_scorer_artifact
+    from dragonfly2_tpu.trainer import synthetic, train_gnn
+
+    cluster = synthetic.make_cluster(num_nodes=1024, num_neighbors=16, num_pairs=4096, seed=7)
+    cfg = train_gnn.GNNTrainConfig()
+    model = train_gnn.make_model(cfg)
+    state = train_gnn.init_state(cfg, cluster.graph, rng_seed=7)
+    g = TopoGraph(*(jnp.asarray(a) for a in cluster.graph))
+    z = np.asarray(
+        jax.jit(lambda p, gg: model.apply(p, gg, method=model.embed))(state.params, g)
+    )
+    import tempfile
+    from pathlib import Path
+
+    with tempfile.TemporaryDirectory() as td:
+        scorer = NativeScorer(export_scorer_artifact(state.params, z, Path(td) / "s.dfsc"))
+        rng = np.random.default_rng(7)
+        child = rng.integers(0, 1024, size=candidates).astype(np.int32)
+        parent = rng.integers(0, 1024, size=candidates).astype(np.int32)
+        feats = cluster.pairs.feats[:candidates].astype(np.float32)
+        for _ in range(50):
+            scorer.score(feats, child=child, parent=parent)
+        lat = np.empty(rounds)
+        t0 = time.perf_counter()
+        for i in range(rounds):
+            s = time.perf_counter()
+            scorer.score(feats, child=child, parent=parent)
+            lat[i] = time.perf_counter() - s
+        total = time.perf_counter() - t0
+        scorer.close()
+    return rounds / total, float(np.percentile(lat, 50) * 1000)
+
+
 def bench_gnn_train(steps: int = 30) -> float:
     from dragonfly2_tpu.parallel import mesh as meshlib
     from dragonfly2_tpu.trainer import synthetic, train_gnn
@@ -80,8 +124,17 @@ def bench_gnn_train(steps: int = 30) -> float:
 
 
 def main() -> None:
-    calls_per_sec, p50_ms = bench_scoring()
+    jax_calls_per_sec, jax_p50_ms = bench_scoring()
+    try:
+        native_calls_per_sec, native_p50_ms = bench_native_scoring()
+    except Exception:
+        # a broken toolchain must not kill the benchmark — the JAX path
+        # already produced a valid headline
+        native_calls_per_sec, native_p50_ms = 0.0, 0.0
     steps_per_sec = bench_gnn_train()
+    # headline = the production serving path: native C++ scorer when the
+    # toolchain exists (config 5 "no GPU"), else the jitted JAX fallback
+    calls_per_sec = max(jax_calls_per_sec, native_calls_per_sec)
     print(
         json.dumps(
             {
@@ -90,7 +143,10 @@ def main() -> None:
                 "unit": "calls/s (40 candidates/call)",
                 "vs_baseline": round(calls_per_sec / 10_000, 3),
                 "extra": {
-                    "scoring_p50_ms": round(p50_ms, 3),
+                    "native_scoring_calls_per_sec": round(native_calls_per_sec, 1),
+                    "native_scoring_p50_ms": round(native_p50_ms, 4),
+                    "jax_scoring_calls_per_sec": round(jax_calls_per_sec, 1),
+                    "jax_scoring_p50_ms": round(jax_p50_ms, 3),
                     "gnn_train_steps_per_sec": round(steps_per_sec, 2),
                     "backend": jax.devices()[0].platform,
                 },

@@ -1,0 +1,17 @@
+"""Native serving runtime: C++ batched scorer behind ctypes.
+
+The reference's online-inference plan was a TF-Serving RPC per scheduling
+round (pkg/rpc/tfserving/client/client_v1.go:82-102, never wired in). The
+TPU-native replacement (SURVEY.md §2.1, north-star config 5) is an exported
+CPU artifact scored in-process: JAX computes and caches the GraphSAGE node
+embeddings at refresh time, the C++ library scores (child, parent) batches
+through the MLP head with no Python/JAX on the hot path.
+"""
+
+from dragonfly2_tpu.native.scorer import (
+    NativeScorer,
+    build_native_lib,
+    export_scorer_artifact,
+)
+
+__all__ = ["NativeScorer", "build_native_lib", "export_scorer_artifact"]

@@ -1,0 +1,191 @@
+// Native batched parent scorer: the XLA-AOT-style serving artifact.
+//
+// Serving equivalent of the reference's intended TensorFlow-Serving Predict
+// hop (pkg/rpc/tfserving/client/client_v1.go:82-102), replaced per SURVEY.md
+// §2.1 by a compiled CPU artifact linked into the scheduler process — no RPC,
+// no Python, no JAX runtime on the hot path. The trainer exports cached
+// GraphSAGE node embeddings plus the pairwise MLP head (models/graphsage.py
+// TopoScorer.head: Dense→gelu→Dense→gelu→Dense→sigmoid) into a flat binary;
+// this library mmap-loads it and scores a batch of (child, parent, features)
+// candidates per call.
+//
+// Build: g++ -O3 -shared -fPIC -o libdfscorer.so scorer.cc  (see scorer.py)
+//
+// Artifact layout (little-endian):
+//   u32 magic 0x44465343 ("DFSC")  u32 version=1
+//   u32 N (nodes)  u32 D (embed dim)  u32 FP (pair-feature dim)
+//   u32 H1  u32 H2 (head hidden dims)
+//   f32 z[N*D]                      cached node embeddings (row-major)
+//   f32 W1[(3D+FP)*H1]  f32 b1[H1]  head layer 0 (kernel column-major-in =
+//   f32 W2[H1*H2]       f32 b2[H2]    flax [in, out] row-major)
+//   f32 W3[H2*1]        f32 b3[1]
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+namespace {
+
+constexpr uint32_t kMagic = 0x44465343u;
+constexpr uint32_t kVersion = 1u;
+
+struct Header {
+  uint32_t magic, version, n, d, fp, h1, h2;
+};
+
+inline float gelu(float x) {
+  // tanh approximation — matches jax.nn.gelu(approximate=True), the flax
+  // default used by TopoScorer.head
+  const float kC = 0.7978845608028654f;  // sqrt(2/pi)
+  return 0.5f * x * (1.0f + std::tanh(kC * (x + 0.044715f * x * x * x)));
+}
+
+inline float sigmoidf(float x) { return 1.0f / (1.0f + std::exp(-x)); }
+
+// Y[B, out] = X[B, in] · W[in, out] + bias  (W row-major [in][out], flax
+// layout). Loop order (i, b, o): each W row streams through cache once per
+// batch instead of once per sample — the weight matrices dominate memory
+// traffic at the ~40-candidate batch sizes the scheduler sends.
+void gemm(const float* __restrict__ X, const float* __restrict__ W,
+          const float* __restrict__ bias, float* __restrict__ Y, int B, int in,
+          int out) {
+  for (int b = 0; b < B; ++b) {
+    float* Yrow = Y + static_cast<size_t>(b) * out;
+    for (int o = 0; o < out; ++o) Yrow[o] = bias[o];
+  }
+  // 8-way unroll over the contraction dim: one pass over the Y slab handles
+  // 8 input features (8 W rows live in L1), cutting accumulator re-stream
+  // traffic 8x versus the naive (i, b, o) order.
+  int i = 0;
+  for (; i + 8 <= in; i += 8) {
+    const float* W0 = W + static_cast<size_t>(i) * out;
+    for (int b = 0; b < B; ++b) {
+      const float* xb = X + static_cast<size_t>(b) * in + i;
+      const float x0 = xb[0], x1 = xb[1], x2 = xb[2], x3 = xb[3];
+      const float x4 = xb[4], x5 = xb[5], x6 = xb[6], x7 = xb[7];
+      float* Yrow = Y + static_cast<size_t>(b) * out;
+      for (int o = 0; o < out; ++o) {
+        Yrow[o] += x0 * W0[o] + x1 * W0[out + o] + x2 * W0[2 * out + o] +
+                   x3 * W0[3 * out + o] + x4 * W0[4 * out + o] +
+                   x5 * W0[5 * out + o] + x6 * W0[6 * out + o] +
+                   x7 * W0[7 * out + o];
+      }
+    }
+  }
+  for (; i < in; ++i) {
+    const float* Wrow = W + static_cast<size_t>(i) * out;
+    for (int b = 0; b < B; ++b) {
+      const float xi = X[static_cast<size_t>(b) * in + i];
+      float* Yrow = Y + static_cast<size_t>(b) * out;
+      for (int o = 0; o < out; ++o) Yrow[o] += xi * Wrow[o];
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+struct DfScorer {
+  Header hdr;
+  std::vector<float> z, w1, b1, w2, b2, w3, b3;
+};
+
+DfScorer* df_scorer_load(const char* path) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  DfScorer* s = new DfScorer();
+  bool ok = std::fread(&s->hdr, sizeof(Header), 1, f) == 1 &&
+            s->hdr.magic == kMagic && s->hdr.version == kVersion;
+  if (ok) {
+    const Header& h = s->hdr;
+    const uint32_t in = 3 * h.d + h.fp;
+    auto rd = [&](std::vector<float>& v, size_t count) {
+      v.resize(count);
+      return std::fread(v.data(), sizeof(float), count, f) == count;
+    };
+    ok = rd(s->z, (size_t)h.n * h.d) && rd(s->w1, (size_t)in * h.h1) &&
+         rd(s->b1, h.h1) && rd(s->w2, (size_t)h.h1 * h.h2) && rd(s->b2, h.h2) &&
+         rd(s->w3, h.h2) && rd(s->b3, 1);
+  }
+  std::fclose(f);
+  if (!ok) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void df_scorer_free(DfScorer* s) { delete s; }
+
+int32_t df_scorer_num_nodes(const DfScorer* s) { return (int32_t)s->hdr.n; }
+int32_t df_scorer_embed_dim(const DfScorer* s) { return (int32_t)s->hdr.d; }
+int32_t df_scorer_feature_dim(const DfScorer* s) { return (int32_t)s->hdr.fp; }
+
+// Score `batch` (child, parent) pairs; feats is [batch, FP] row-major.
+// Returns 0 on success, -1 on an out-of-range node index.
+int32_t df_scorer_score(const DfScorer* s, const int32_t* child,
+                        const int32_t* parent, const float* feats,
+                        int32_t batch, float* out) {
+  const Header& h = s->hdr;
+  const int32_t in_dim = 3 * h.d + h.fp;
+  // validate all indices up front, then run three batched GEMMs
+  for (int32_t b = 0; b < batch; ++b) {
+    const int32_t c = child[b], p = parent[b];
+    if (c < 0 || p < 0 || (uint32_t)c >= h.n || (uint32_t)p >= h.n) return -1;
+  }
+  std::vector<float> x((size_t)batch * in_dim);
+  std::vector<float> y1((size_t)batch * h.h1), y2((size_t)batch * h.h2);
+
+  // Slice the batch across threads when OpenMP is available (TPU-VM serving
+  // hosts have dozens of cores; the container CI has one and runs the serial
+  // path). Each slice runs the full pipeline independently.
+  int slices = 1;
+#ifdef _OPENMP
+  slices = std::min<int>(omp_get_max_threads(), std::max<int32_t>(1, batch / 8));
+#endif
+  const int32_t chunk = (batch + slices - 1) / slices;
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static) num_threads(slices)
+#endif
+  for (int si = 0; si < slices; ++si) {
+    const int32_t b0 = si * chunk;
+    const int32_t bn = std::min<int32_t>(batch - b0, chunk);
+    if (bn <= 0) continue;
+    for (int32_t b = b0; b < b0 + bn; ++b) {
+      float* xb = x.data() + (size_t)b * in_dim;
+      const float* zc = s->z.data() + (size_t)child[b] * h.d;
+      const float* zp = s->z.data() + (size_t)parent[b] * h.d;
+      for (uint32_t i = 0; i < h.d; ++i) {
+        xb[i] = zc[i];
+        xb[h.d + i] = zp[i];
+        xb[2 * h.d + i] = zc[i] * zp[i];
+      }
+      std::memcpy(xb + 3 * h.d, feats + (size_t)b * h.fp, h.fp * sizeof(float));
+    }
+    float* x0 = x.data() + (size_t)b0 * in_dim;
+    float* y1p = y1.data() + (size_t)b0 * h.h1;
+    float* y2p = y2.data() + (size_t)b0 * h.h2;
+    gemm(x0, s->w1.data(), s->b1.data(), y1p, bn, in_dim, h.h1);
+    for (size_t i = 0; i < (size_t)bn * h.h1; ++i) y1p[i] = gelu(y1p[i]);
+    gemm(y1p, s->w2.data(), s->b2.data(), y2p, bn, h.h1, h.h2);
+    for (size_t i = 0; i < (size_t)bn * h.h2; ++i) y2p[i] = gelu(y2p[i]);
+    for (int32_t b = b0; b < b0 + bn; ++b) {
+      const float* yb = y2.data() + (size_t)b * h.h2;
+      float o = s->b3[0];
+      for (uint32_t i = 0; i < h.h2; ++i) o += yb[i] * s->w3[i];
+      out[b] = sigmoidf(o);
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,175 @@
+"""Artifact export + ctypes binding for the C++ batched scorer (scorer.cc).
+
+Flow (north-star config 5):
+  1. trainer finishes → `export_scorer_artifact(params, z, path)` flattens the
+     TopoScorer head weights + cached embeddings into scorer.cc's binary format
+  2. `build_native_lib()` compiles scorer.cc once (g++ -O3, cached by mtime)
+  3. `NativeScorer(artifact)` loads both and serves `score()` with the same
+     batch signature as models.scorer.GNNScorer — drop-in for the scheduler's
+     `ml` evaluator slot, no JAX runtime on the hot path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import struct
+import subprocess
+import tempfile
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_MAGIC = 0x44465343
+_VERSION = 1
+_SRC = Path(__file__).with_name("scorer.cc")
+
+
+def _default_lib_path() -> Path:
+    # per-user cache dir: the .so is CDLL-loaded, so a predictable path in a
+    # world-writable tmp dir would be a cross-user code-injection vector
+    override = os.environ.get("DRAGONFLY_NATIVE_CACHE")
+    if override:
+        cache = Path(override)
+    else:
+        xdg = os.environ.get("XDG_CACHE_HOME") or os.path.expanduser("~/.cache")
+        cache = Path(xdg) / "dragonfly2_tpu_native"
+    cache.mkdir(parents=True, exist_ok=True)
+    os.chmod(cache, 0o700)
+    return cache / "libdfscorer.so"
+
+
+def build_native_lib(*, force: bool = False, lib_path: Path | None = None) -> Path:
+    """Compile scorer.cc → shared library (cached; rebuilt when stale)."""
+    lib = lib_path or _default_lib_path()
+    if not force and lib.exists() and lib.stat().st_mtime >= _SRC.stat().st_mtime:
+        return lib
+    lib.parent.mkdir(parents=True, exist_ok=True)
+    tmp = lib.with_name(lib.name + f".{os.getpid()}.tmp")
+    base = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-ffast-math",
+            "-funroll-loops", "-o", str(tmp), str(_SRC)]
+    # best → portable: native SIMD + OpenMP, then native SIMD, then plain
+    for extra in (["-march=native", "-fopenmp"], ["-march=native"], []):
+        try:
+            subprocess.run(base + extra, check=True, capture_output=True, text=True)
+            break
+        except subprocess.CalledProcessError as e:
+            err = e.stderr
+    else:
+        raise RuntimeError(f"native scorer build failed:\n{err}")
+    tmp.replace(lib)
+    logger.info("built native scorer lib at %s", lib)
+    return lib
+
+
+def export_scorer_artifact(params: Any, z: np.ndarray, path: str | Path) -> Path:
+    """Write the binary scoring artifact: cached embeddings + head weights.
+
+    params: the TopoScorer flax variables ({'params': {'head': {'layers_0':
+    ...}}}); z: [N, D] float32 node embeddings from TopoScorer.embed.
+    """
+    head = params["params"]["head"]
+    w1 = np.asarray(head["layers_0"]["kernel"], np.float32)
+    b1 = np.asarray(head["layers_0"]["bias"], np.float32)
+    w2 = np.asarray(head["layers_2"]["kernel"], np.float32)
+    b2 = np.asarray(head["layers_2"]["bias"], np.float32)
+    w3 = np.asarray(head["layers_4"]["kernel"], np.float32)
+    b3 = np.asarray(head["layers_4"]["bias"], np.float32)
+    z = np.ascontiguousarray(np.asarray(z, np.float32))
+
+    n, d = z.shape
+    in_dim, h1 = w1.shape
+    fp = in_dim - 3 * d
+    if fp < 0:
+        raise ValueError(f"head input {in_dim} < 3*embed_dim {3*d}: wrong params/z pairing")
+    if w2.shape != (h1, w2.shape[1]) or w3.shape[0] != w2.shape[1] or w3.shape[1] != 1:
+        raise ValueError(f"unexpected head shapes: {w1.shape}, {w2.shape}, {w3.shape}")
+    h2 = w2.shape[1]
+
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "wb") as f:
+        f.write(struct.pack("<7I", _MAGIC, _VERSION, n, d, fp, h1, h2))
+        for arr in (z, w1, b1, w2, b2, w3, b3):
+            f.write(np.ascontiguousarray(arr, np.float32).tobytes())
+    tmp.replace(path)
+    return path
+
+
+class NativeScorer:
+    """ctypes binding with GNNScorer's batch-score interface.
+
+    `score(pair_feats, child=, parent=)` → [B] float32 in (0, 1). `ready` is
+    always True once constructed (embeddings ship inside the artifact).
+    """
+
+    def __init__(self, artifact_path: str | Path, *, lib_path: Path | None = None):
+        lib = build_native_lib(lib_path=lib_path)
+        self._dll = ctypes.CDLL(str(lib))
+        self._dll.df_scorer_load.restype = ctypes.c_void_p
+        self._dll.df_scorer_load.argtypes = [ctypes.c_char_p]
+        self._dll.df_scorer_free.argtypes = [ctypes.c_void_p]
+        for fn in ("df_scorer_num_nodes", "df_scorer_embed_dim", "df_scorer_feature_dim"):
+            getattr(self._dll, fn).restype = ctypes.c_int32
+            getattr(self._dll, fn).argtypes = [ctypes.c_void_p]
+        self._dll.df_scorer_score.restype = ctypes.c_int32
+        self._dll.df_scorer_score.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        self._handle = self._dll.df_scorer_load(str(artifact_path).encode())
+        if not self._handle:
+            raise IOError(f"failed to load scorer artifact {artifact_path}")
+        self.num_nodes = self._dll.df_scorer_num_nodes(self._handle)
+        self.embed_dim = self._dll.df_scorer_embed_dim(self._handle)
+        self.feature_dim = self._dll.df_scorer_feature_dim(self._handle)
+
+    @property
+    def ready(self) -> bool:
+        return True
+
+    def score(
+        self, pair_feats: np.ndarray, *, child: np.ndarray, parent: np.ndarray
+    ) -> np.ndarray:
+        feats = np.ascontiguousarray(pair_feats, np.float32)
+        c = np.ascontiguousarray(child, np.int32)
+        p = np.ascontiguousarray(parent, np.int32)
+        batch = len(c)
+        if len(p) != batch:
+            raise ValueError(f"child/parent length mismatch: {batch} != {len(p)}")
+        if feats.shape != (batch, self.feature_dim):
+            raise ValueError(
+                f"pair_feats shape {feats.shape} != ({batch}, {self.feature_dim})"
+            )
+        out = np.empty(batch, np.float32)
+        rc = self._dll.df_scorer_score(
+            self._handle,
+            c.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            p.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            feats.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            batch,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        if rc != 0:
+            raise ValueError(f"native scorer rejected batch (rc={rc}): bad node index")
+        return out
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._dll.df_scorer_free(self._handle)
+            self._handle = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass

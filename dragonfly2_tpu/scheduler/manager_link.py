@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import socket
 from typing import Optional
 
@@ -270,8 +271,16 @@ class ManagerLink:
         from dragonfly2_tpu.models.scorer import GNNScorer
         from dragonfly2_tpu.trainer import artifacts
 
-        model, params = artifacts.load_gnn(path)
         graph, host_index = artifacts.load_graph(path)
+        if os.environ.get("DRAGONFLY_NATIVE_SCORER", "1") != "0":
+            try:
+                native = artifacts.load_native(path)
+                if native is not None:
+                    logger.info("serving model via native scorer (%s)", path)
+                    return native, host_index
+            except Exception:
+                logger.exception("native scorer unavailable; falling back to JAX")
+        model, params = artifacts.load_gnn(path)
         scorer = GNNScorer(model, params)
         scorer.refresh(graph)
         return scorer, host_index

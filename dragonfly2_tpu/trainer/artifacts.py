@@ -90,6 +90,27 @@ def load_graph(directory: str | Path) -> tuple[Any, dict[str, int]]:
     return graph, {k: int(v) for k, v in host_index.items()}
 
 
+def save_native(directory: str | Path, model: TopoScorer, params: Any, graph: Any) -> Path:
+    """Export the native serving artifact beside the flax one: compute the
+    cached node embeddings once in JAX, then flatten head weights + embeddings
+    into the C++ scorer's binary format (native/scorer.cc; replaces the
+    reference's TF-Serving hop, tfserving/client_v1.go:82-102)."""
+    from dragonfly2_tpu.native import export_scorer_artifact
+
+    z = np.asarray(jax.jit(lambda p, g: model.apply(p, g, method=model.embed))(params, graph))
+    return export_scorer_artifact(params, z, Path(directory) / "scorer.dfsc")
+
+
+def load_native(directory: str | Path):
+    """Load the native scorer if its artifact exists, else None."""
+    from dragonfly2_tpu.native import NativeScorer
+
+    path = Path(directory) / "scorer.dfsc"
+    if not path.exists():
+        return None
+    return NativeScorer(path)
+
+
 def load_mlp(directory: str | Path) -> tuple[BandwidthMLP, Any]:
     cfg = load_config(directory)
     assert cfg["type"] == "mlp", cfg

@@ -193,6 +193,11 @@ class TrainerService:
                 },
             )
             artifacts.save_graph(path, ds.graph, ds.host_index)
+            try:
+                artifacts.save_native(path, train_gnn.make_model(cfg), state.params, ds.graph)
+            except Exception:
+                # native serving is an optimization; the flax artifact always works
+                logger.exception("native scorer export failed; flax artifact only")
             out["gnn"] = {"artifact": str(path), "evaluation": evaluation}
         return out
 
