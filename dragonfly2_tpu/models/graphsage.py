@@ -42,15 +42,26 @@ class SAGELayer(nn.Module):
 
     @nn.compact
     def __call__(self, h: jnp.ndarray, g: TopoGraph) -> jnp.ndarray:
+        # Pre-projection decomposition: the naive form projects the
+        # [N, K, 2H+E] concat of (neighbor state, self state, edge feats)
+        # through one Dense — K times the FLOPs per node state. Algebraically
+        # W·[hn; hs; e] = Wn·hn + Ws·hs + We·e, so project each term at its
+        # natural rank instead: node projections are [N, H]·[H, F] (no K),
+        # only the tiny edge term stays per-edge. ~(2H+E)/(2H/K+E) ≈ 7x fewer
+        # MACs at K=16, and every matmul is a clean MXU shape.
         h = h.astype(self.dtype)
-        hn = neighbor_gather(h, g.neighbors)  # [N, K, H]
-        msg_in = jnp.concatenate(
-            [hn, jnp.broadcast_to(h[:, None, :], hn.shape), g.edge_feats.astype(self.dtype)],
-            axis=-1,
-        )
-        msg = nn.gelu(
-            nn.Dense(self.features, dtype=self.dtype, param_dtype=jnp.float32)(msg_in)
-        )
+        u = nn.Dense(
+            self.features, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
+            name="msg_nbr",
+        )(h)
+        s = nn.Dense(
+            self.features, dtype=self.dtype, param_dtype=jnp.float32, name="msg_self"
+        )(h)
+        v = nn.Dense(
+            self.features, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
+            name="msg_edge",
+        )(g.edge_feats.astype(self.dtype))
+        msg = nn.gelu(neighbor_gather(u, g.neighbors) + s[:, None, :] + v)  # [N, K, F]
         agg = masked_mean(msg, g.mask.astype(self.dtype))  # [N, features]
         self_h = nn.Dense(self.features, dtype=self.dtype, param_dtype=jnp.float32)(h)
         out = nn.gelu(self_h + agg)

@@ -6,9 +6,9 @@ the topology graph is a *dense padded neighbor table* — `neighbors[N, K]`
 int32 with a boolean mask — so aggregation is static-shaped gather + masked
 mean + matmul, all of which XLA tiles onto the MXU with no dynamic shapes.
 
-The XLA path below is the default (and currently only) implementation; a
-fused Pallas variant of the same contract is the planned follow-up once it
-beats XLA's gather fusion on-chip.
+The XLA path below is the default; ops.neighbor_agg_pallas holds the fused
+MXU kernel for the same contract, auto-selected by `neighbor_aggregate`
+on TPU for VMEM-sized graphs.
 """
 
 from __future__ import annotations
@@ -36,9 +36,18 @@ def masked_mean(x: jnp.ndarray, mask: jnp.ndarray, *, eps: float = 1e-6) -> jnp.
 
 
 def neighbor_aggregate(
-    h: jnp.ndarray, neighbors: jnp.ndarray, mask: jnp.ndarray
+    h: jnp.ndarray, neighbors: jnp.ndarray, mask: jnp.ndarray, *, impl: str = "auto"
 ) -> jnp.ndarray:
-    """Fused gather + masked mean: [N, H] -> [N, H] neighborhood means."""
+    """Gather + masked mean: [N, H] -> [N, H] neighborhood means.
+
+    impl: "auto" (Pallas on TPU when the graph fits VMEM, else XLA),
+    "pallas", or "xla".
+    """
+    if impl != "xla":
+        from dragonfly2_tpu.ops import neighbor_agg_pallas as pk
+
+        if impl == "pallas" or (impl == "auto" and pk.supports_pallas(h)):
+            return pk.neighbor_aggregate_pallas(h, neighbors, mask)
     return masked_mean(neighbor_gather(h, neighbors), mask)
 
 

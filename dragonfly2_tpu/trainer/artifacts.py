@@ -22,6 +22,17 @@ from dragonfly2_tpu.models.graphsage import TopoScorer
 from dragonfly2_tpu.models.mlp import BandwidthMLP
 
 
+# Bumped whenever the flax param-tree structure changes (renamed/reshaped
+# modules make from_bytes fail); loaders refuse mismatched artifacts with a
+# clear error instead of a pytree exception deep in deserialization.
+# 2: SAGELayer pre-projection decomposition (msg_nbr/msg_self/msg_edge).
+ARTIFACT_FORMAT = 2
+
+
+class IncompatibleArtifact(Exception):
+    pass
+
+
 def save_artifact(
     directory: str | Path, *, model_type: str, version: str, params: Any, config: dict
 ) -> Path:
@@ -29,9 +40,18 @@ def save_artifact(
     d.mkdir(parents=True, exist_ok=True)
     (d / "params.msgpack").write_bytes(flax.serialization.to_bytes(params))
     (d / "config.json").write_text(
-        json.dumps({"type": model_type, "version": version, **config})
+        json.dumps({"type": model_type, "version": version, "format": ARTIFACT_FORMAT, **config})
     )
     return d
+
+
+def _check_format(cfg: dict, directory: Any) -> None:
+    fmt = cfg.get("format", 1)
+    if fmt != ARTIFACT_FORMAT:
+        raise IncompatibleArtifact(
+            f"artifact {directory} has format {fmt}, this build expects "
+            f"{ARTIFACT_FORMAT}; retrain to republish"
+        )
 
 
 def load_config(directory: str | Path) -> dict:
@@ -41,6 +61,7 @@ def load_config(directory: str | Path) -> dict:
 def load_gnn(directory: str | Path) -> tuple[TopoScorer, Any]:
     cfg = load_config(directory)
     assert cfg["type"] == "gnn", cfg
+    _check_format(cfg, directory)
     model = TopoScorer(
         hidden=cfg["hidden"], embed_dim=cfg["embed_dim"], num_layers=cfg["num_layers"]
     )
@@ -114,6 +135,7 @@ def load_native(directory: str | Path):
 def load_mlp(directory: str | Path) -> tuple[BandwidthMLP, Any]:
     cfg = load_config(directory)
     assert cfg["type"] == "mlp", cfg
+    _check_format(cfg, directory)
     model = BandwidthMLP(hidden=tuple(cfg["hidden"]))
     from dragonfly2_tpu.models.features import FEATURE_DIM
 

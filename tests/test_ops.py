@@ -1,0 +1,101 @@
+"""Aggregation op tests: XLA reference vs fused Pallas kernel (interpret
+mode on CPU; the real-chip path is exercised by bench.py)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.ops.neighbor_agg import masked_mean, neighbor_aggregate, neighbor_gather
+from dragonfly2_tpu.ops.neighbor_agg_pallas import neighbor_aggregate_pallas
+
+
+def _random_graph(n=100, k=7, h=33, seed=0):
+    rng = np.random.default_rng(seed)
+    states = rng.normal(size=(n, h)).astype(np.float32)
+    neighbors = rng.integers(0, n, size=(n, k)).astype(np.int32)
+    mask = (rng.random((n, k)) < 0.7).astype(np.float32)
+    return jnp.asarray(states), jnp.asarray(neighbors), jnp.asarray(mask)
+
+
+def test_xla_reference_masked_mean():
+    h, nbr, mask = _random_graph()
+    out = neighbor_aggregate(h, nbr, mask, impl="xla")
+    # row 0 by hand
+    m = np.asarray(mask[0])
+    rows = np.asarray(h)[np.asarray(nbr[0])]
+    want = (rows * m[:, None]).sum(0) / (m.sum() + 1e-6)
+    np.testing.assert_allclose(np.asarray(out[0]), want, rtol=1e-5)
+
+
+@pytest.mark.parametrize("n,k,hdim", [(100, 7, 33), (128, 16, 256), (257, 4, 64), (1, 2, 8)])
+def test_pallas_matches_xla(n, k, hdim):
+    h, nbr, mask = _random_graph(n, k, hdim)
+    want = neighbor_aggregate(h, nbr, mask, impl="xla")
+    got = neighbor_aggregate_pallas(h, nbr, mask, interpret=True)
+    assert got.shape == (n, hdim)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5)
+
+
+def test_pallas_fully_masked_row_is_zero():
+    h, nbr, mask = _random_graph(64, 4, 16)
+    mask = mask.at[3].set(0.0)
+    got = neighbor_aggregate_pallas(h, nbr, mask, interpret=True)
+    np.testing.assert_allclose(np.asarray(got[3]), np.zeros(16), atol=1e-6)
+
+
+def test_pallas_duplicate_neighbors_counted():
+    # node 0's neighbor list is [1, 1]: mean must equal h[1]
+    h = jnp.asarray(np.arange(12, dtype=np.float32).reshape(3, 4))
+    nbr = jnp.asarray([[1, 1], [0, 2], [0, 1]], jnp.int32)
+    mask = jnp.ones((3, 2), jnp.float32)
+    got = neighbor_aggregate_pallas(h, nbr, mask, interpret=True)
+    np.testing.assert_allclose(np.asarray(got[0]), np.asarray(h[1]), rtol=1e-5)
+
+
+def test_pallas_bfloat16_states():
+    h, nbr, mask = _random_graph(128, 8, 64)
+    want = neighbor_aggregate(h.astype(jnp.bfloat16), nbr, mask, impl="xla")
+    got = neighbor_aggregate_pallas(h.astype(jnp.bfloat16), nbr, mask, interpret=True)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32), rtol=3e-2, atol=3e-2
+    )
+
+
+def test_pallas_grad_matches_xla():
+    import jax
+
+    h, nbr, mask = _random_graph(96, 5, 24)
+
+    def loss_pallas(hh):
+        return jnp.sum(neighbor_aggregate_pallas(hh, nbr, mask, interpret=True) ** 2)
+
+    def loss_xla(hh):
+        return jnp.sum(masked_mean(neighbor_gather(hh, nbr), mask) ** 2)
+
+    g1 = jax.grad(loss_pallas)(h)
+    g2 = jax.grad(loss_xla)(h)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-3, atol=1e-5)
+
+
+def test_supports_pallas_vmem_guard():
+    from dragonfly2_tpu.ops.neighbor_agg_pallas import supports_pallas
+
+    small = jnp.zeros((1024, 256), jnp.float32)
+    huge = jnp.zeros((8192, 1024), jnp.float32)  # 32 MB of states alone
+    # on CPU both return False (platform gate) but the size math must hold
+    assert not supports_pallas(huge) or small is None
+    # check the budget arithmetic directly: huge working set exceeds budget
+    from dragonfly2_tpu.ops.neighbor_agg_pallas import TILE_N, VMEM_BUDGET_BYTES
+
+    n, hd = huge.shape
+    ws = TILE_N * n * 4 + n * hd * 4 + TILE_N * hd * 4
+    assert ws > VMEM_BUDGET_BYTES
+
+
+def test_auto_dispatch_on_cpu_uses_xla():
+    # CPU backend: auto must not route into pallas (which needs a TPU)
+    h, nbr, mask = _random_graph(32, 4, 8)
+    out = neighbor_aggregate(h, nbr, mask, impl="auto")
+    want = masked_mean(neighbor_gather(h, nbr), mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), rtol=1e-6)
