@@ -1,0 +1,82 @@
+"""dfmodel: publish / fetch model checkpoints over the P2P fabric.
+
+The config-4 CLI (no reference equivalent — SURVEY.md §2.4 flags this as the
+new TPU-VM component): `publish` imports a checkpoint directory into the P2P
+cache and emits a manifest; `fetch` pulls a manifest's files onto this host
+through the piece engine (warm peers serve over DCN, origin touched once per
+cluster).
+
+  python -m dragonfly2_tpu.cli.dfmodel publish ./llama-3-8b
+  python -m dragonfly2_tpu.cli.dfmodel fetch ./llama-3-8b/dragonfly-checkpoint.json -O ./staged
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+from dragonfly2_tpu.cli.dfget import DEFAULT_SOCK, ensure_daemon
+from dragonfly2_tpu.rpc.core import RpcClient, RpcError
+
+
+async def _amain(args: argparse.Namespace) -> int:
+    if not await ensure_daemon(
+        args.sock, args.scheduler, args.storage,
+        no_spawn=args.no_spawn, spawn_timeout=args.spawn_timeout,
+    ):
+        return 1
+    client = RpcClient(args.sock, timeout=args.timeout)
+    try:
+        # abspath everything: the detached daemon's cwd is not ours
+        if args.cmd == "publish":
+            result = await client.call(
+                "publish_checkpoint",
+                {"directory": os.path.abspath(args.directory), "name": args.name},
+            )
+            print(json.dumps(result))
+        elif args.cmd == "fetch":
+            manifest = args.manifest
+            if "://" not in manifest:
+                manifest = os.path.abspath(manifest)
+            result = await client.call(
+                "fetch_checkpoint",
+                {
+                    "manifest": manifest,
+                    "dest": os.path.abspath(args.output),
+                    "concurrency": args.concurrency,
+                },
+            )
+            print(json.dumps(result))
+        return 0
+    except RpcError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    finally:
+        await client.close()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="dfmodel", description="P2P checkpoint fan-out CLI")
+    ap.add_argument("--sock", default=DEFAULT_SOCK)
+    ap.add_argument("--scheduler", default=None, help="scheduler addr (spawn only)")
+    ap.add_argument("--storage", default=None, help="daemon storage root (spawn only)")
+    ap.add_argument("--timeout", type=float, default=3600.0)
+    ap.add_argument("--spawn-timeout", type=float, default=15.0)
+    ap.add_argument("--no-spawn", action="store_true")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("publish", help="import a checkpoint dir into the P2P cache")
+    p.add_argument("directory")
+    p.add_argument("--name", default="")
+    p = sub.add_parser("fetch", help="pull a manifest's files through P2P")
+    p.add_argument("manifest", help="manifest path or URL")
+    p.add_argument("-O", "--output", required=True)
+    p.add_argument("--concurrency", type=int, default=4)
+    args = ap.parse_args()
+    sys.exit(asyncio.run(_amain(args)))
+
+
+if __name__ == "__main__":
+    main()

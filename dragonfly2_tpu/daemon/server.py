@@ -23,7 +23,7 @@ logger = logging.getLogger("daemon")
 
 DAEMON_METHODS = [
     "download", "stat_task", "delete_task", "export_task", "host_info",
-    "trigger_seed", "import_file",
+    "trigger_seed", "import_file", "publish_checkpoint", "fetch_checkpoint",
 ]
 
 
@@ -103,6 +103,35 @@ class DaemonRpcAdapter:
             p["path"], tag=p.get("tag", ""), application=p.get("application", "")
         )
         return {"task_id": ts.meta.task_id, "pieces": ts.finished_count()}
+
+    async def publish_checkpoint(self, p: dict) -> dict:
+        """Import a checkpoint dir into the P2P cache (tpuvm fan-out,
+        north-star config 4)."""
+        from dragonfly2_tpu.tpuvm.checkpoint import publish_checkpoint
+
+        manifest = await publish_checkpoint(
+            self.engine, p["directory"], name=p.get("name", "")
+        )
+        return {
+            "name": manifest.name,
+            "files": len(manifest.files),
+            "total_bytes": manifest.total_bytes,
+            "manifest": str(p["directory"]).rstrip("/") + "/dragonfly-checkpoint.json",
+        }
+
+    async def fetch_checkpoint(self, p: dict) -> dict:
+        from dragonfly2_tpu.tpuvm.checkpoint import fetch_checkpoint, fetch_manifest
+
+        manifest = await fetch_manifest(self.engine, p["manifest"])
+        dest = await fetch_checkpoint(
+            self.engine, manifest, p["dest"], concurrency=int(p.get("concurrency", 4))
+        )
+        return {
+            "name": manifest.name,
+            "files": len(manifest.files),
+            "total_bytes": manifest.total_bytes,
+            "dest": str(dest),
+        }
 
 
 async def run_daemon(

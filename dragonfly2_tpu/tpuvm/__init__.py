@@ -1,0 +1,26 @@
+"""TPU-VM-aware checkpoint distribution + staging (north-star config 4).
+
+No reference equivalent (SURVEY.md §2.4: "TPU-VM-aware storage backend — new
+component"): the reference moves container layers; TPU pods move model
+checkpoints. This package fans safetensors checkpoints out across pod hosts
+over DCN with the P2P piece engine (each file one digest-keyed task, so every
+host downloads from peers instead of hammering the origin store), then stages
+tensors onto local devices shard-by-shard via memmap + device_put — only the
+bytes this host's mesh slice needs ever leave the page cache.
+"""
+
+from dragonfly2_tpu.tpuvm.safetensors import (
+    read_header,
+    read_header_ex,
+    read_tensor,
+    tensor_names,
+    write_safetensors,
+)
+
+__all__ = [
+    "read_header",
+    "read_header_ex",
+    "read_tensor",
+    "tensor_names",
+    "write_safetensors",
+]

@@ -1,0 +1,179 @@
+"""TPU-VM checkpoint fan-out + staging tests (north-star config 4):
+safetensors round-trip, P2P publish/fetch between engines, sharded
+device_put staging on the virtual 8-device CPU mesh."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.daemon.engine import InProcessSchedulerClient
+from dragonfly2_tpu.scheduler.service import SchedulerService
+from dragonfly2_tpu.tpuvm import safetensors as stlib
+from dragonfly2_tpu.tpuvm.checkpoint import (
+    Manifest,
+    fetch_checkpoint,
+    fetch_manifest,
+    publish_checkpoint,
+)
+from dragonfly2_tpu.tpuvm.staging import stage_checkpoint_dir, stage_tensor, stage_tensors
+from tests.test_e2e import make_engine
+
+
+class TestSafetensors:
+    def test_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(0)
+        tensors = {
+            "layers.0.w": rng.normal(size=(16, 32)).astype(np.float32),
+            "layers.0.b": rng.normal(size=(32,)).astype(np.float32),
+            "tok.embed": rng.integers(0, 100, size=(10, 4)).astype(np.int32),
+        }
+        p = stlib.write_safetensors(tmp_path / "m.safetensors", tensors, metadata={"v": "1"})
+        assert sorted(stlib.tensor_names(p)) == sorted(tensors)
+        hdr = stlib.read_header(p)
+        assert hdr["__metadata__"] == {"v": "1"}
+        for name, want in tensors.items():
+            got = stlib.read_tensor(p, name)
+            np.testing.assert_array_equal(np.asarray(got), want)
+
+    def test_bf16_raw_bits(self, tmp_path):
+        import ml_dtypes
+
+        x = np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16)
+        raw = x.view(np.uint16)
+        p = stlib.write_safetensors(
+            tmp_path / "b.safetensors", {"w": raw}, bf16_names=["w"]
+        )
+        hdr = stlib.read_header(p)
+        assert hdr["w"]["dtype"] == "BF16"
+        back = stlib.read_tensor(p, "w").view(ml_dtypes.bfloat16)
+        np.testing.assert_array_equal(back.astype(np.float32), x.astype(np.float32))
+
+    def test_corrupt_file_rejected(self, tmp_path):
+        bad = tmp_path / "bad.safetensors"
+        bad.write_bytes(b"\xff" * 4)
+        with pytest.raises(stlib.SafetensorsError):
+            stlib.read_header(bad)
+
+
+class TestManifestSafety:
+    def test_traversal_entry_rejected(self, run, tmp_path):
+        from dragonfly2_tpu.tpuvm.checkpoint import ManifestEntry
+
+        async def body():
+            m = Manifest(name="evil", created_at=0.0, files=[
+                ManifestEntry(path="../../escape.bin", size=4, digest="sha256:" + "0" * 64, task_id="t" * 64),
+            ])
+            with pytest.raises(Exception) as ei:
+                await fetch_checkpoint(None, m, tmp_path / "dest")
+            # TaskGroup wraps in ExceptionGroup on 3.11+
+            msg = str(ei.value) + "".join(str(e) for e in getattr(ei.value, "exceptions", []))
+            assert "escapes destination" in msg
+            assert not (tmp_path / "escape.bin").exists()
+
+        run(body())
+
+    def test_stage_tensors_empty_names_stages_nothing(self, tmp_path):
+        p = stlib.write_safetensors(tmp_path / "s.safetensors", {"w": np.zeros(4, np.float32)})
+        assert stage_tensors(p, names=[]) == {}
+
+
+class TestFanout:
+    def test_publish_then_fetch_on_second_host(self, run, tmp_path):
+        rng = np.random.default_rng(1)
+        ckpt = tmp_path / "ckpt"
+        ckpt.mkdir()
+        stlib.write_safetensors(
+            ckpt / "model-00001.safetensors",
+            {"a": rng.normal(size=(64, 64)).astype(np.float32)},
+        )
+        stlib.write_safetensors(
+            ckpt / "model-00002.safetensors",
+            {"b": rng.normal(size=(128,)).astype(np.float32)},
+        )
+        (ckpt / "config.json").write_text(json.dumps({"model_type": "demo"}))
+
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            pub = make_engine(tmp_path, client, "pubhost")
+            sub = make_engine(tmp_path, client, "subhost")
+            await pub.start()
+            await sub.start()
+            try:
+                manifest = await publish_checkpoint(pub, ckpt, name="demo")
+                assert len(manifest.files) == 3  # 2 safetensors + config.json
+                assert manifest.total_bytes > 0
+                # manifest round-trips through its JSON form
+                m2 = await fetch_manifest(sub, str(ckpt / "dragonfly-checkpoint.json"))
+                assert [e.task_id for e in m2.files] == [e.task_id for e in manifest.files]
+
+                dest = tmp_path / "staged"
+                await fetch_checkpoint(sub, m2, dest)
+                for e in manifest.files:
+                    got = (dest / e.path).read_bytes()
+                    want = (ckpt / e.path).read_bytes()
+                    assert got == want
+                # second fetch is a no-op (digest match short-circuit)
+                await fetch_checkpoint(sub, m2, dest)
+            finally:
+                await pub.stop()
+                await sub.stop()
+
+        run(body())
+
+
+class TestStaging:
+    def test_stage_unsharded(self, tmp_path):
+        w = np.arange(64, dtype=np.float32).reshape(8, 8)
+        p = stlib.write_safetensors(tmp_path / "s.safetensors", {"w": w})
+        arr = stage_tensor(p, "w")
+        assert isinstance(arr, jax.Array)
+        np.testing.assert_array_equal(np.asarray(arr), w)
+
+    def test_stage_sharded_over_mesh(self, tmp_path):
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        devs = np.array(jax.devices()[:8]).reshape(4, 2)
+        mesh = Mesh(devs, ("data", "model"))
+        w = np.arange(32 * 16, dtype=np.float32).reshape(32, 16)
+        b = np.arange(16, dtype=np.float32)
+        p = stlib.write_safetensors(tmp_path / "s.safetensors", {"w": w, "b": b})
+
+        shardings = {
+            "w": NamedSharding(mesh, P("data", "model")),
+            "b": NamedSharding(mesh, P()),
+        }
+        out = stage_tensors(p, shardings=shardings)
+        np.testing.assert_array_equal(np.asarray(out["w"]), w)
+        np.testing.assert_array_equal(np.asarray(out["b"]), b)
+        # actually sharded: each addressable shard holds a slice
+        assert len(out["w"].addressable_shards) == 8
+        assert out["w"].addressable_shards[0].data.shape == (8, 8)
+
+    def test_stage_bf16_to_device(self, tmp_path):
+        import ml_dtypes
+
+        x = np.linspace(-2, 2, 16, dtype=np.float32).astype(ml_dtypes.bfloat16)
+        p = stlib.write_safetensors(
+            tmp_path / "bf.safetensors", {"w": x.view(np.uint16)}, bf16_names=["w"]
+        )
+        arr = stage_tensor(p, "w")
+        assert arr.dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(arr, np.float32), x.astype(np.float32)
+        )
+
+    def test_stage_checkpoint_dir_merges_files(self, tmp_path):
+        d = tmp_path / "ck"
+        d.mkdir()
+        stlib.write_safetensors(d / "a.safetensors", {"x": np.zeros(4, np.float32)})
+        stlib.write_safetensors(d / "b.safetensors", {"y": np.ones(4, np.float32)})
+        out = stage_checkpoint_dir(d)
+        assert sorted(out) == ["x", "y"]
+        # duplicate tensor names across files are an error
+        stlib.write_safetensors(d / "c.safetensors", {"x": np.zeros(2, np.float32)})
+        with pytest.raises(ValueError):
+            stage_checkpoint_dir(d)
