@@ -16,7 +16,6 @@ import os
 
 from dragonfly2_tpu.daemon.engine import PeerEngine
 from dragonfly2_tpu.rpc.core import RpcError, RpcServer
-from dragonfly2_tpu.rpc.scheduler import RemoteSchedulerClient
 from dragonfly2_tpu.utils.proc import run_until_signalled
 
 logger = logging.getLogger("daemon")
@@ -157,7 +156,25 @@ async def run_daemon(
     probe_interval: float | None = None,
     ready_event: asyncio.Event | None = None,
 ) -> None:
-    scheduler = RemoteSchedulerClient(scheduler_addr)
+    from dragonfly2_tpu.rpc.balancer import make_scheduler_client
+
+    # one address → plain client; "a:1,b:2" (or a manager address book) →
+    # consistent-hash balanced with live membership (ref pkg/resolver fed by
+    # dynconfig: the manager's scheduler list is the source of truth)
+    resolve = None
+    resolver_manager = None
+    if manager_addr:
+        from dragonfly2_tpu.rpc.manager import RemoteManagerClient
+
+        resolver_manager = RemoteManagerClient(manager_addr)
+
+        async def resolve() -> list[str]:
+            rows = await resolver_manager.list_schedulers(ip=ip)
+            return [f"{r['ip']}:{r['port']}" for r in rows if r.get("ip") and r.get("port")]
+
+    scheduler = make_scheduler_client(scheduler_addr, resolve=resolve)
+    if hasattr(scheduler, "start_resolver"):
+        scheduler.start_resolver()
     engine = PeerEngine(
         storage_root=storage_root,
         scheduler=scheduler,
@@ -225,10 +242,8 @@ async def run_daemon(
     if manager_addr and host_type == "seed":
         # only seed peers register with the manager (normal peers are known to
         # their scheduler via announce; ref client keepalive is daemon→manager
-        # only for seed address books)
-        from dragonfly2_tpu.rpc.manager import RemoteManagerClient
-
-        manager = RemoteManagerClient(manager_addr)
+        # only for seed address books); shares the resolver's connection
+        manager = resolver_manager
 
     async def announce_loop() -> None:
         """Keepalive + host stats to the scheduler (ref client/daemon/announcer:
@@ -273,8 +288,8 @@ async def run_daemon(
             await tcp_server.stop()
         await engine.stop()
         await scheduler.close()
-        if manager is not None:
-            await manager.close()
+        if resolver_manager is not None:
+            await resolver_manager.close()
         if os.path.exists(sock_path):
             os.unlink(sock_path)
 

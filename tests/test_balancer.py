@@ -1,0 +1,186 @@
+"""Consistent-hash balancer tests (ref pkg/balancer/consistent_hashing.go +
+pkg/resolver): ring stability, task affinity, peer-map routing, host fan-out,
+resolver membership change."""
+
+import asyncio
+
+import pytest
+
+from dragonfly2_tpu.rpc.balancer import (
+    BalancedSchedulerClient,
+    ConsistentHashRing,
+    make_scheduler_client,
+)
+from dragonfly2_tpu.rpc.core import RpcError
+from dragonfly2_tpu.rpc.scheduler import RemoteSchedulerClient
+from dragonfly2_tpu.scheduler.service import HostInfo, TaskMeta
+
+
+class TestRing:
+    def test_pick_deterministic_and_distributed(self):
+        addrs = [f"10.0.0.{i}:9000" for i in range(4)]
+        ring = ConsistentHashRing(addrs)
+        keys = [f"task-{i}" for i in range(2000)]
+        owners = {k: ring.pick(k) for k in keys}
+        assert owners == {k: ring.pick(k) for k in keys}  # deterministic
+        counts = {a: 0 for a in addrs}
+        for owner in owners.values():
+            counts[owner] += 1
+        for a, c in counts.items():
+            assert 250 < c < 850, f"{a} owns {c}/2000 — ring badly unbalanced"
+
+    def test_membership_change_moves_only_affected_keys(self):
+        addrs = [f"10.0.0.{i}:9000" for i in range(4)]
+        ring = ConsistentHashRing(addrs)
+        keys = [f"task-{i}" for i in range(2000)]
+        before = {k: ring.pick(k) for k in keys}
+        ring.remove(addrs[0])
+        after = {k: ring.pick(k) for k in keys}
+        moved = sum(1 for k in keys if before[k] != after[k])
+        lost = sum(1 for k in keys if before[k] == addrs[0])
+        assert moved == lost  # only the removed node's keys re-hash
+        ring.add(addrs[0])
+        assert {k: ring.pick(k) for k in keys} == before  # add restores
+
+    def test_empty_ring_raises(self):
+        with pytest.raises(RpcError):
+            ConsistentHashRing([]).pick("x")
+
+
+class FakeClient:
+    """Records calls; used as client_factory."""
+
+    instances: dict[str, "FakeClient"] = {}
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self.calls: list[tuple] = []
+        FakeClient.instances[addr] = self
+
+    async def register_peer(self, peer_id, meta, host):
+        self.calls.append(("register_peer", peer_id, meta.task_id))
+        from dragonfly2_tpu.scheduler.service import RegisterResult
+
+        return RegisterResult(scope="normal", task_id=meta.task_id, back_to_source=True)
+
+    async def report_piece_result(self, peer_id, piece_index, **kw):
+        self.calls.append(("report_piece_result", peer_id, piece_index))
+
+    async def report_peer_result(self, peer_id, **kw):
+        self.calls.append(("report_peer_result", peer_id))
+
+    async def announce_host(self, host, stats=None):
+        self.calls.append(("announce_host", host.id))
+
+    async def sync_probes(self, host_id, results):
+        self.calls.append(("sync_probes", host_id))
+        return []
+
+    async def healthy(self):
+        return True
+
+    async def close(self):
+        self.calls.append(("close",))
+
+
+@pytest.fixture(autouse=True)
+def _clear_fakes():
+    FakeClient.instances = {}
+    yield
+
+
+def _balanced(addrs, **kw):
+    return BalancedSchedulerClient(addrs, client_factory=FakeClient, **kw)
+
+
+class TestBalancedClient:
+    def test_task_affinity_and_peer_map(self, run):
+        async def body():
+            bc = _balanced(["a:1", "b:2", "c:3"])
+            meta = TaskMeta(task_id="t" * 64, url="http://x")
+            host = HostInfo(id="h1", ip="127.0.0.1", hostname="h1")
+            await bc.register_peer("peer-1", meta, host)
+            owner = bc.ring.pick(meta.task_id)
+            assert FakeClient.instances[owner].calls[0][0] == "register_peer"
+            # per-peer calls follow the learned mapping, not a re-hash of peer id
+            await bc.report_piece_result("peer-1", 0, success=True)
+            await bc.report_peer_result("peer-1", success=True)
+            calls = FakeClient.instances[owner].calls
+            assert [c[0] for c in calls] == [
+                "register_peer", "report_piece_result", "report_peer_result",
+            ]
+            for addr, fc in FakeClient.instances.items():
+                if addr != owner:
+                    assert fc.calls == []
+            await bc.close()
+
+        run(body())
+
+    def test_announce_host_fans_out(self, run):
+        async def body():
+            bc = _balanced(["a:1", "b:2", "c:3"])
+            await bc.announce_host(HostInfo(id="h1", ip="1.1.1.1", hostname="h1"))
+            assert sorted(FakeClient.instances) == ["a:1", "b:2", "c:3"]
+            for fc in FakeClient.instances.values():
+                assert ("announce_host", "h1") in fc.calls
+            await bc.close()
+
+        run(body())
+
+    def test_resolver_updates_membership(self, run):
+        async def body():
+            addrs_holder = {"addrs": ["a:1", "b:2"]}
+
+            async def resolve():
+                return addrs_holder["addrs"]
+
+            bc = _balanced(["a:1", "b:2"], resolve=resolve, resolve_interval=0.01)
+            bc.start_resolver()
+            # seed a client for b:2 then drop it from membership
+            await bc.announce_host(HostInfo(id="h", ip="1.1.1.1", hostname="h"))
+            addrs_holder["addrs"] = ["a:1", "c:3"]
+            await asyncio.sleep(0.1)
+            assert bc.ring.addresses == {"a:1", "c:3"}
+            # evicted client is retired (usable by in-flight calls), closed
+            # only at shutdown
+            assert ("close",) not in FakeClient.instances["b:2"].calls
+            await bc.close()
+            assert ("close",) in FakeClient.instances["b:2"].calls
+
+        run(body())
+
+    def test_make_scheduler_client_dispatch(self):
+        assert isinstance(make_scheduler_client("127.0.0.1:9000"), RemoteSchedulerClient)
+        assert isinstance(
+            make_scheduler_client("127.0.0.1:9000,127.0.0.1:9001"), BalancedSchedulerClient
+        )
+
+    def test_peer_map_evicts_on_terminal_report(self, run):
+        async def body():
+            bc = _balanced(["a:1", "b:2"])
+            meta = TaskMeta(task_id="t" * 64, url="http://x")
+            host = HostInfo(id="h1", ip="127.0.0.1", hostname="h1")
+            await bc.register_peer("p1", meta, host)
+            assert "p1" in bc._peer_addr and meta.task_id in bc._task_addr
+            await bc.report_peer_result("p1", success=True)
+            assert "p1" not in bc._peer_addr  # terminal call evicts
+            await bc.close()
+
+        run(body())
+
+    def test_task_calls_follow_learned_map_after_membership_change(self, run):
+        async def body():
+            async def resolve():
+                return []
+
+            bc = _balanced(["a:1", "b:2"])
+            meta = TaskMeta(task_id="t" * 64, url="http://x")
+            host = HostInfo(id="h1", ip="127.0.0.1", hostname="h1")
+            await bc.register_peer("p1", meta, host)
+            owner = bc._task_addr[meta.task_id]
+            bc.ring.add("c:3")  # membership change mid-download
+            client = bc._for_task(meta.task_id)
+            assert client.addr == owner  # still routed to the owner
+            await bc.close()
+
+        run(body())
