@@ -26,15 +26,60 @@ def _json(data: Any, status: int = 200) -> web.Response:
 
 
 class ManagerRest:
-    def __init__(self, service: ManagerService, jobs: JobQueue):
+    def __init__(
+        self,
+        service: ManagerService,
+        jobs: JobQueue,
+        *,
+        auth_secret: str | None = None,
+        ca=None,
+    ):
         self.svc = service
         self.jobs = jobs
         self.preheat = PreheatProducer(jobs)
+        self.auth_secret = auth_secret  # None → open (dev mode), like ref --disable-auth
+        self.ca = ca  # security.ca.CertificateAuthority | None
+        from dragonfly2_tpu.security.rbac import Rbac
+
+        self.rbac = Rbac()
+
+    # ---- auth middleware (ref manager/middlewares/jwt.go + permission) ----
+
+    _OPEN_PATHS = ("/healthz", "/api/v1/users/signin")
+
+    @web.middleware
+    async def _auth_middleware(self, req: web.Request, handler):
+        if self.auth_secret is None or req.path in self._OPEN_PATHS:
+            return await handler(req)
+        from dragonfly2_tpu.security.tokens import TokenError, verify_token
+
+        authz = req.headers.get("Authorization", "")
+        if not authz.startswith("Bearer "):
+            return _json({"error": "missing bearer token"}, status=401)
+        try:
+            claims = verify_token(authz[7:], self.auth_secret)
+        except TokenError as e:
+            return _json({"error": str(e)}, status=401)
+        parts = req.path.split("/")  # /api/v1/<resource>/...
+        resource = parts[3] if len(parts) > 3 else ""
+        action = self.rbac.action_for_method(req.method)
+        if not self.rbac.allowed(claims.get("role", "guest"), resource, action):
+            return _json({"error": f"role {claims.get('role')!r} may not {action} {resource}"}, status=403)
+        req["user"] = claims
+        return await handler(req)
 
     def app(self) -> web.Application:
-        app = web.Application()
+        app = web.Application(middlewares=[self._auth_middleware])
         r = app.router
         r.add_get("/healthz", self.healthz)
+        # users + auth
+        r.add_post("/api/v1/users/signin", self.signin)
+        r.add_get("/api/v1/users", self.list_users)
+        r.add_post("/api/v1/users", self.create_user)
+        r.add_patch("/api/v1/users/{name}", self.update_user)
+        r.add_delete("/api/v1/users/{name}", self.delete_user)
+        # certificates (ref pkg/rpc/security issuance)
+        r.add_post("/api/v1/certificates", self.issue_certificate)
         # scheduler clusters
         r.add_get("/api/v1/scheduler-clusters", self.list_scheduler_clusters)
         r.add_post("/api/v1/scheduler-clusters", self.create_scheduler_cluster)
@@ -59,6 +104,60 @@ class ManagerRest:
         r.add_post("/api/v1/jobs", self.create_job)
         r.add_get(r"/api/v1/jobs/{id:\d+}", self.get_job)
         return app
+
+    # ---- users + certificates ----
+
+    async def signin(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        user = self.svc.verify_user(body.get("name", ""), body.get("password", ""))
+        if user is None:
+            return _json({"error": "invalid credentials"}, status=401)
+        if self.auth_secret is None:
+            return _json({"user": user, "token": ""})
+        from dragonfly2_tpu.security.tokens import sign_token
+
+        token = sign_token({"sub": user["name"], "role": user["role"]}, self.auth_secret)
+        return _json({"user": user, "token": token})
+
+    async def list_users(self, req: web.Request) -> web.Response:
+        return _json({"users": self.svc.list_users()})
+
+    async def create_user(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        try:
+            user = self.svc.create_user(
+                body["name"], body["password"],
+                role=body.get("role", "guest"), email=body.get("email", ""),
+            )
+        except (KeyError, ValueError) as e:
+            return _json({"error": str(e)}, status=400)
+        return _json(user, status=201)
+
+    async def update_user(self, req: web.Request) -> web.Response:
+        name = req.match_info["name"]
+        if not any(u["name"] == name for u in self.svc.list_users()):
+            return _json({"error": "no such user"}, status=404)
+        body = await req.json()
+        unknown = set(body) - {"role"}
+        if unknown:
+            return _json({"error": f"unsupported fields: {sorted(unknown)}"}, status=400)
+        if "role" in body:
+            self.svc.update_user_role(name, body["role"])
+        return _json({"ok": True})
+
+    async def delete_user(self, req: web.Request) -> web.Response:
+        if not self.svc.delete_user(req.match_info["name"]):
+            return _json({"error": "no such user"}, status=404)
+        return _json({"ok": True})
+
+    async def issue_certificate(self, req: web.Request) -> web.Response:
+        if self.ca is None:
+            return _json({"error": "manager has no CA configured"}, status=400)
+        body = await req.json()
+        issued = self.ca.issue(
+            body.get("name", "service"), sans=tuple(body.get("sans", ()))
+        )
+        return _json(issued.to_dict(), status=201)
 
     async def healthz(self, req: web.Request) -> web.Response:
         return _json({"status": "ok"})
@@ -194,9 +293,17 @@ class ManagerRest:
 
 
 async def start_rest(
-    service: ManagerService, jobs: JobQueue, *, host: str = "127.0.0.1", port: int = 0
+    service: ManagerService,
+    jobs: JobQueue,
+    *,
+    host: str = "127.0.0.1",
+    port: int = 0,
+    auth_secret: str | None = None,
+    ca=None,
 ) -> tuple[web.AppRunner, int]:
-    runner = web.AppRunner(ManagerRest(service, jobs).app(), access_log=None)
+    runner = web.AppRunner(
+        ManagerRest(service, jobs, auth_secret=auth_secret, ca=ca).app(), access_log=None
+    )
     await runner.setup()
     site = web.TCPSite(runner, host, port)
     await site.start()

@@ -10,6 +10,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import logging
+import os
 
 from dragonfly2_tpu.manager.db import Database
 from dragonfly2_tpu.manager.jobs import JobQueue
@@ -32,12 +33,28 @@ class ManagerServer:
         rest_port: int | None = 0,
         metrics_port: int | None = None,
         keepalive_ttl: float = 60.0,
+        ca_dir: str | None = None,
+        cert_token: str | None = None,
+        auth_secret: str | None = None,
+        admin_password: str | None = None,
     ):
         self.db = Database(db_path)
         self.service = ManagerService(self.db, keepalive_ttl=keepalive_ttl)
         self.jobs = JobQueue(self.db)
+        self.ca = None
+        if ca_dir:
+            from dragonfly2_tpu.security.ca import CertificateAuthority
+
+            self.ca = CertificateAuthority(ca_dir)
+        self.auth_secret = auth_secret
+        if admin_password and not self.db.find("users", name="admin"):
+            self.service.create_user("admin", admin_password, role="admin")
+            logger.info("bootstrapped admin user")
         self.rpc = RpcServer(host=host, port=port)
-        register_manager(self.rpc, ManagerRpcAdapter(self.service, self.jobs))
+        adapter = ManagerRpcAdapter(self.service, self.jobs)
+        adapter.ca = self.ca  # enables issue_certificate over RPC...
+        adapter.cert_token = cert_token  # ...gated by the bootstrap token
+        register_manager(self.rpc, adapter)
         self.rest_port = rest_port
         self.metrics_port = metrics_port
         self._debug = None
@@ -54,7 +71,8 @@ class ManagerServer:
         await self.rpc.start()
         if self.rest_port is not None:
             self._rest_runner, self.rest_port = await start_rest(
-                self.service, self.jobs, host=self.rpc.host, port=self.rest_port
+                self.service, self.jobs, host=self.rpc.host, port=self.rest_port,
+                auth_secret=self.auth_secret, ca=self.ca,
             )
         if self.metrics_port is not None:
             from dragonfly2_tpu.observability.server import start_debug_server
@@ -91,6 +109,8 @@ async def amain(args: argparse.Namespace) -> None:
     server = ManagerServer(
         db_path=args.db, host=args.host, port=args.port, rest_port=args.rest_port,
         metrics_port=args.metrics_port, keepalive_ttl=args.keepalive_ttl,
+        ca_dir=args.ca_dir, cert_token=args.cert_token,
+        auth_secret=args.auth_secret, admin_password=args.admin_password,
     )
     await server.start()
     print(f"manager ready rpc={server.address} rest={server.rest_port}", flush=True)
@@ -105,6 +125,13 @@ def main() -> None:
     p.add_argument("--port", type=int, default=9200)
     p.add_argument("--rest-port", type=int, default=9201)
     p.add_argument("--metrics-port", type=int, default=None)
+    p.add_argument("--ca-dir", default=None, help="enable the cluster CA (cert issuance)")
+    p.add_argument("--cert-token", default=os.environ.get("DRAGONFLY_CERT_TOKEN"),
+                   help="bootstrap token gating RPC certificate issuance")
+    p.add_argument("--auth-secret", default=os.environ.get("DRAGONFLY_AUTH_SECRET"),
+                   help="enable REST auth: HMAC secret for bearer tokens")
+    p.add_argument("--admin-password", default=os.environ.get("DRAGONFLY_ADMIN_PASSWORD"),
+                   help="bootstrap the admin user on first start")
     p.add_argument("--keepalive-ttl", type=float, default=60.0)
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args()

@@ -286,3 +286,59 @@ class ManagerService:
 
     def get_config(self, name: str) -> Optional[dict]:
         return self.db.find_one("configs", name=name)
+
+    # ---- users + auth (ref manager/handlers/user.go + middlewares/jwt.go) ----
+
+    @staticmethod
+    def _hash_password(password: str, salt: bytes | None = None) -> str:
+        import hashlib
+        import os as _os
+
+        salt = salt or _os.urandom(16)
+        digest = hashlib.scrypt(password.encode(), salt=salt, n=2**14, r=8, p=1)
+        return salt.hex() + "$" + digest.hex()
+
+    @classmethod
+    def _check_password(cls, password: str, stored: str) -> bool:
+        import hmac as _hmac
+
+        try:
+            salt_hex, _ = stored.split("$", 1)
+        except ValueError:
+            return False
+        return _hmac.compare_digest(
+            cls._hash_password(password, bytes.fromhex(salt_hex)), stored
+        )
+
+    def create_user(
+        self, name: str, password: str, *, role: str = "guest", email: str = ""
+    ) -> dict:
+        if self.db.find_one("users", name=name) is not None:
+            raise ValueError(f"user {name!r} exists")
+        row_id = self.db.insert(
+            "users", name=name, email=email,
+            password_hash=self._hash_password(password), role=role,
+        )
+        return self._public_user(self.db.get("users", row_id))
+
+    def verify_user(self, name: str, password: str) -> Optional[dict]:
+        row = self.db.find_one("users", name=name)
+        if row is None or row.get("state") != "enable":
+            return None
+        if not self._check_password(password, row.get("password_hash", "")):
+            return None
+        return self._public_user(row)
+
+    def list_users(self) -> list[dict]:
+        return [self._public_user(r) for r in self.db.find("users")]
+
+    def update_user_role(self, name: str, role: str) -> bool:
+        return self.db.update_where("users", {"name": name}, role=role) > 0
+
+    def delete_user(self, name: str) -> bool:
+        row = self.db.find_one("users", name=name)
+        return row is not None and self.db.delete("users", row["id"])
+
+    @staticmethod
+    def _public_user(row: dict) -> dict:
+        return {k: v for k, v in row.items() if k != "password_hash"}

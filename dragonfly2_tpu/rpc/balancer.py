@@ -164,8 +164,15 @@ class BalancedSchedulerClient:
 
     # ---- SchedulerClient protocol ----
 
+    def _owner_for_task(self, task_id: str) -> str:
+        """Learned owner first (sticky across membership change), else ring."""
+        addr = self._task_addr.get(task_id)
+        if addr is None or addr not in self.ring.addresses:
+            addr = self.ring.pick(task_id)
+        return addr
+
     async def register_peer(self, peer_id, meta, host):
-        addr = self.ring.pick(meta.task_id)
+        addr = self._owner_for_task(meta.task_id)
         self._learn(peer_id, meta.task_id, addr)
         return await self._client(addr).register_peer(peer_id, meta, host)
 
@@ -179,7 +186,7 @@ class BalancedSchedulerClient:
         await self._for_peer(peer_id).report_pieces(peer_id, piece_indices, **kw)
 
     async def announce_task(self, peer_id, meta, host, **kw):
-        addr = self.ring.pick(meta.task_id)
+        addr = self._owner_for_task(meta.task_id)
         self._learn(peer_id, meta.task_id, addr)
         await self._client(addr).announce_task(peer_id, meta, host, **kw)
 

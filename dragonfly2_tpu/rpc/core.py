@@ -67,11 +67,13 @@ class RpcServer:
         unix_path: str | None = None,
         qps_limit: float = 10_000,
         qps_burst: float = 20_000,
+        ssl: Any = None,
     ):
         self._handlers: dict[str, Handler] = {}
         self.host = host
         self.port = port
         self.unix_path = unix_path
+        self.ssl = ssl  # ssl.SSLContext for TLS/mTLS (security.ca helpers)
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[asyncio.StreamWriter] = set()
         self._bucket = TokenBucket(qps_limit, qps_burst)
@@ -92,7 +94,9 @@ class RpcServer:
         if self.unix_path:
             self._server = await asyncio.start_unix_server(self._on_conn, path=self.unix_path)
         else:
-            self._server = await asyncio.start_server(self._on_conn, self.host, self.port)
+            self._server = await asyncio.start_server(
+                self._on_conn, self.host, self.port, ssl=self.ssl
+            )
             self.port = self._server.sockets[0].getsockname()[1]
 
     async def stop(self) -> None:
@@ -170,11 +174,13 @@ class RpcClient:
         timeout: float = 30.0,
         retries: int = 3,
         retry_backoff: float = 0.2,
+        ssl: Any = None,
     ):
         self.address = address
         self.timeout = timeout
         self.retries = retries
         self.retry_backoff = retry_backoff
+        self.ssl = ssl  # ssl.SSLContext (security.ca.client_ssl_context)
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
         self._pending: dict[int, asyncio.Future] = {}
@@ -190,7 +196,9 @@ class RpcClient:
                 self._reader, self._writer = await asyncio.open_unix_connection(self.address)
             else:
                 host, port = self.address.rsplit(":", 1)
-                self._reader, self._writer = await asyncio.open_connection(host, int(port))
+                self._reader, self._writer = await asyncio.open_connection(
+                    host, int(port), ssl=self.ssl
+                )
             self._recv_task = asyncio.ensure_future(self._recv_loop(self._reader))
 
     async def _recv_loop(self, reader: asyncio.StreamReader) -> None:

@@ -30,6 +30,7 @@ MANAGER_METHODS = [
     "job_state",
     "pull_job",
     "complete_job",
+    "issue_certificate",
 ]
 
 
@@ -121,6 +122,30 @@ class ManagerRpcAdapter:
             cluster_id=p.get("cluster_id"),
         )
 
+    async def issue_certificate(self, p: dict) -> dict:
+        """Issue a leaf cert for a cluster service (ref pkg/rpc/security).
+        `ca` + `cert_token` are wired by the server when --ca-dir is set;
+        callers must present the cluster bootstrap token — the RPC plane has
+        no user auth, and an open issuance endpoint would hand the mTLS trust
+        root to any network peer."""
+        import hmac as _hmac
+
+        from dragonfly2_tpu.rpc.core import RpcError
+
+        ca = getattr(self, "ca", None)
+        if ca is None:
+            raise RpcError("manager has no CA configured", code="unavailable")
+        token = getattr(self, "cert_token", None)
+        if not token:
+            raise RpcError(
+                "certificate issuance over RPC requires --cert-token on the manager",
+                code="permission_denied",
+            )
+        if not _hmac.compare_digest(str(p.get("token", "")), token):
+            raise RpcError("bad bootstrap token", code="permission_denied")
+        issued = ca.issue(p.get("name", "service"), sans=tuple(p.get("sans", ())))
+        return issued.to_dict()
+
 
 def register_manager(server: RpcServer, adapter: ManagerRpcAdapter) -> None:
     server.register_service(adapter, MANAGER_METHODS)
@@ -203,4 +228,14 @@ class RemoteManagerClient:
         await self._c.call(
             "complete_job",
             {"job_id": job_id, "success": success, "result": result or {}, "cluster_id": cluster_id},
+        )
+
+    async def issue_certificate(
+        self, name: str, sans: list[str] | None = None, *, token: str = ""
+    ) -> dict:
+        """Obtain a leaf cert + key + CA bundle from the manager's CA
+        (ref certify's Obtain via pkg/rpc/security). `token` is the cluster
+        bootstrap token configured on the manager."""
+        return await self._c.call(
+            "issue_certificate", {"name": name, "sans": sans or [], "token": token}
         )

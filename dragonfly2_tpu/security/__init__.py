@@ -1,0 +1,23 @@
+"""Cluster security: CA issuance, mTLS contexts, tokens, RBAC.
+
+Parity with the reference's security subsystem (SURVEY.md §5): manager-run CA
+with cert issuance over RPC (pkg/issuer/ + pkg/rpc/security + certify cert
+caching, scheduler/scheduler.go:189-228), force/prefer/default TLS policies
+(trainer/config/config.go:91-95), and the manager's JWT + casbin RBAC
+(manager/middlewares/, manager/permission/) — rebuilt on python-cryptography
+(EC P-256 CA), HMAC tokens, and a table-driven permission model.
+"""
+
+from dragonfly2_tpu.security.ca import CertificateAuthority, IssuedCert
+from dragonfly2_tpu.security.rbac import Rbac, ROLES
+from dragonfly2_tpu.security.tokens import TokenError, sign_token, verify_token
+
+__all__ = [
+    "CertificateAuthority",
+    "IssuedCert",
+    "Rbac",
+    "ROLES",
+    "TokenError",
+    "sign_token",
+    "verify_token",
+]

@@ -1,0 +1,198 @@
+"""Self-signed CA + leaf certificate issuance (ref pkg/issuer/issuer.go
+NewDragonflyIssuer + manager-side security service).
+
+EC P-256 keys, CA persisted to a directory (ca.pem/ca.key), leaf certs issued
+with IP/DNS SANs and bounded validity. Services call the manager's
+issue_certificate RPC at boot and cache the result on disk (the reference
+uses certify's cache for the same reason: restart without re-issuance)."""
+
+from __future__ import annotations
+
+import datetime
+import ipaddress
+import logging
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, Optional
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_CA_DAYS = 10 * 365
+DEFAULT_LEAF_DAYS = 30
+
+
+@dataclass
+class IssuedCert:
+    cert_pem: bytes
+    key_pem: bytes
+    ca_pem: bytes
+
+    def to_dict(self) -> dict:
+        """Wire form shared by the REST and RPC issuance planes."""
+        return {
+            "cert_pem": self.cert_pem.decode(),
+            "key_pem": self.key_pem.decode(),
+            "ca_pem": self.ca_pem.decode(),
+        }
+
+
+def _name(common_name: str, org: str = "dragonfly2-tpu") -> x509.Name:
+    return x509.Name(
+        [
+            x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
+            x509.NameAttribute(NameOID.COMMON_NAME, common_name),
+        ]
+    )
+
+
+def _key_pem(key: ec.EllipticCurvePrivateKey) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )
+
+
+class CertificateAuthority:
+    """Filesystem-backed CA: loads ca.pem/ca.key from `directory` or creates
+    a fresh self-signed pair on first use."""
+
+    def __init__(self, directory: str | Path, *, common_name: str = "dragonfly2-tpu-ca"):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self._cert_path = self.dir / "ca.pem"
+        self._key_path = self.dir / "ca.key"
+        if self._cert_path.exists() and self._key_path.exists():
+            self._cert = x509.load_pem_x509_certificate(self._cert_path.read_bytes())
+            self._key = serialization.load_pem_private_key(
+                self._key_path.read_bytes(), password=None
+            )
+            logger.info("loaded CA from %s", self.dir)
+        else:
+            self._key = ec.generate_private_key(ec.SECP256R1())
+            now = datetime.datetime.now(datetime.timezone.utc)
+            name = _name(common_name)
+            self._cert = (
+                x509.CertificateBuilder()
+                .subject_name(name)
+                .issuer_name(name)
+                .public_key(self._key.public_key())
+                .serial_number(x509.random_serial_number())
+                .not_valid_before(now - datetime.timedelta(minutes=5))
+                .not_valid_after(now + datetime.timedelta(days=DEFAULT_CA_DAYS))
+                .add_extension(x509.BasicConstraints(ca=True, path_length=0), critical=True)
+                .add_extension(
+                    x509.KeyUsage(
+                        digital_signature=True, key_cert_sign=True, crl_sign=True,
+                        content_commitment=False, key_encipherment=False,
+                        data_encipherment=False, key_agreement=False,
+                        encipher_only=False, decipher_only=False,
+                    ),
+                    critical=True,
+                )
+                .sign(self._key, hashes.SHA256())
+            )
+            self._cert_path.write_bytes(self._cert.public_bytes(serialization.Encoding.PEM))
+            self._key_path.write_bytes(_key_pem(self._key))
+            self._key_path.chmod(0o600)
+            logger.info("created new CA at %s", self.dir)
+
+    @property
+    def ca_pem(self) -> bytes:
+        return self._cert.public_bytes(serialization.Encoding.PEM)
+
+    def issue(
+        self,
+        common_name: str,
+        *,
+        sans: Iterable[str] = (),
+        days: int = DEFAULT_LEAF_DAYS,
+        server: bool = True,
+        client: bool = True,
+    ) -> IssuedCert:
+        """Issue a leaf cert. sans entries are IPs or DNS names (auto-detected).
+        Both serverAuth and clientAuth by default — every service is both in a
+        mesh (ref issues one cert per service instance)."""
+        key = ec.generate_private_key(ec.SECP256R1())
+        now = datetime.datetime.now(datetime.timezone.utc)
+        san_objs: list[x509.GeneralName] = []
+        for s in sans:
+            try:
+                san_objs.append(x509.IPAddress(ipaddress.ip_address(s)))
+            except ValueError:
+                san_objs.append(x509.DNSName(s))
+        if not san_objs:
+            san_objs = [x509.DNSName(common_name)]
+        ekus = []
+        if server:
+            ekus.append(x509.oid.ExtendedKeyUsageOID.SERVER_AUTH)
+        if client:
+            ekus.append(x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH)
+        cert = (
+            x509.CertificateBuilder()
+            .subject_name(_name(common_name))
+            .issuer_name(self._cert.subject)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(minutes=5))
+            .not_valid_after(now + datetime.timedelta(days=days))
+            .add_extension(x509.BasicConstraints(ca=False, path_length=None), critical=True)
+            .add_extension(x509.SubjectAlternativeName(san_objs), critical=False)
+            .add_extension(x509.ExtendedKeyUsage(ekus), critical=False)
+            .sign(self._key, hashes.SHA256())
+        )
+        return IssuedCert(
+            cert_pem=cert.public_bytes(serialization.Encoding.PEM),
+            key_pem=_key_pem(key),
+            ca_pem=self.ca_pem,
+        )
+
+
+def write_issued(cert: IssuedCert, directory: str | Path, *, prefix: str = "tls") -> dict:
+    """Cache an issued cert to disk (certify-cache equivalent); returns paths."""
+    d = Path(directory)
+    d.mkdir(parents=True, exist_ok=True)
+    paths = {
+        "cert": d / f"{prefix}.crt",
+        "key": d / f"{prefix}.key",
+        "ca": d / "ca.pem",
+    }
+    paths["cert"].write_bytes(cert.cert_pem)
+    paths["key"].write_bytes(cert.key_pem)
+    paths["key"].chmod(0o600)
+    paths["ca"].write_bytes(cert.ca_pem)
+    return {k: str(v) for k, v in paths.items()}
+
+
+def server_ssl_context(cert_path: str, key_path: str, ca_path: Optional[str] = None):
+    """ssl.SSLContext for a TLS server; with ca_path, client certs are
+    required (mTLS force policy)."""
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_path, key_path)
+    if ca_path:
+        ctx.load_verify_locations(ca_path)
+        ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
+
+
+def client_ssl_context(
+    ca_path: str, cert_path: Optional[str] = None, key_path: Optional[str] = None
+):
+    """ssl.SSLContext for a TLS client pinned to the cluster CA; with a
+    cert/key pair the client authenticates too (mTLS)."""
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_verify_locations(ca_path)
+    ctx.check_hostname = False  # cluster certs are SAN-per-IP; ips move
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    if cert_path and key_path:
+        ctx.load_cert_chain(cert_path, key_path)
+    return ctx

@@ -1,0 +1,212 @@
+"""Security subsystem: CA issuance, TLS RPC, tokens, RBAC, manager auth
+(ref pkg/issuer + certify + manager JWT/casbin, SURVEY.md §5)."""
+
+import asyncio
+import ssl
+
+import pytest
+from aiohttp import ClientSession
+
+from dragonfly2_tpu.security import (
+    CertificateAuthority,
+    Rbac,
+    TokenError,
+    sign_token,
+    verify_token,
+)
+from dragonfly2_tpu.security.ca import client_ssl_context, server_ssl_context, write_issued
+
+
+class TestTokens:
+    def test_sign_verify_roundtrip(self):
+        tok = sign_token({"sub": "alice", "role": "admin"}, "s3cret")
+        claims = verify_token(tok, "s3cret")
+        assert claims["sub"] == "alice" and claims["role"] == "admin"
+        assert claims["exp"] > claims["iat"]
+
+    def test_bad_signature_and_expiry(self):
+        tok = sign_token({"sub": "a"}, "secret-a")
+        with pytest.raises(TokenError):
+            verify_token(tok, "secret-b")
+        expired = sign_token({"sub": "a"}, "s", ttl=-10)
+        with pytest.raises(TokenError):
+            verify_token(expired, "s")
+        with pytest.raises(TokenError):
+            verify_token("garbage.token", "s")
+
+    def test_alg_confusion_rejected(self):
+        # a token claiming alg:none must not validate
+        import base64
+        import json
+
+        header = base64.urlsafe_b64encode(
+            json.dumps({"alg": "none"}).encode()
+        ).rstrip(b"=").decode()
+        body = base64.urlsafe_b64encode(json.dumps({"sub": "x"}).encode()).rstrip(b"=").decode()
+        with pytest.raises(TokenError):
+            verify_token(f"{header}.{body}.", "s")
+
+
+class TestRbac:
+    def test_builtin_roles(self):
+        r = Rbac()
+        assert r.allowed("admin", "users", "write")
+        assert r.allowed("operator", "models", "write")
+        assert not r.allowed("operator", "users", "write")
+        assert r.allowed("guest", "schedulers", "read")
+        assert not r.allowed("guest", "models", "write")
+        assert not r.allowed("guest", "certificates", "read")
+        assert not r.allowed("nobody", "models", "read")
+
+    def test_add_policy_and_method_mapping(self):
+        r = Rbac()
+        r.add_policy("ml-bot", "models", ["read", "write"])
+        assert r.allowed("ml-bot", "models", "write")
+        assert Rbac.action_for_method("GET") == "read"
+        assert Rbac.action_for_method("POST") == "write"
+
+
+class TestCA:
+    def test_issue_and_verify_chain(self, tmp_path):
+        ca = CertificateAuthority(tmp_path / "ca")
+        issued = ca.issue("scheduler-1", sans=["127.0.0.1", "sched.local"])
+        from cryptography import x509
+        from cryptography.hazmat.primitives.asymmetric import ec
+
+        leaf = x509.load_pem_x509_certificate(issued.cert_pem)
+        root = x509.load_pem_x509_certificate(issued.ca_pem)
+        leaf.verify_directly_issued_by(root)  # raises on mismatch
+        sans = leaf.extensions.get_extension_for_class(x509.SubjectAlternativeName).value
+        assert "sched.local" in sans.get_values_for_type(x509.DNSName)
+
+    def test_ca_persistence(self, tmp_path):
+        ca1 = CertificateAuthority(tmp_path / "ca")
+        ca2 = CertificateAuthority(tmp_path / "ca")  # reload, not regenerate
+        assert ca1.ca_pem == ca2.ca_pem
+
+    def test_mtls_rpc_roundtrip(self, run, tmp_path):
+        """RpcServer/RpcClient with mutual TLS from the CA."""
+        from dragonfly2_tpu.rpc.core import RpcClient, RpcServer
+
+        ca = CertificateAuthority(tmp_path / "ca")
+        srv_paths = write_issued(
+            ca.issue("server", sans=["127.0.0.1"]), tmp_path / "srv"
+        )
+        cli_paths = write_issued(
+            ca.issue("client", sans=["127.0.0.1"]), tmp_path / "cli"
+        )
+
+        async def body():
+            server = RpcServer(
+                host="127.0.0.1",
+                ssl=server_ssl_context(srv_paths["cert"], srv_paths["key"], srv_paths["ca"]),
+            )
+
+            async def echo(p):
+                return {"echo": p}
+
+            server.register("echo", echo)
+            await server.start()
+            try:
+                client = RpcClient(
+                    f"127.0.0.1:{server.port}",
+                    ssl=client_ssl_context(cli_paths["ca"], cli_paths["cert"], cli_paths["key"]),
+                )
+                out = await client.call("echo", {"x": 1})
+                assert out == {"echo": {"x": 1}}
+                await client.close()
+
+                # a client without a cert is refused (mTLS force policy)
+                bare = RpcClient(
+                    f"127.0.0.1:{server.port}",
+                    ssl=client_ssl_context(cli_paths["ca"]),
+                    retries=0, timeout=5.0,
+                )
+                with pytest.raises(Exception):
+                    await bare.call("echo", {})
+                await bare.close()
+            finally:
+                await server.stop()
+
+        run(body())
+
+
+class TestManagerAuth:
+    def test_rest_auth_flow(self, run, tmp_path):
+        from dragonfly2_tpu.manager.db import Database
+        from dragonfly2_tpu.manager.jobs import JobQueue
+        from dragonfly2_tpu.manager.rest import start_rest
+        from dragonfly2_tpu.manager.service import ManagerService
+
+        async def body():
+            db = Database(":memory:")
+            svc = ManagerService(db)
+            svc.create_user("admin", "hunter2", role="admin")
+            svc.create_user("viewer", "viewpass", role="guest")
+            ca = CertificateAuthority(tmp_path / "ca")
+            runner, port = await start_rest(
+                svc, JobQueue(db), auth_secret="top-secret", ca=ca
+            )
+            base = f"http://127.0.0.1:{port}"
+            try:
+                async with ClientSession() as s:
+                    # no token → 401 (healthz stays open)
+                    async with s.get(f"{base}/healthz") as r:
+                        assert r.status == 200
+                    async with s.get(f"{base}/api/v1/schedulers") as r:
+                        assert r.status == 401
+                    # bad creds → 401
+                    async with s.post(f"{base}/api/v1/users/signin",
+                                      json={"name": "admin", "password": "wrong"}) as r:
+                        assert r.status == 401
+                    # signin → token works
+                    async with s.post(f"{base}/api/v1/users/signin",
+                                      json={"name": "admin", "password": "hunter2"}) as r:
+                        assert r.status == 200
+                        token = (await r.json())["token"]
+                    hdr = {"Authorization": f"Bearer {token}"}
+                    async with s.get(f"{base}/api/v1/schedulers", headers=hdr) as r:
+                        assert r.status == 200
+                    # admin can issue certs over REST
+                    async with s.post(f"{base}/api/v1/certificates", headers=hdr,
+                                      json={"name": "svc", "sans": ["127.0.0.1"]}) as r:
+                        assert r.status == 201
+                        assert "BEGIN CERTIFICATE" in (await r.json())["cert_pem"]
+                    # guest may read but not write
+                    async with s.post(f"{base}/api/v1/users/signin",
+                                      json={"name": "viewer", "password": "viewpass"}) as r:
+                        g_token = (await r.json())["token"]
+                    g_hdr = {"Authorization": f"Bearer {g_token}"}
+                    async with s.get(f"{base}/api/v1/schedulers", headers=g_hdr) as r:
+                        assert r.status == 200
+                    async with s.post(f"{base}/api/v1/applications", headers=g_hdr,
+                                      json={"name": "x"}) as r:
+                        assert r.status == 403
+                    async with s.post(f"{base}/api/v1/certificates", headers=g_hdr,
+                                      json={"name": "evil"}) as r:
+                        assert r.status == 403
+            finally:
+                await runner.cleanup()
+
+        run(body())
+
+    def test_issue_certificate_over_rpc(self, run, tmp_path):
+        from dragonfly2_tpu.manager.server import ManagerServer
+        from dragonfly2_tpu.rpc.manager import RemoteManagerClient
+
+        async def body():
+            server = ManagerServer(
+                db_path=":memory:", port=0, rest_port=None,
+                ca_dir=str(tmp_path / "ca"), admin_password="boot",
+            )
+            await server.start()
+            try:
+                client = RemoteManagerClient(server.address)
+                out = await client.issue_certificate("daemon-7", sans=["10.0.0.7"])
+                assert "BEGIN CERTIFICATE" in out["cert_pem"]
+                assert "BEGIN PRIVATE KEY" in out["key_pem"]
+                await client.close()
+            finally:
+                await server.stop()
+
+        run(body())
