@@ -192,6 +192,41 @@ class TestManagerAuth:
 
     def test_issue_certificate_over_rpc(self, run, tmp_path):
         from dragonfly2_tpu.manager.server import ManagerServer
+        from dragonfly2_tpu.rpc.core import RpcError
+        from dragonfly2_tpu.rpc.manager import RemoteManagerClient
+
+        async def body():
+            server = ManagerServer(
+                db_path=":memory:", port=0, rest_port=None,
+                ca_dir=str(tmp_path / "ca"), admin_password="boot",
+                cert_token="bootstrap-secret",
+            )
+            await server.start()
+            try:
+                client = RemoteManagerClient(server.address)
+                out = await client.issue_certificate(
+                    "daemon-7", sans=["10.0.0.7"], token="bootstrap-secret"
+                )
+                assert "BEGIN CERTIFICATE" in out["cert_pem"]
+                assert "BEGIN PRIVATE KEY" in out["key_pem"]
+                # wrong / missing bootstrap token → permission_denied
+                with pytest.raises(RpcError) as ei:
+                    await client.issue_certificate("evil", token="wrong")
+                assert ei.value.code == "permission_denied"
+                with pytest.raises(RpcError) as ei:
+                    await client.issue_certificate("evil")
+                assert ei.value.code == "permission_denied"
+                await client.close()
+            finally:
+                await server.stop()
+
+        run(body())
+
+    def test_issue_certificate_rpc_refused_without_token(self, run, tmp_path):
+        """A manager started without --cert-token must refuse RPC issuance
+        outright (the gate at rpc/manager.py issue_certificate)."""
+        from dragonfly2_tpu.manager.server import ManagerServer
+        from dragonfly2_tpu.rpc.core import RpcError
         from dragonfly2_tpu.rpc.manager import RemoteManagerClient
 
         async def body():
@@ -202,9 +237,9 @@ class TestManagerAuth:
             await server.start()
             try:
                 client = RemoteManagerClient(server.address)
-                out = await client.issue_certificate("daemon-7", sans=["10.0.0.7"])
-                assert "BEGIN CERTIFICATE" in out["cert_pem"]
-                assert "BEGIN PRIVATE KEY" in out["key_pem"]
+                with pytest.raises(RpcError) as ei:
+                    await client.issue_certificate("daemon-7", token="anything")
+                assert ei.value.code == "permission_denied"
                 await client.close()
             finally:
                 await server.stop()
