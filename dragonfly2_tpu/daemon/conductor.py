@@ -125,7 +125,13 @@ class ConductorConfig:
     piece_workers: int = 4
     download_rate_bps: float = 512 << 20  # per-peer default (ref constants.go:45)
     piece_timeout: float = 30.0
+    # Fallback re-check cadence when no push event arrives; piece announcements
+    # themselves are pushed via parent long-poll, not polled on this interval.
     metadata_poll_interval: float = 0.2
+    longpoll_wait: float = 25.0
+    # How long to keep riding live parents' push channels with nothing to do
+    # before asking the scheduler for new parents.
+    no_progress_reschedule: float = 5.0
     reschedule_limit: int = 5
     watchdog_timeout: float = 600.0
 
@@ -162,6 +168,8 @@ class PeerTaskConductor:
         self._piece_digests: dict[str, str] = {}  # learned from parent metadata
         self._peer_reported = False
         self._t0 = 0.0
+        self._sync_tasks: dict[str, asyncio.Task] = {}  # parent_id -> long-poll loop
+        self._update_event = asyncio.Event()  # any parent state/metadata change
 
     # ---- entry ----
 
@@ -350,83 +358,153 @@ class PeerTaskConductor:
         self.dispatcher.update_parents(parents)
         session = self._http()
         reschedules = 0
+        last_update = time.monotonic()
 
-        while True:
-            await self._poll_parent_metadata(session)
-            if self.ts.meta.content_length < 0:
-                # Parents are still back-to-source themselves and haven't
-                # learned the object size; wait for their metadata rather than
-                # burning the reschedule budget.
-                if not self.dispatcher.usable():
-                    reschedules += 1
-                    if reschedules > self.cfg.reschedule_limit:
+        try:
+            while True:
+                self._sync_parents(session)
+                if self.ts.meta.content_length < 0:
+                    # Parents are still back-to-source themselves and haven't
+                    # learned the object size; wait for their metadata rather
+                    # than burning the reschedule budget.
+                    if not self.dispatcher.usable():
+                        reschedules += 1
+                        if reschedules > self.cfg.reschedule_limit:
+                            await self._download_back_to_source()
+                            return
+                        reg = await self.scheduler.reschedule(self.peer_id)
+                        if reg.back_to_source:
+                            await self._download_back_to_source()
+                            return
+                        self.dispatcher.update_parents(reg.parents)
+                    await self._wait_update()
+                    continue
+                if self.ts.meta.content_length == 0 or self.ts.is_complete():
+                    return
+                total = self.ts.meta.total_pieces
+                missing = list(self.ts.finished.missing_until(total))
+                available = [i for i in missing if self.dispatcher.pick(i) is not None]
+                if not available:
+                    if any(not t.done() for t in self._sync_tasks.values()):
+                        # Live parents just have nothing new yet — keep riding
+                        # the push channel; spend the reschedule budget only
+                        # after a real no-progress window.
+                        if await self._wait_update():
+                            last_update = time.monotonic()
+                            continue
+                        if time.monotonic() - last_update < self.cfg.no_progress_reschedule:
+                            continue
+                    if reschedules >= self.cfg.reschedule_limit:
+                        logger.info(
+                            "peer %s: cutover to back-to-source for %d pieces",
+                            self.peer_id, len(missing),
+                        )
                         await self._download_back_to_source()
                         return
+                    reschedules += 1
                     reg = await self.scheduler.reschedule(self.peer_id)
                     if reg.back_to_source:
                         await self._download_back_to_source()
                         return
                     self.dispatcher.update_parents(reg.parents)
-                await asyncio.sleep(self.cfg.metadata_poll_interval)
-                continue
-            if self.ts.meta.content_length == 0 or self.ts.is_complete():
-                return
-            total = self.ts.meta.total_pieces
-            missing = list(self.ts.finished.missing_until(total))
-            available = [i for i in missing if self.dispatcher.pick(i) is not None]
-            if not available:
-                if reschedules >= self.cfg.reschedule_limit:
-                    logger.info(
-                        "peer %s: cutover to back-to-source for %d pieces",
-                        self.peer_id, len(missing),
-                    )
-                    await self._download_back_to_source()
-                    return
-                reschedules += 1
-                reg = await self.scheduler.reschedule(self.peer_id)
-                if reg.back_to_source:
-                    await self._download_back_to_source()
-                    return
-                self.dispatcher.update_parents(reg.parents)
-                await asyncio.sleep(self.cfg.metadata_poll_interval)
-                continue
+                    last_update = time.monotonic()  # fresh no-progress window
+                    await self._wait_update()
+                    continue
 
-            queue: asyncio.Queue[int] = asyncio.Queue()
-            for i in available:
-                queue.put_nowait(i)
-            workers = [
-                asyncio.ensure_future(self._piece_worker(session, queue))
-                for _ in range(min(self.cfg.piece_workers, len(available)))
-            ]
-            await queue.join()
-            for w in workers:
-                w.cancel()
-            await asyncio.gather(*workers, return_exceptions=True)
+                queue: asyncio.Queue[int] = asyncio.Queue()
+                for i in available:
+                    queue.put_nowait(i)
+                workers = [
+                    asyncio.ensure_future(self._piece_worker(session, queue))
+                    for _ in range(min(self.cfg.piece_workers, len(available)))
+                ]
+                await queue.join()
+                for w in workers:
+                    w.cancel()
+                await asyncio.gather(*workers, return_exceptions=True)
+                last_update = time.monotonic()
+        finally:
+            for t in self._sync_tasks.values():
+                t.cancel()
+            await asyncio.gather(*self._sync_tasks.values(), return_exceptions=True)
+            self._sync_tasks.clear()
 
-    async def _poll_parent_metadata(self, session: aiohttp.ClientSession) -> None:
-        async def poll(state: ParentState) -> None:
-            url = f"http://{state.info.ip}:{state.info.download_port}/metadata/{self.meta.task_id}"
-            try:
-                async with session.get(url, timeout=aiohttp.ClientTimeout(total=5)) as resp:
-                    if resp.status != 200:
-                        state.record(False, 0)
-                        return
-                    data = await resp.json()
-            except (aiohttp.ClientError, asyncio.TimeoutError):
-                state.record(False, 0)
-                return
-            state.pieces = set(data.get("finished_pieces", ()))
-            for k, v in data.get("piece_digests", {}).items():
-                self._piece_digests.setdefault(k, v)
-            if self.ts.meta.content_length < 0 and data.get("content_length", -1) >= 0:
-                self.ts.set_task_info(
-                    content_length=data["content_length"],
-                    piece_size=data["piece_size"],
-                    total_pieces=data["total_pieces"],
-                    digest=data.get("digest", ""),
+    async def _wait_update(self) -> bool:
+        """Park until any parent sync loop reports progress (piece landed,
+        metadata learned, parent died). Returns True if an update arrived,
+        False on the fallback-timeout re-check. This replaces the fixed
+        polling interval on the hot path: piece-arrival latency is now one
+        push round-trip, not up to a poll period."""
+        try:
+            await asyncio.wait_for(
+                self._update_event.wait(), timeout=self.cfg.metadata_poll_interval
+            )
+            arrived = True
+        except asyncio.TimeoutError:
+            arrived = False
+        self._update_event.clear()
+        return arrived
+
+    def _sync_parents(self, session: aiohttp.ClientSession) -> None:
+        """Ensure one long-poll sync loop per usable parent (ref
+        pieceTaskSyncManager.syncPeers); drop loops for removed parents."""
+        current = {s.info.peer_id for s in self.dispatcher.usable()}
+        for pid in list(self._sync_tasks):
+            t = self._sync_tasks[pid]
+            if pid not in current or t.done():
+                if pid not in current:
+                    t.cancel()
+                elif not t.cancelled() and t.exception() is not None:
+                    logger.warning("parent %s sync loop died: %r", pid, t.exception())
+                del self._sync_tasks[pid]
+        for state in self.dispatcher.usable():
+            if state.info.peer_id not in self._sync_tasks:
+                self._sync_tasks[state.info.peer_id] = asyncio.ensure_future(
+                    self._parent_sync_loop(session, state)
                 )
 
-        await asyncio.gather(*(poll(s) for s in self.dispatcher.usable()))
+    async def _parent_sync_loop(self, session: aiohttp.ClientSession, state: ParentState) -> None:
+        """Long-poll one parent's metadata endpoint: the first request returns
+        immediately with current state; subsequent requests park server-side
+        until the parent's task state changes past the seen version (ref
+        pieceTaskSynchronizer.receive push loop)."""
+        version = -1
+        url = f"http://{state.info.ip}:{state.info.download_port}/metadata/{self.meta.task_id}"
+        while not state.blocked:
+            try:
+                async with session.get(
+                    url,
+                    params={"since": str(version), "wait": str(self.cfg.longpoll_wait)},
+                    timeout=aiohttp.ClientTimeout(total=self.cfg.longpoll_wait + 10),
+                ) as resp:
+                    if resp.status != 200:
+                        state.record(False, 0)
+                        self._update_event.set()
+                        await asyncio.sleep(0.5)  # parent may not know the task yet
+                        continue
+                    data = await resp.json()
+                version = data.get("version", version)
+                state.pieces = set(data.get("finished_pieces", ()))
+                for k, v in data.get("piece_digests", {}).items():
+                    self._piece_digests.setdefault(k, v)
+                if self.ts.meta.content_length < 0 and data.get("content_length", -1) >= 0:
+                    self.ts.set_task_info(
+                        content_length=data["content_length"],
+                        piece_size=data["piece_size"],
+                        total_pieces=data["total_pieces"],
+                        digest=data.get("digest", ""),
+                    )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 — a bad parent (garbage JSON,
+                # missing fields, network error) must count against it and back
+                # off, never kill the sync loop silently
+                state.record(False, 0)
+                self._update_event.set()
+                logger.debug("parent %s metadata sync error: %r", state.info.peer_id, e)
+                await asyncio.sleep(0.5)
+                continue
+            self._update_event.set()
 
     async def _piece_worker(self, session: aiohttp.ClientSession, queue: asyncio.Queue) -> None:
         while True:

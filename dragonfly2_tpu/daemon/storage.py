@@ -50,13 +50,42 @@ class TaskStorage:
         self._bitset = Bitset(meta.finished_pieces)
         self._lock = asyncio.Lock()
         self._progress = asyncio.Event()  # replaced on every notify
+        # In-memory change counter for push-style piece announcements: child
+        # peers long-poll "metadata changed past version N" instead of
+        # re-fetching on a timer (ref peertask_piecetask_synchronizer.go
+        # bidi SyncPieceTasks push). Not persisted: restarts reset it, and
+        # long-pollers simply observe a fresh counter on reconnect.
+        self.version = 0
         if not self.data_path.exists():
             self.data_path.touch()
 
     def _notify_progress(self) -> None:
         """Wake stream readers: a piece landed or metadata changed."""
+        self.version += 1
         ev, self._progress = self._progress, asyncio.Event()
         ev.set()
+
+    async def wait_version(self, since: int, timeout: float) -> int:
+        """Block until the task state has changed past `since` (or timeout);
+        returns the current version either way."""
+        if since > self.version:
+            # Caller saw a previous incarnation's (larger) counter — the
+            # process restarted and reset it. Answer immediately so the
+            # long-poller resynchronizes instead of stalling a full window.
+            return self.version
+        deadline = time.monotonic() + timeout
+        while self.version <= since:
+            ev = self._progress  # capture BEFORE re-check to not miss a notify
+            if self.version > since:
+                break
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                await asyncio.wait_for(ev.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                break
+        return self.version
 
     # ---- metadata ----
 

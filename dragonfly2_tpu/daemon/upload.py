@@ -51,7 +51,12 @@ class UploadServer:
         return app
 
     async def start(self) -> None:
-        self._runner = web.AppRunner(self._app(), access_log=None)
+        # handler_cancellation: parked long-poll metadata handlers must die
+        # with the client connection / server shutdown, not hold cleanup for
+        # the full longpoll window.
+        self._runner = web.AppRunner(
+            self._app(), access_log=None, handler_cancellation=True, shutdown_timeout=1.0
+        )
         await self._runner.setup()
         site = web.TCPSite(self._runner, self.host, self.port)
         await site.start()
@@ -67,11 +72,26 @@ class UploadServer:
     async def _handle_health(self, request: web.Request) -> web.Response:
         return web.json_response({"ok": True})
 
+    MAX_LONGPOLL_S = 25.0
+
     async def _handle_metadata(self, request: web.Request) -> web.Response:
+        """Piece-metadata endpoint with long-poll push semantics (replacing
+        the reference's bidi SyncPieceTasks stream,
+        peertask_piecetask_synchronizer.go:81-237): `?since=<version>&wait=<s>`
+        parks the request until the task state changes past `since`, so a
+        child learns of a new piece the moment it lands instead of on a
+        polling interval."""
         task_id = request.match_info["task_id"]
         ts = self.storage.get(task_id)
         if ts is None:
             raise web.HTTPNotFound(text=f"task {task_id} unknown")
+        since = request.query.get("since")
+        if since is not None:
+            try:
+                wait_s = min(float(request.query.get("wait", "25")), self.MAX_LONGPOLL_S)
+                await ts.wait_version(int(since), max(0.0, wait_s))
+            except ValueError:
+                raise web.HTTPBadRequest(text="since/wait must be numeric")
         m = ts.meta
         return web.json_response(
             {
@@ -83,6 +103,7 @@ class UploadServer:
                 "finished_pieces": sorted(ts.finished.indices()),
                 "piece_digests": m.piece_digests,
                 "done": m.done,
+                "version": ts.version,
             }
         )
 
