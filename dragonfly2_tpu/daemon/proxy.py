@@ -5,11 +5,18 @@ Parity with reference client/daemon/proxy (proxy.go:288 ServeHTTP,
 proxy_manager.go:42-52 rules) and client/daemon/transport
 (transport.go:58-119 RoundTrip → StartStreamTask): an explicit-proxy server
 that converts matching GET requests into P2P stream tasks, passes everything
-else through, tunnels CONNECT (no TLS MITM — the reference's cert-forging
-path, cert.go, is out of scope for the mTLS-lite build), and doubles as a
-registry mirror for container-image acceleration: origin-form requests are
-rewritten onto a configured upstream registry, with immutable blob fetches
-(`/v2/<name>/blobs/sha256:...`) riding the P2P engine keyed by digest.
+else through, and doubles as a registry mirror for container-image
+acceleration: origin-form requests are rewritten onto a configured upstream
+registry, with immutable blob fetches (`/v2/<name>/blobs/sha256:...`) riding
+the P2P engine keyed by digest.
+
+HTTPS interception (ref cert.go + proxy_sni.go): CONNECT targets matching the
+hijack host patterns are MITM'd — the proxy completes the client's TLS
+handshake with a CA-forged leaf for the target host and routes the decrypted
+requests through the same rule engine, so HTTPS registries/origins ride P2P
+too. Non-matching CONNECTs get a blind tunnel. The companion SniProxy accepts
+raw TLS (no CONNECT), peeks the ClientHello SNI, and either hijacks the same
+way or splices a byte tunnel to the named upstream.
 
 Raw asyncio (not aiohttp.web) because a proxy must handle CONNECT and
 absolute-form request targets, which web frameworks do not model.
@@ -20,8 +27,9 @@ from __future__ import annotations
 import asyncio
 import logging
 import re
+import socket
 from dataclasses import dataclass, field
-from typing import Optional
+from typing import Callable, Optional
 from urllib.parse import urlsplit
 
 import aiohttp
@@ -33,6 +41,31 @@ _HOP_HEADERS = {
     "proxy-connection", "te", "trailers", "transfer-encoding", "upgrade",
 }
 _BLOB_RE = re.compile(r"^/v2/.+/blobs/(sha256:[0-9a-f]{64})$")
+
+
+async def splice(
+    client_r: asyncio.StreamReader, client_w: asyncio.StreamWriter,
+    upstream_r: asyncio.StreamReader, upstream_w: asyncio.StreamWriter,
+) -> None:
+    """Bidirectional byte pump between two stream pairs (blind tunnel)."""
+
+    async def pipe(src: asyncio.StreamReader, dst: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                data = await src.read(64 << 10)
+                if not data:
+                    break
+                dst.write(data)
+                await dst.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
+        finally:
+            try:
+                dst.close()
+            except Exception:
+                pass
+
+    await asyncio.gather(pipe(client_r, upstream_w), pipe(upstream_r, client_w))
 
 
 @dataclass
@@ -70,12 +103,31 @@ class RegistryMirrorConfig:
 
 
 @dataclass
+class HttpsHijack:
+    """MITM config (ref proxy config hijackHTTPS): forge leaf certs for hosts
+    matching `hosts` regexes; everything else is blind-tunneled."""
+
+    forger: "object"  # security.mitm.CertForger (untyped: optional dependency)
+    hosts: tuple = (r".*",)
+
+    def __post_init__(self):
+        self._res = [re.compile(p) for p in self.hosts]
+
+    def should(self, host: str) -> bool:
+        return any(r.search(host) for r in self._res)
+
+
+@dataclass
 class ProxyConfig:
     rules: list[ProxyRule] = field(default_factory=list)
     registry_mirror: Optional[RegistryMirrorConfig] = None
     # requests below this size are not worth a scheduler round-trip; the
     # reference proxies everything matched, so default 0 keeps parity
     min_p2p_size: int = 0
+    https_hijack: Optional[HttpsHijack] = None
+    # outbound TLS trust for passthrough/back-to-source of intercepted
+    # requests (None = system store)
+    upstream_ssl: Optional["object"] = None  # ssl.SSLContext
 
 
 class ProxyServer:
@@ -113,7 +165,10 @@ class ProxyServer:
 
     def _http(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession(auto_decompress=False)
+            connector = None
+            if self.cfg.upstream_ssl is not None:
+                connector = aiohttp.TCPConnector(ssl=self.cfg.upstream_ssl)
+            self._session = aiohttp.ClientSession(auto_decompress=False, connector=connector)
         return self._session
 
     # ---- connection handling ----
@@ -193,6 +248,10 @@ class ProxyServer:
         except ValueError:
             await self._respond_simple(writer, 400, b"bad CONNECT target")
             return
+        hijack = self.cfg.https_hijack
+        if hijack is not None and hijack.should(host):
+            await self._handle_mitm(host, port, reader, writer)
+            return
         try:
             upstream_r, upstream_w = await asyncio.open_connection(host, port)
         except OSError as e:
@@ -201,24 +260,45 @@ class ProxyServer:
         metrics.PROXY_REQUEST_TOTAL.inc(via="tunnel")
         writer.write(b"HTTP/1.1 200 Connection established\r\n\r\n")
         await writer.drain()
+        await splice(reader, writer, upstream_r, upstream_w)
 
-        async def pipe(src: asyncio.StreamReader, dst: asyncio.StreamWriter) -> None:
-            try:
-                while True:
-                    data = await src.read(64 << 10)
-                    if not data:
-                        break
-                    dst.write(data)
-                    await dst.drain()
-            except (ConnectionResetError, BrokenPipeError):
-                pass
-            finally:
-                try:
-                    dst.close()
-                except Exception:
-                    pass
+    async def _handle_mitm(
+        self, host: str, port: int,
+        reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
+    ) -> None:
+        """Terminate the client's TLS with a forged leaf for `host` and route
+        the decrypted request through the normal rule engine (ref cert.go
+        MITM path). One request per tunnel — responses are close-delimited."""
+        from dragonfly2_tpu.daemon import metrics
 
-        await asyncio.gather(pipe(reader, upstream_w), pipe(upstream_r, writer))
+        try:
+            ctx = self.cfg.https_hijack.forger.context_for(host)
+        except Exception:
+            # forge failure must surface as a clean proxy error BEFORE the
+            # client is told the tunnel is up and starts talking TLS
+            logger.exception("leaf-cert forge failed for %s", host)
+            await self._respond_simple(writer, 502, b"certificate forge failed")
+            return
+        writer.write(b"HTTP/1.1 200 Connection established\r\n\r\n")
+        await writer.drain()
+        try:
+            # server-side handshake: StreamWriter.start_tls infers server
+            # side from the server-connection protocol
+            await writer.start_tls(ctx)
+        except (OSError, asyncio.IncompleteReadError) as e:
+            logger.debug("MITM handshake with client failed for %s: %s", host, e)
+            return
+        metrics.PROXY_REQUEST_TOTAL.inc(via="mitm")
+        request = await self._read_request(reader)
+        if request is None:
+            return
+        method, req_target, headers = request
+        if req_target.startswith("http://") or req_target.startswith("https://"):
+            url = req_target  # absolute-form inside the tunnel (unusual but legal)
+        else:
+            netloc = host if port == 443 else f"{host}:{port}"
+            url = f"https://{netloc}{req_target}"
+        await self._route(method, url, headers, reader, writer)
 
     # ---- routing ----
 
@@ -353,3 +433,189 @@ class ProxyServer:
                 async for chunk in resp.content.iter_chunked(64 << 10):
                     writer.write(chunk)
                     await writer.drain()
+
+
+class SniProxy:
+    """Transparent HTTPS interception without CONNECT (ref proxy_sni.go
+    ServeSNI/handleTLSConn): clients whose DNS points the origin host at this
+    proxy speak raw TLS to it. The proxy peeks the ClientHello's SNI before
+    any handshake; hijacked hosts get a forged-cert TLS termination and ride
+    the proxy's rule engine, others get a blind byte tunnel to the named
+    upstream.
+
+    Owns a raw accept loop (not asyncio.start_server) so the ClientHello can
+    be MSG_PEEK'd from the kernel buffer — a started transport would have
+    consumed it before the SNI decision.
+    """
+
+    def __init__(
+        self,
+        proxy: ProxyServer,
+        *,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        hijack: Optional[HttpsHijack] = None,
+        resolve: Optional[Callable[[str], tuple[str, int]]] = None,
+        peek_timeout: float = 10.0,
+    ):
+        self.proxy = proxy
+        self.host = host
+        self.port = port
+        self.hijack = hijack if hijack is not None else proxy.cfg.https_hijack
+        # sni -> (upstream_host, upstream_port); identity:443 by default
+        self.resolve = resolve or (lambda sni: (sni, 443))
+        self.peek_timeout = peek_timeout
+        self._sock: socket.socket | None = None
+        self._accept_task: asyncio.Task | None = None
+        self._conns: set[asyncio.Task] = set()
+
+    async def start(self) -> None:
+        self._sock = socket.create_server((self.host, self.port))
+        self._sock.setblocking(False)
+        self.port = self._sock.getsockname()[1]
+        self._accept_task = asyncio.ensure_future(self._accept_loop())
+        logger.info("sni proxy listening on %s:%d", self.host, self.port)
+
+    async def stop(self) -> None:
+        if self._accept_task is not None:
+            self._accept_task.cancel()
+            await asyncio.gather(self._accept_task, return_exceptions=True)
+            self._accept_task = None
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+        for t in list(self._conns):
+            t.cancel()
+        await asyncio.gather(*self._conns, return_exceptions=True)
+        self._conns.clear()
+
+    async def _accept_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                conn, _addr = await loop.sock_accept(self._sock)
+            except asyncio.CancelledError:
+                raise
+            except OSError as e:
+                # transient accept failure (e.g. EMFILE) must not kill the
+                # listener — asyncio.start_server survives these too
+                logger.warning("sni proxy accept failed: %s", e)
+                await asyncio.sleep(0.1)
+                continue
+            conn.setblocking(False)
+            t = asyncio.ensure_future(self._handle(conn))
+            self._conns.add(t)
+            t.add_done_callback(self._conns.discard)
+
+    async def _peek_sni(self, conn: socket.socket) -> str | None:
+        """MSG_PEEK the ClientHello (leaving it in the kernel buffer) until
+        the SNI parses, the hello proves SNI-less, or the timeout lapses.
+        Readability-driven via add_reader — no polling."""
+        from dragonfly2_tpu.security.mitm import parse_client_hello_sni
+
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + self.peek_timeout
+        fd = conn.fileno()
+        while True:
+            try:
+                data = conn.recv(16 << 10, socket.MSG_PEEK)
+            except (BlockingIOError, InterruptedError):
+                data = None
+            except OSError:
+                return None
+            if data is not None:
+                if not data:
+                    return None  # EOF before a full ClientHello
+                status, sni = parse_client_hello_sni(data)
+                if status == "ok":
+                    return sni
+                if status == "none":
+                    return None
+                # incomplete: fall through and wait for more bytes
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                return None
+            readable = asyncio.Event()
+            loop.add_reader(fd, readable.set)
+            try:
+                await asyncio.wait_for(readable.wait(), timeout=remaining)
+            except asyncio.TimeoutError:
+                return None
+            finally:
+                loop.remove_reader(fd)
+
+    async def _handle(self, conn: socket.socket) -> None:
+        try:
+            sni = await self._peek_sni(conn)
+            reader, writer = await asyncio.open_connection(sock=conn)
+        except asyncio.CancelledError:
+            conn.close()  # no transport owns the fd yet — close it or leak it
+            raise
+        except Exception as e:
+            conn.close()
+            logger.debug("sni peek/stream setup failed: %r", e)
+            return
+        try:
+            if sni and self.hijack is not None and self.hijack.should(sni):
+                await self._handle_hijack(sni, reader, writer)
+            elif sni:
+                await self._handle_tunnel(sni, reader, writer)
+            # no SNI: nothing to route by — drop (ref logs and closes)
+        except (ConnectionResetError, BrokenPipeError, asyncio.IncompleteReadError):
+            pass
+        except Exception:
+            logger.exception("sni proxy connection failed")
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _handle_hijack(
+        self, sni: str, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        from dragonfly2_tpu.daemon import metrics
+
+        import ssl as _ssl
+
+        ctx = self.hijack.forger.context_for(sni)
+        loop = asyncio.get_running_loop()
+        try:
+            # Server-side TLS upgrade on an open_connection stream: replicate
+            # StreamWriter.start_tls, which would infer client side here.
+            transport = await loop.start_tls(
+                writer.transport, writer.transport.get_protocol(), ctx, server_side=True
+            )
+        except (_ssl.SSLError, OSError, asyncio.IncompleteReadError) as e:
+            # a client that does not trust the cluster CA aborts here — noisy
+            # but normal for a transparent proxy
+            logger.debug("sni MITM handshake failed for %s: %s", sni, e)
+            return
+        writer._transport = transport  # rewire like StreamWriter.start_tls does
+        metrics.PROXY_REQUEST_TOTAL.inc(via="sni_mitm")
+        request = await self.proxy._read_request(reader)
+        if request is None:
+            return
+        method, target, headers = request
+        # route via the RESOLVED upstream: with transparent interception the
+        # SNI name's DNS typically points back at this proxy — dialing it
+        # again would self-loop. The Host header still carries the SNI name.
+        up_host, up_port = self.resolve(sni)
+        netloc = up_host if up_port == 443 else f"{up_host}:{up_port}"
+        url = f"https://{netloc}{target}" if target.startswith("/") else target
+        await self.proxy._route(method, url, headers, reader, writer)
+
+    async def _handle_tunnel(
+        self, sni: str, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        from dragonfly2_tpu.daemon import metrics
+
+        up_host, up_port = self.resolve(sni)
+        try:
+            upstream_r, upstream_w = await asyncio.open_connection(up_host, up_port)
+        except OSError as e:
+            logger.debug("sni tunnel to %s:%d failed: %s", up_host, up_port, e)
+            return
+        metrics.PROXY_REQUEST_TOTAL.inc(via="sni_tunnel")
+        await splice(reader, writer, upstream_r, upstream_w)

@@ -149,6 +149,9 @@ async def run_daemon(
     proxy_port: int | None = None,
     proxy_rules: list | None = None,
     registry_mirror: str | None = None,
+    hijack_ca_dir: str | None = None,
+    hijack_hosts: list | None = None,
+    sni_proxy_port: int | None = None,
     object_storage_port: int | None = None,
     object_storage_root: str | None = None,
     manager_addr: str | None = None,
@@ -201,21 +204,39 @@ async def run_daemon(
         await tcp_server.start()
         engine.rpc_port = tcp_server.port
     proxy = None
-    if proxy_port is not None:
+    sni_proxy = None
+    if proxy_port is not None or sni_proxy_port is not None:
         from dragonfly2_tpu.daemon.proxy import (
+            HttpsHijack,
             ProxyConfig,
             ProxyRule,
             ProxyServer,
             RegistryMirrorConfig,
+            SniProxy,
         )
 
+        hijack = None
+        if hijack_ca_dir:
+            from dragonfly2_tpu.security.ca import CertificateAuthority
+            from dragonfly2_tpu.security.mitm import CertForger
+
+            hijack = HttpsHijack(
+                forger=CertForger(CertificateAuthority(hijack_ca_dir)),
+                hosts=tuple(hijack_hosts) if hijack_hosts else (r".*",),
+            )
         pcfg = ProxyConfig(
             rules=[r if isinstance(r, ProxyRule) else ProxyRule(regex=r) for r in (proxy_rules or [])],
             registry_mirror=RegistryMirrorConfig(base_url=registry_mirror) if registry_mirror else None,
+            https_hijack=hijack,
         )
-        proxy = ProxyServer(engine, host=ip, port=proxy_port, config=pcfg)
-        await proxy.start()
-        logger.info("proxy on %s:%d", ip, proxy.port)
+        proxy = ProxyServer(engine, host=ip, port=proxy_port or 0, config=pcfg)
+        if proxy_port is not None:
+            await proxy.start()
+            logger.info("proxy on %s:%d", ip, proxy.port)
+        if sni_proxy_port is not None:
+            sni_proxy = SniProxy(proxy, host=ip, port=sni_proxy_port, hijack=hijack)
+            await sni_proxy.start()
+            logger.info("sni proxy on %s:%d", ip, sni_proxy.port)
 
     objgw = None
     if object_storage_port is not None:
@@ -277,6 +298,8 @@ async def run_daemon(
     finally:
         announcer.cancel()
         await prober.stop()
+        if sni_proxy is not None:
+            await sni_proxy.stop()
         if proxy is not None:
             await proxy.stop()
         if objgw is not None:
@@ -331,6 +354,12 @@ def main() -> None:
                     help="URL regex routed through P2P (repeatable)")
     ap.add_argument("--registry-mirror", default=None,
                     help="upstream registry base URL for mirror mode")
+    ap.add_argument("--hijack-ca-dir", default=None,
+                    help="CA dir enabling HTTPS MITM on the proxy (forged leaf certs)")
+    ap.add_argument("--hijack-host", action="append", default=[],
+                    help="host regex to MITM (repeatable; default all when CA set)")
+    ap.add_argument("--sni-proxy-port", type=int, default=None,
+                    help="raw-TLS SNI proxy port (off by default)")
     ap.add_argument("--object-storage-port", type=int, default=None,
                     help="dfstore object gateway port (off by default)")
     ap.add_argument("--object-storage-root", default=None,
@@ -362,6 +391,9 @@ def main() -> None:
             proxy_port=args.proxy_port,
             proxy_rules=args.proxy_rule,
             registry_mirror=args.registry_mirror,
+            hijack_ca_dir=args.hijack_ca_dir,
+            hijack_hosts=args.hijack_host,
+            sni_proxy_port=args.sni_proxy_port,
             object_storage_port=args.object_storage_port,
             object_storage_root=args.object_storage_root,
             manager_addr=args.manager,

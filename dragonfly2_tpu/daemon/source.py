@@ -52,14 +52,18 @@ class ResourceClient:
 class HTTPSourceClient(ResourceClient):
     scheme = "http"
 
-    def __init__(self, *, chunk_size: int = 1 << 20, timeout: float = 300.0):
+    def __init__(
+        self, *, chunk_size: int = 1 << 20, timeout: float = 300.0, ssl_context=None
+    ):
         self.chunk_size = chunk_size
         self._timeout = aiohttp.ClientTimeout(total=timeout)
+        self._ssl = ssl_context  # e.g. cluster-CA trust for private https origins
         self._session: aiohttp.ClientSession | None = None
 
     def _sess(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession(timeout=self._timeout)
+            connector = aiohttp.TCPConnector(ssl=self._ssl) if self._ssl is not None else None
+            self._session = aiohttp.ClientSession(timeout=self._timeout, connector=connector)
         return self._session
 
     async def info(self, url: str, headers: dict | None = None) -> SourceInfo:
@@ -151,9 +155,9 @@ class FileSourceClient(ResourceClient):
 class SourceRegistry:
     """Scheme -> client registry (ref pkg/source register/loader)."""
 
-    def __init__(self) -> None:
+    def __init__(self, *, http_ssl=None) -> None:
         self._clients: dict[str, ResourceClient] = {}
-        http = HTTPSourceClient()
+        http = HTTPSourceClient(ssl_context=http_ssl)
         self.register("http", http)
         self.register("https", http)
         self.register("file", FileSourceClient())
