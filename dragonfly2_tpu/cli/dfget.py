@@ -85,8 +85,109 @@ async def ensure_daemon(
     return True
 
 
+async def single_download(
+    client: RpcClient, args: argparse.Namespace, url: str, output: str
+) -> None:
+    t0 = time.monotonic()
+    result = await client.call(
+        "download",
+        {
+            "url": url,
+            "output": os.path.abspath(output),
+            "tag": args.tag,
+            "application": args.application,
+            "digest": args.digest if url == args.url else "",
+            "filters": args.filter,
+        },
+        timeout=args.timeout,
+    )
+    elapsed = time.monotonic() - t0
+    size = result["content_length"]
+    rate = size / max(elapsed, 1e-6) / (1 << 20)
+    print(
+        f"downloaded {url} -> {output}: {size} bytes, "
+        f"{result['pieces']} pieces, {elapsed:.2f}s ({rate:.1f} MiB/s) "
+        f"task={result['task_id'][:16]}"
+    )
+
+
+def _accepted(url: str, accept: str, reject: str) -> bool:
+    import re
+
+    if reject and re.search(reject, url):
+        return False
+    if accept and not re.search(accept, url):
+        return False
+    return True
+
+
+async def recursive_download(client: RpcClient, args: argparse.Namespace) -> int:
+    """Breadth-first directory download (ref client/dfget/dfget.go:312
+    recursiveDownload + pkg/source URLEntry listing): list each directory URL
+    via the source client, download file entries through the daemon into the
+    mirrored tree under --output, queue subdirectories."""
+    from collections import deque
+
+    from dragonfly2_tpu.daemon.source import SourceRegistry
+
+    sources = SourceRegistry()
+    queue: deque[tuple[str, str, int]] = deque()  # (url, output_dir, level)
+    queue.append((args.url, args.output, args.level))
+    seen: set[str] = set()
+    failures = 0
+    try:
+        while queue:
+            url, out_dir, level = queue.popleft()
+            if args.level and level == 0:
+                continue
+            if url in seen:
+                continue  # loop prevention (ref downloadMap)
+            seen.add(url)
+            try:
+                entries = await sources.list_entries(url)
+            except Exception as e:
+                print(f"error: listing {url}: {e}", file=sys.stderr)
+                failures += 1
+                continue
+            sem = asyncio.Semaphore(args.jobs)
+            batch: list = []
+
+            async def fetch(entry_url: str, out_path: str) -> int:
+                async with sem:
+                    try:
+                        await single_download(client, args, entry_url, out_path)
+                        return 0
+                    except Exception as e:
+                        print(f"error: {entry_url}: {e}", file=sys.stderr)
+                        return 1
+
+            for entry in entries:
+                child_out = os.path.join(out_dir, entry.name)
+                if entry.is_dir:
+                    # accept-regex describes FILES; only reject prunes subtrees
+                    # (ref recursiveDownload queues dirs before accept checks)
+                    if args.reject_regex and not _accepted(entry.url, "", args.reject_regex):
+                        continue
+                    queue.append((entry.url, child_out, level - 1))
+                    continue
+                if not _accepted(entry.url, args.accept_regex, args.reject_regex):
+                    continue
+                if args.list_only:
+                    print(entry.url)
+                    continue
+                batch.append(fetch(entry.url, child_out))
+            if batch:
+                failures += sum(await asyncio.gather(*batch))
+    finally:
+        await sources.close()
+    return 1 if failures else 0
+
+
 async def download(args: argparse.Namespace) -> int:
     sock = args.sock
+    if args.recursive and args.list_only:
+        # pure listing never touches the daemon
+        return await recursive_download(None, args)
     if not await ensure_daemon(
         sock, args.scheduler, args.storage,
         no_spawn=args.no_spawn, spawn_timeout=args.spawn_timeout,
@@ -95,27 +196,9 @@ async def download(args: argparse.Namespace) -> int:
 
     client = RpcClient(sock, timeout=args.timeout)
     try:
-        t0 = time.monotonic()
-        result = await client.call(
-            "download",
-            {
-                "url": args.url,
-                "output": os.path.abspath(args.output),
-                "tag": args.tag,
-                "application": args.application,
-                "digest": args.digest,
-                "filters": args.filter,
-            },
-            timeout=args.timeout,
-        )
-        elapsed = time.monotonic() - t0
-        size = result["content_length"]
-        rate = size / max(elapsed, 1e-6) / (1 << 20)
-        print(
-            f"downloaded {args.url} -> {args.output}: {size} bytes, "
-            f"{result['pieces']} pieces, {elapsed:.2f}s ({rate:.1f} MiB/s) "
-            f"task={result['task_id'][:16]}"
-        )
+        if args.recursive:
+            return await recursive_download(client, args)
+        await single_download(client, args, args.url, args.output)
         return 0
     except Exception as e:
         print(f"error: {e}", file=sys.stderr)
@@ -135,6 +218,16 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--application", default="")
     ap.add_argument("--digest", default="", help="expected digest algo:hex")
     ap.add_argument("--filter", action="append", default=[], help="query params to drop from task id")
+    ap.add_argument("--recursive", action="store_true",
+                    help="treat URL as a directory and mirror it under --output")
+    ap.add_argument("--level", type=int, default=0,
+                    help="recursion depth limit (0 = unlimited)")
+    ap.add_argument("--accept-regex", default="", help="only download matching URLs")
+    ap.add_argument("--reject-regex", default="", help="skip matching URLs")
+    ap.add_argument("--list-only", action="store_true",
+                    help="with --recursive: print file URLs without downloading")
+    ap.add_argument("--jobs", type=int, default=8,
+                    help="concurrent file downloads under --recursive")
     ap.add_argument("--timeout", type=float, default=600.0)
     ap.add_argument("--spawn-timeout", type=float, default=10.0)
     ap.add_argument("--no-spawn", action="store_true", help="fail if daemon absent")

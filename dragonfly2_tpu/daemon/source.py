@@ -33,6 +33,17 @@ class SourceInfo:
     etag: str = ""
 
 
+@dataclass
+class URLEntry:
+    """One child of a listable URL (ref pkg/source source_client.go:129-137
+    URLEntry): used by recursive download. `name` is the final path element
+    only; `is_dir` entries are re-listed, files are downloaded."""
+
+    url: str
+    name: str
+    is_dir: bool
+
+
 class ResourceClient:
     scheme: str = ""
 
@@ -44,6 +55,11 @@ class ResourceClient:
     ) -> AsyncIterator[bytes]:
         raise NotImplementedError
         yield b""  # pragma: no cover
+
+    async def list_entries(self, url: str, headers: dict | None = None) -> list[URLEntry]:
+        """Children of a directory-like URL (ref source.List). Clients that
+        cannot enumerate raise SourceError."""
+        raise SourceError(f"scheme does not support listing: {url}")
 
     async def close(self) -> None:
         pass
@@ -108,6 +124,57 @@ class HTTPSourceClient(ResourceClient):
             async for chunk in resp.content.iter_chunked(self.chunk_size):
                 yield chunk
 
+    async def list_entries(self, url: str, headers: dict | None = None) -> list[URLEntry]:
+        """Parse an HTML auto-index page (nginx autoindex / python http.server
+        style): every <a href> that resolves to a strict child of this URL is
+        an entry; a trailing slash marks a directory."""
+        import html as _html
+        import re as _re
+        from urllib.parse import unquote, urljoin
+
+        req_base = url if url.endswith("/") else url + "/"
+        async with self._sess().get(
+            req_base, headers=headers or {}, allow_redirects=True
+        ) as resp:
+            if resp.status >= 400:
+                raise SourceError(f"listing {url}: HTTP {resp.status}")
+            ctype = resp.headers.get("Content-Type", "")
+            if "html" not in ctype:
+                raise SourceError(f"listing {url}: not an index page ({ctype})")
+            # resolve hrefs against where the index actually lives (the
+            # request may have been redirected, e.g. /dir -> /dir/ or a
+            # versioned path)
+            base = str(resp.url)
+            if not base.endswith("/"):
+                base += "/"
+            page = await resp.text()
+        entries: list[URLEntry] = []
+        seen: set[str] = set()
+        for href in _re.findall(r'<a\s[^>]*href="([^"]+)"', page, _re.IGNORECASE):
+            href = _html.unescape(href)
+            child = urljoin(base, href)
+            if not child.startswith(base) or child == base:
+                continue  # parent links, absolute escapes, sort links
+            rel = child[len(base):]
+            if "?" in rel or "#" in rel:
+                continue
+            is_dir = rel.endswith("/")
+            rel = rel.rstrip("/")
+            if "/" in rel or not rel:
+                continue  # only immediate children; deeper levels via recursion
+            name = unquote(rel)
+            # a hostile index can smuggle separators/.. through percent
+            # encoding (..%2F..) — the decoded NAME joins local paths, so it
+            # must be a single clean path element or the mirror writes
+            # outside --output
+            if not name or name in (".", "..") or "/" in name or "\\" in name:
+                continue
+            if name in seen:
+                continue
+            seen.add(name)
+            entries.append(URLEntry(url=child, name=name, is_dir=is_dir))
+        return entries
+
     async def close(self) -> None:
         if self._session is not None and not self._session.closed:
             await self._session.close()
@@ -151,6 +218,22 @@ class FileSourceClient(ResourceClient):
                 remaining -= len(chunk)
                 yield chunk
 
+    async def list_entries(self, url: str, headers: dict | None = None) -> list[URLEntry]:
+        p = self._path(url)
+        if not p.is_dir():
+            raise SourceError(f"not a directory: {p}")
+        entries = []
+        for child in sorted(p.iterdir()):
+            is_dir = child.is_dir()
+            entries.append(
+                URLEntry(
+                    url=f"file://{child}" + ("/" if is_dir else ""),
+                    name=child.name,
+                    is_dir=is_dir,
+                )
+            )
+        return entries
+
 
 class SourceRegistry:
     """Scheme -> client registry (ref pkg/source register/loader)."""
@@ -180,6 +263,9 @@ class SourceRegistry:
     ) -> AsyncIterator[bytes]:
         async for chunk in self.client_for(url).download(url, rng, headers):
             yield chunk
+
+    async def list_entries(self, url: str, headers: dict | None = None) -> list[URLEntry]:
+        return await self.client_for(url).list_entries(url, headers)
 
     async def close(self) -> None:
         seen = set()

@@ -282,3 +282,60 @@ class TestUploadServer:
                 await srv.stop()
 
         run(body())
+
+
+class TestSourceListing:
+    def test_http_autoindex_listing(self, run, tmp_path):
+        """HTML index parsing: children only, dirs flagged, decorations
+        (parent link, query-string sort links) skipped."""
+
+        async def body():
+            page = """<html><body>
+            <a href="../">../</a>
+            <a href="?C=M;O=A">sort</a>
+            <a href="a.bin">a.bin</a>
+            <a href="sub/">sub/</a>
+            <a href="b%20c.bin">b c.bin</a>
+            <a href="/abs-escape">escape</a>
+            <a href="a.bin">a.bin</a>
+            <a href="..%2F..%2Fetc%2Fevil">traversal</a>
+            <a href="%2e%2e">dotdot</a>
+            </body></html>"""
+
+            async def index(request):
+                return web.Response(text=page, content_type="text/html")
+
+            app = web.Application()
+            app.router.add_get("/dir/", index)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, "127.0.0.1", 0)
+            await site.start()
+            port = site._server.sockets[0].getsockname()[1]
+            reg = SourceRegistry()
+            try:
+                entries = await reg.list_entries(f"http://127.0.0.1:{port}/dir/")
+                by_name = {e.name: e for e in entries}
+                assert set(by_name) == {"a.bin", "sub", "b c.bin"}
+                assert by_name["sub"].is_dir and not by_name["a.bin"].is_dir
+                assert by_name["a.bin"].url.endswith("/dir/a.bin")
+            finally:
+                await reg.close()
+                await runner.cleanup()
+
+        run(body())
+
+    def test_file_listing(self, run, tmp_path):
+        async def body():
+            (tmp_path / "d").mkdir()
+            (tmp_path / "d" / "x.bin").write_bytes(b"x")
+            (tmp_path / "d" / "sub").mkdir()
+            reg = SourceRegistry()
+            entries = await reg.list_entries(f"file://{tmp_path}/d")
+            names = {(e.name, e.is_dir) for e in entries}
+            assert names == {("x.bin", False), ("sub", True)}
+            # non-listable: plain file
+            with pytest.raises(SourceError):
+                await reg.list_entries(f"file://{tmp_path}/d/x.bin")
+
+        run(body())

@@ -223,3 +223,83 @@ class TestMultiProcess:
                     p.wait(timeout=10)
                 except subprocess.TimeoutExpired:
                     p.kill()
+
+    def test_recursive_download(self, tmp_path):
+        """dfget --recursive mirrors an HTTP auto-index tree with per-file
+        sha256 parity (ref test/e2e/dfget_test.go:203-221 recursive case)."""
+        import socket as _socket
+        import urllib.request
+
+        env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+        tree = {
+            "a.bin": os.urandom(300_000),
+            "sub/b.bin": os.urandom(200_000),
+            "sub/deep/c.bin": os.urandom(100_000),
+            "sub/skip.txt": b"rejected by regex",
+        }
+        root = tmp_path / "tree"
+        for rel, data in tree.items():
+            p = root / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_bytes(data)
+
+        procs = []
+        try:
+            with _socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                http_port = s.getsockname()[1]
+            origin = subprocess.Popen(
+                [sys.executable, "-m", "http.server", str(http_port),
+                 "--bind", "127.0.0.1", "--directory", str(root)],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
+            )
+            procs.append(origin)
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    urllib.request.urlopen(f"http://127.0.0.1:{http_port}/", timeout=1)
+                    break
+                except OSError:
+                    time.sleep(0.1)
+
+            sched = subprocess.Popen(
+                [sys.executable, "-m", "dragonfly2_tpu.scheduler.server", "--port", "0",
+                 "--telemetry-dir", str(tmp_path / "tel")],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+            )
+            procs.append(sched)
+            line = sched.stdout.readline()
+            assert line.startswith("SCHEDULER_READY"), line
+            sched_addr = line.split()[1]
+
+            sock = str(tmp_path / "dr.sock")
+            d = subprocess.Popen(
+                [sys.executable, "-m", "dragonfly2_tpu.daemon.server",
+                 "--scheduler", sched_addr, "--sock", sock,
+                 "--storage", str(tmp_path / "store"), "--hostname", "dr"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+            )
+            procs.append(d)
+            assert d.stdout.readline().startswith("DAEMON_READY")
+
+            out_dir = tmp_path / "mirror"
+            r = subprocess.run(
+                [sys.executable, "-m", "dragonfly2_tpu.cli.dfget",
+                 f"http://127.0.0.1:{http_port}/", "-O", str(out_dir),
+                 "--recursive", "--reject-regex", r"\.txt$",
+                 "--sock", sock, "--no-spawn", "--scheduler", sched_addr],
+                capture_output=True, text=True, env=env, timeout=120,
+            )
+            assert r.returncode == 0, r.stderr + r.stdout
+            for rel in ["a.bin", "sub/b.bin", "sub/deep/c.bin"]:
+                got = (out_dir / rel).read_bytes()
+                assert hashlib.sha256(got).hexdigest() == hashlib.sha256(tree[rel]).hexdigest(), rel
+            assert not (out_dir / "sub/skip.txt").exists()  # reject regex
+        finally:
+            for p in procs:
+                p.send_signal(signal.SIGTERM)
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
