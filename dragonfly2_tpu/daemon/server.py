@@ -154,6 +154,7 @@ async def run_daemon(
     sni_proxy_port: int | None = None,
     object_storage_port: int | None = None,
     object_storage_root: str | None = None,
+    object_storage_backend: str = "fs",
     manager_addr: str | None = None,
     announce_interval: float = 30.0,
     probe_interval: float | None = None,
@@ -243,7 +244,19 @@ async def run_daemon(
         from dragonfly2_tpu.daemon.objectgw import ObjectGateway
         from dragonfly2_tpu.objectstorage import new_backend
 
-        backend = new_backend("fs", root=object_storage_root or (str(storage_root) + "-objects"))
+        if object_storage_backend == "s3":
+            # endpoint/credentials from the environment, the S3 convention
+            from dragonfly2_tpu.objectstorage.s3client import S3Config
+
+            s3cfg = S3Config.from_env()
+            backend = new_backend(
+                "s3", endpoint=s3cfg.endpoint, access_key=s3cfg.access_key,
+                secret_key=s3cfg.secret_key, region=s3cfg.region,
+            )
+        else:
+            backend = new_backend(
+                "fs", root=object_storage_root or (str(storage_root) + "-objects")
+            )
         objgw = ObjectGateway(engine, backend, host=ip, port=object_storage_port)
         await objgw.start()
 
@@ -364,6 +377,8 @@ def main() -> None:
                     help="dfstore object gateway port (off by default)")
     ap.add_argument("--object-storage-root", default=None,
                     help="fs backend root (default: <storage>-objects)")
+    ap.add_argument("--object-storage-backend", default="fs", choices=["fs", "s3"],
+                    help="object store behind the gateway; s3 reads AWS_* env vars")
     ap.add_argument("--rpc-port", type=int, default=None,
                     help="TCP RPC port (seed peers always listen; 0 = ephemeral)")
     ap.add_argument("--manager", default=None, help="manager address host:port")
@@ -371,6 +386,11 @@ def main() -> None:
                     help="RTT probe cadence in seconds (default 20 min)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
+    if args.object_storage_backend == "s3":
+        if args.object_storage_root:
+            ap.error("--object-storage-root applies to the fs backend only")
+        if not (os.environ.get("AWS_ENDPOINT_URL") or os.environ.get("DF_S3_ENDPOINT")):
+            ap.error("--object-storage-backend s3 requires AWS_ENDPOINT_URL in the environment")
     logging.basicConfig(
         level=logging.DEBUG if args.verbose else logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
@@ -396,6 +416,7 @@ def main() -> None:
             sni_proxy_port=args.sni_proxy_port,
             object_storage_port=args.object_storage_port,
             object_storage_root=args.object_storage_root,
+            object_storage_backend=args.object_storage_backend,
             manager_addr=args.manager,
             probe_interval=args.probe_interval,
         )

@@ -3,9 +3,11 @@
 Parity with reference pkg/source (source_client.go:102-137 ResourceClient:
 GetContentLength / IsSupportRange / Download / GetLastModified, plus the
 scheme registry and clients/{http,s3,oss,hdfs,oras}). Here: http(s) via
-aiohttp and file:// for local staging + tests (this container has zero
-egress, so every origin in practice is localhost or a file). The s3/oss/obs
-family rides the same interface once an object-storage backend lands.
+aiohttp, file:// for local staging + tests, and s3:// over the SigV4 client
+(any S3-dialect endpoint — which is how OSS/OBS are reached too, via their
+S3-compatibility modes). All clients support URL-entry listing where the
+protocol can enumerate (HTML auto-index, directory scan, ListObjectsV2),
+feeding dfget --recursive.
 """
 
 from __future__ import annotations
@@ -235,6 +237,89 @@ class FileSourceClient(ResourceClient):
         return entries
 
 
+class S3SourceClient(ResourceClient):
+    """s3://bucket/key origins (ref pkg/source/clients/s3protocol): signed
+    HeadObject/ranged GetObject against any S3-dialect endpoint, plus
+    delimiter-based listing so s3:// trees work with recursive download.
+    Credentials/endpoint come from the environment (AWS_ENDPOINT_URL,
+    AWS_ACCESS_KEY_ID, AWS_SECRET_ACCESS_KEY, AWS_REGION) unless a
+    pre-built client is injected."""
+
+    scheme = "s3"
+
+    def __init__(self, client=None):
+        self._client = client  # lazily built from env on first use
+
+    def _c(self):
+        if self._client is None:
+            from dragonfly2_tpu.objectstorage.s3client import S3Client, S3Config
+
+            self._client = S3Client(S3Config.from_env())
+        return self._client
+
+    @staticmethod
+    def _split(url: str) -> tuple[str, str]:
+        parts = urlsplit(url)
+        bucket, key = parts.netloc, parts.path.lstrip("/")
+        if not bucket:
+            raise SourceError(f"bad s3 url (no bucket): {url}")
+        return bucket, key
+
+    async def info(self, url: str, headers: dict | None = None) -> SourceInfo:
+        from dragonfly2_tpu.objectstorage.s3client import S3Error
+
+        bucket, key = self._split(url)
+        try:
+            obj = await self._c().head_object(bucket, key)
+        except S3Error as e:
+            raise SourceError(f"s3 head {url}: {e}") from e
+        return SourceInfo(
+            content_length=obj.size, supports_range=True,
+            last_modified=obj.last_modified, etag=obj.etag,
+        )
+
+    async def download(
+        self, url: str, rng: Range | None = None, headers: dict | None = None
+    ) -> AsyncIterator[bytes]:
+        from dragonfly2_tpu.objectstorage.s3client import S3Error
+
+        bucket, key = self._split(url)
+        try:
+            async for chunk in self._c().get_object(
+                bucket, key, range_header=rng.header() if rng is not None else ""
+            ):
+                yield chunk
+        except S3Error as e:
+            raise SourceError(f"s3 get {url}: {e}") from e
+
+    async def list_entries(self, url: str, headers: dict | None = None) -> list[URLEntry]:
+        from dragonfly2_tpu.objectstorage.s3client import S3Error
+
+        bucket, prefix = self._split(url)
+        if prefix and not prefix.endswith("/"):
+            prefix += "/"
+        try:
+            res = await self._c().list_objects(bucket, prefix=prefix, delimiter="/")
+        except S3Error as e:
+            raise SourceError(f"s3 list {url}: {e}") from e
+        entries: list[URLEntry] = []
+        for o in res.objects:
+            name = o.key[len(prefix):]
+            if not name or "/" in name:
+                continue
+            entries.append(URLEntry(url=f"s3://{bucket}/{o.key}", name=name, is_dir=False))
+        for p in res.common_prefixes:
+            name = p[len(prefix):].rstrip("/")
+            if not name or "/" in name:
+                continue
+            entries.append(URLEntry(url=f"s3://{bucket}/{p}", name=name, is_dir=True))
+        return entries
+
+    async def close(self) -> None:
+        if self._client is not None:
+            await self._client.close()
+
+
 class SourceRegistry:
     """Scheme -> client registry (ref pkg/source register/loader)."""
 
@@ -244,6 +329,7 @@ class SourceRegistry:
         self.register("http", http)
         self.register("https", http)
         self.register("file", FileSourceClient())
+        self.register("s3", S3SourceClient())
 
     def register(self, scheme: str, client: ResourceClient) -> None:
         self._clients[scheme] = client

@@ -277,22 +277,147 @@ class LocalFSBackend(ObjectStorageBackend):
         return self._obj_path(bucket, key).resolve().as_uri()
 
 
-class S3Backend(ObjectStorageBackend):  # pragma: no cover - gated on boto3
-    """S3/OSS/OBS-compatible backend (ref pkg/objectstorage/s3.go). boto3 is
-    not baked into this image; constructing this without it raises with a
-    clear message instead of failing on first use."""
+class S3Backend(ObjectStorageBackend):
+    """S3/OSS/OBS-compatible backend (ref pkg/objectstorage/s3.go) over the
+    dependency-free SigV4 client — works against any S3 dialect endpoint
+    (minio, ceph-rgw, OSS/OBS in S3 mode)."""
 
     name = "s3"
 
-    def __init__(self, *, endpoint: str, access_key: str, secret_key: str, region: str = ""):
+    def __init__(
+        self,
+        *,
+        endpoint: str,
+        access_key: str,
+        secret_key: str,
+        region: str = "us-east-1",
+    ):
+        from dragonfly2_tpu.objectstorage.s3client import S3Client, S3Config
+
+        self._client = S3Client(
+            S3Config(
+                endpoint=endpoint, access_key=access_key,
+                secret_key=secret_key, region=region,
+            )
+        )
+
+    @staticmethod
+    def _wrap(e: Exception) -> ObjectStorageError:
+        from dragonfly2_tpu.objectstorage.s3client import S3Error
+
+        if isinstance(e, S3Error):
+            if e.status == 404:
+                return ObjectStorageError(str(e), code="not_found")
+            if e.status == 409 or e.code in ("BucketAlreadyOwnedByYou", "BucketAlreadyExists"):
+                return ObjectStorageError(str(e), code="already_exists")
+            return ObjectStorageError(str(e), code="invalid" if e.status < 500 else "internal")
+        return ObjectStorageError(str(e))
+
+    async def create_bucket(self, bucket: str) -> None:
         try:
-            import boto3  # noqa: F401
-        except ImportError as e:
-            raise ObjectStorageError(
-                "s3 backend requires boto3, which is not installed in this "
-                "environment; use the fs backend or install boto3"
-            ) from e
-        raise NotImplementedError("S3 backend wiring lands with a boto3-equipped runtime")
+            await self._client.create_bucket(bucket)
+        except Exception as e:
+            raise self._wrap(e) from e
+
+    async def delete_bucket(self, bucket: str) -> None:
+        try:
+            await self._client.delete_bucket(bucket)
+        except Exception as e:
+            raise self._wrap(e) from e
+
+    async def list_buckets(self) -> list[Bucket]:
+        try:
+            return [Bucket(name=n) for n in await self._client.list_buckets()]
+        except Exception as e:
+            raise self._wrap(e) from e
+
+    async def bucket_exists(self, bucket: str) -> bool:
+        try:
+            return await self._client.bucket_exists(bucket)
+        except Exception as e:
+            raise self._wrap(e) from e
+
+    async def put_object(
+        self,
+        bucket: str,
+        key: str,
+        data: Union[bytes, AsyncIterator[bytes]],
+        *,
+        content_type: str = "application/octet-stream",
+        user_metadata: dict | None = None,
+    ) -> ObjectMetadata:
+        _safe_key(key)
+        try:
+            if isinstance(data, (bytes, bytearray)):
+                digest = hashlib.sha256(data).hexdigest()
+                length = len(data)
+                etag = await self._client.put_object(
+                    bucket, key, bytes(data),
+                    content_type=content_type, user_metadata=user_metadata,
+                )
+            else:
+                # streamed: UNSIGNED-PAYLOAD signing, one incremental-hash
+                # pass, never buffered (multi-GB artifacts through the
+                # gateway stay out of RAM)
+                etag, length, digest = await self._client.put_object_stream(
+                    bucket, key, data,
+                    content_type=content_type, user_metadata=user_metadata,
+                )
+        except Exception as e:
+            raise self._wrap(e) from e
+        return ObjectMetadata(
+            key=key,
+            content_length=length,
+            digest=f"sha256:{digest}",
+            etag=etag,
+            content_type=content_type,
+            last_modified=time.time(),
+            user_metadata=dict(user_metadata or {}),
+        )
+
+    async def get_object(self, bucket: str, key: str) -> bytes:
+        buf = bytearray()
+        try:
+            async for chunk in self._client.get_object(bucket, key):
+                buf.extend(chunk)
+        except Exception as e:
+            raise self._wrap(e) from e
+        return bytes(buf)
+
+    async def stat_object(self, bucket: str, key: str) -> ObjectMetadata:
+        try:
+            obj = await self._client.head_object(bucket, key)
+        except Exception as e:
+            raise self._wrap(e) from e
+        return ObjectMetadata(
+            key=key,
+            content_length=obj.size,
+            etag=obj.etag,
+            content_type=obj.content_type or "application/octet-stream",
+            user_metadata=dict(obj.user_metadata),
+        )
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        try:
+            await self._client.delete_object(bucket, key)
+        except Exception as e:
+            raise self._wrap(e) from e
+
+    async def list_objects(self, bucket: str, prefix: str = "") -> list[ObjectMetadata]:
+        try:
+            res = await self._client.list_objects(bucket, prefix=prefix)
+        except Exception as e:
+            raise self._wrap(e) from e
+        return [
+            ObjectMetadata(key=o.key, content_length=o.size, etag=o.etag)
+            for o in res.objects
+        ]
+
+    def presign_get(self, bucket: str, key: str) -> str:
+        return self._client.presign_get(bucket, key)
+
+    async def close(self) -> None:
+        await self._client.close()
 
 
 _BACKENDS = {"fs": LocalFSBackend, "s3": S3Backend}
