@@ -11,8 +11,9 @@ spaghetti:
 P2P mode: a score-based PieceDispatcher (ref piece_dispatcher.go:33-124,
 ε-random exploration) assigns each missing piece to a parent that has it;
 N workers pull assignments, HTTP-range the bytes from the parent's upload
-server, verify, write, and report. Parent piece availability is polled from
-the parents' /metadata endpoint (replacing the reference's bidi
+server, verify, write, and report. Parent piece availability is pushed via
+long-poll on the parents' /metadata endpoint (`?since=<version>&wait=` parks
+until the parent's piece state advances — replacing the reference's bidi
 SyncPieceTasks streams). Failures block the parent and trigger a scheduler
 reschedule; after the retry budget the conductor cuts over to back-to-source
 for the remaining pieces (ref partial back-source path).

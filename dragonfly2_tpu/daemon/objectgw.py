@@ -108,8 +108,13 @@ class ObjectGateway:
 
     async def _list_objects(self, req: web.Request) -> web.Response:
         try:
+            limit_s = req.query.get("limit", "")
+            limit = max(1, int(limit_s)) if limit_s else None
+        except ValueError:
+            return web.json_response({"error": "limit must be an integer"}, status=400)
+        try:
             objs = await self.backend.list_objects(
-                req.match_info["bucket"], prefix=req.query.get("prefix", "")
+                req.match_info["bucket"], prefix=req.query.get("prefix", ""), limit=limit
             )
         except ObjectStorageError as e:
             return self._err(e)

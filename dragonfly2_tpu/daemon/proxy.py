@@ -267,8 +267,11 @@ class ProxyServer:
         reader: asyncio.StreamReader, writer: asyncio.StreamWriter,
     ) -> None:
         """Terminate the client's TLS with a forged leaf for `host` and route
-        the decrypted request through the normal rule engine (ref cert.go
-        MITM path). One request per tunnel — responses are close-delimited."""
+        decrypted requests through the normal rule engine (ref cert.go MITM
+        path). The tunnel is kept alive across requests when the response can
+        be length-framed, so registry clients doing token-fetch + manifest on
+        one CONNECT don't see an unexpected close; a close-delimited response
+        ends the tunnel."""
         from dragonfly2_tpu.daemon import metrics
 
         try:
@@ -288,17 +291,49 @@ class ProxyServer:
         except (OSError, asyncio.IncompleteReadError) as e:
             logger.debug("MITM handshake with client failed for %s: %s", host, e)
             return
-        metrics.PROXY_REQUEST_TOTAL.inc(via="mitm")
-        request = await self._read_request(reader)
-        if request is None:
-            return
-        method, req_target, headers = request
-        if req_target.startswith("http://") or req_target.startswith("https://"):
-            url = req_target  # absolute-form inside the tunnel (unusual but legal)
-        else:
-            netloc = host if port == 443 else f"{host}:{port}"
-            url = f"https://{netloc}{req_target}"
-        await self._route(method, url, headers, reader, writer)
+        netloc = host if port == 443 else f"{host}:{port}"
+        await self._serve_tunnel_requests(
+            reader,
+            writer,
+            # absolute-form inside the tunnel is unusual but legal
+            lambda t: t if t.startswith(("http://", "https://")) else f"https://{netloc}{t}",
+            via="mitm",
+        )
+
+    TUNNEL_IDLE_TIMEOUT_S = 75.0
+
+    async def _serve_tunnel_requests(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+        build_url,
+        via: str,
+    ) -> None:
+        """Keep-alive request loop over a decrypted (MITM'd) tunnel, shared by
+        the CONNECT-MITM and SNI-hijack paths. Length-framed responses keep
+        the tunnel open so registry clients doing token-fetch + manifest on
+        one connection don't see an unexpected close; a close-delimited
+        response or an idle period ends it."""
+        from dragonfly2_tpu.daemon import metrics
+
+        while True:
+            try:
+                request = await asyncio.wait_for(
+                    self._read_request(reader), self.TUNNEL_IDLE_TIMEOUT_S
+                )
+            except asyncio.TimeoutError:
+                return  # idle pooled connection: reclaim the task/fd
+            if request is None:
+                return
+            metrics.PROXY_REQUEST_TOTAL.inc(via=via)
+            method, req_target, headers = request
+            client_wants_close = "close" in headers.get("connection", "").lower()
+            alive = await self._route(
+                method, build_url(req_target), headers, reader, writer,
+                keepalive=not client_wants_close,
+            )
+            if not alive or client_wants_close:
+                return
 
     # ---- routing ----
 
@@ -331,7 +366,11 @@ class ProxyServer:
         headers: dict[str, str],
         reader: asyncio.StreamReader,
         writer: asyncio.StreamWriter,
-    ) -> None:
+        keepalive: bool = False,
+    ) -> bool:
+        """Serve one request. Returns True iff the response was length-framed
+        with keep-alive, so the caller may read another request from the same
+        connection."""
         from dragonfly2_tpu.daemon import metrics
 
         route, url = self._decide(method, url)
@@ -352,10 +391,11 @@ class ProxyServer:
                 logger.warning("p2p route for %s failed (%s); falling back", url, e)
                 stream = None
             if stream is not None:
-                await self._serve_p2p(stream, writer)
-                return
+                return await self._serve_p2p(stream, writer, keepalive=keepalive)
         metrics.PROXY_REQUEST_TOTAL.inc(via="passthrough")
-        await self._serve_passthrough(method, url, fwd, body, writer)
+        return await self._serve_passthrough(
+            method, url, fwd, body, writer, keepalive=keepalive
+        )
 
     @staticmethod
     async def _read_body(reader: asyncio.StreamReader, headers: dict[str, str]) -> bytes:
@@ -386,14 +426,17 @@ class ProxyServer:
             digest = m.group(1)
         return await self.engine.stream_task(url, headers=headers, digest=digest)
 
-    async def _serve_p2p(self, stream, writer: asyncio.StreamWriter) -> None:
+    async def _serve_p2p(
+        self, stream, writer: asyncio.StreamWriter, keepalive: bool = False
+    ) -> bool:
         length, body = stream
+        conn = b"keep-alive" if keepalive else b"close"
         writer.write(
             b"HTTP/1.1 200 OK\r\n"
             + f"Content-Length: {length}\r\n".encode()
             + b"Content-Type: application/octet-stream\r\n"
             + b"X-Dragonfly-Via: p2p\r\n"
-            + b"Connection: close\r\n\r\n"
+            + b"Connection: " + conn + b"\r\n\r\n"
         )
         await writer.drain()
         # headers are out: any failure past this point aborts the connection
@@ -401,6 +444,7 @@ class ProxyServer:
         async for chunk in body:
             writer.write(chunk)
             await writer.drain()
+        return keepalive
 
     async def _serve_passthrough(
         self,
@@ -409,7 +453,8 @@ class ProxyServer:
         headers: dict[str, str],
         body: bytes,
         writer: asyncio.StreamWriter,
-    ) -> None:
+        keepalive: bool = False,
+    ) -> bool:
         async with self._http().request(
             method, url, headers=headers, data=body or None, allow_redirects=False
         ) as resp:
@@ -420,19 +465,19 @@ class ProxyServer:
                 writer.write(f"{k}: {v}\r\n".encode("latin1"))
             data_known = resp.headers.get("Content-Length")
             if data_known is not None:
+                keep = keepalive
+                conn = b"keep-alive" if keep else b"close"
                 writer.write(f"Content-Length: {data_known}\r\n".encode())
-                writer.write(b"Connection: close\r\n\r\n")
-                await writer.drain()
-                async for chunk in resp.content.iter_chunked(64 << 10):
-                    writer.write(chunk)
-                    await writer.drain()
+                writer.write(b"Connection: " + conn + b"\r\n\r\n")
             else:
-                # unknown length: close-delimited response
+                # unknown length: close-delimited response, tunnel must end
+                keep = False
                 writer.write(b"Connection: close\r\n\r\n")
+            await writer.drain()
+            async for chunk in resp.content.iter_chunked(64 << 10):
+                writer.write(chunk)
                 await writer.drain()
-                async for chunk in resp.content.iter_chunked(64 << 10):
-                    writer.write(chunk)
-                    await writer.drain()
+            return keep
 
 
 class SniProxy:
@@ -575,8 +620,6 @@ class SniProxy:
     async def _handle_hijack(
         self, sni: str, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
-        from dragonfly2_tpu.daemon import metrics
-
         import ssl as _ssl
 
         ctx = self.hijack.forger.context_for(sni)
@@ -593,18 +636,17 @@ class SniProxy:
             logger.debug("sni MITM handshake failed for %s: %s", sni, e)
             return
         writer._transport = transport  # rewire like StreamWriter.start_tls does
-        metrics.PROXY_REQUEST_TOTAL.inc(via="sni_mitm")
-        request = await self.proxy._read_request(reader)
-        if request is None:
-            return
-        method, target, headers = request
         # route via the RESOLVED upstream: with transparent interception the
         # SNI name's DNS typically points back at this proxy — dialing it
         # again would self-loop. The Host header still carries the SNI name.
         up_host, up_port = self.resolve(sni)
         netloc = up_host if up_port == 443 else f"{up_host}:{up_port}"
-        url = f"https://{netloc}{target}" if target.startswith("/") else target
-        await self.proxy._route(method, url, headers, reader, writer)
+        await self.proxy._serve_tunnel_requests(
+            reader,
+            writer,
+            lambda t: f"https://{netloc}{t}" if t.startswith("/") else t,
+            via="sni_mitm",
+        )
 
     async def _handle_tunnel(
         self, sni: str, reader: asyncio.StreamReader, writer: asyncio.StreamWriter

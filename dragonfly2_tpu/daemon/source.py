@@ -305,12 +305,12 @@ class S3SourceClient(ResourceClient):
         entries: list[URLEntry] = []
         for o in res.objects:
             name = o.key[len(prefix):]
-            if not name or "/" in name:
+            if not name or name in (".", "..") or "/" in name or "\\" in name:
                 continue
             entries.append(URLEntry(url=f"s3://{bucket}/{o.key}", name=name, is_dir=False))
         for p in res.common_prefixes:
             name = p[len(prefix):].rstrip("/")
-            if not name or "/" in name:
+            if not name or name in (".", "..") or "/" in name or "\\" in name:
                 continue
             entries.append(URLEntry(url=f"s3://{bucket}/{p}", name=name, is_dir=True))
         return entries

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import math
 from typing import Optional
 
 from aiohttp import web
@@ -88,8 +89,10 @@ class UploadServer:
         since = request.query.get("since")
         if since is not None:
             try:
-                wait_s = min(float(request.query.get("wait", "25")), self.MAX_LONGPOLL_S)
-                await ts.wait_version(int(since), max(0.0, wait_s))
+                wait_s = float(request.query.get("wait", "25"))
+                if not math.isfinite(wait_s):
+                    raise web.HTTPBadRequest(text="wait must be finite")
+                await ts.wait_version(int(since), min(max(0.0, wait_s), self.MAX_LONGPOLL_S))
             except ValueError:
                 raise web.HTTPBadRequest(text="since/wait must be numeric")
         m = ts.meta

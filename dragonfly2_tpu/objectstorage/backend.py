@@ -3,9 +3,9 @@
 Interface parity with reference pkg/objectstorage/objectstorage.go:65-105
 (GetBucketMetadata/CreateBucket/ListBucketMetadatas, GetObject/PutObject/
 DeleteObject/IsObjectExist/GetObjectMetadatas, GetSignURL) re-shaped async.
-Backends: `fs` (local filesystem, always available) and `s3` (gated on boto3,
-which is not baked into this image — the class raises a clear error at
-construction instead of at first use).
+Backends: `fs` (local filesystem, always available) and `s3` (backed by the
+in-repo hand-rolled SigV4 client, `objectstorage/s3client.py` — no SDK
+dependency).
 
 The filesystem layout is `root/<bucket>/<key>` with a sidecar
 `root/.meta/<bucket>/<key>.json` carrying digest/content-type/custom
@@ -98,7 +98,10 @@ class ObjectStorageBackend:
         except ObjectStorageError:
             return False
 
-    async def list_objects(self, bucket: str, prefix: str = "") -> list[ObjectMetadata]:
+    async def list_objects(
+        self, bucket: str, prefix: str = "", limit: int | None = None
+    ) -> list[ObjectMetadata]:
+        """List objects under `prefix`; `limit` caps the result count."""
         raise NotImplementedError
 
     def presign_get(self, bucket: str, key: str) -> str:
@@ -262,7 +265,9 @@ class LocalFSBackend(ObjectStorageBackend):
         path.unlink(missing_ok=True)
         self._meta_path(bucket, key).unlink(missing_ok=True)
 
-    async def list_objects(self, bucket: str, prefix: str = "") -> list[ObjectMetadata]:
+    async def list_objects(
+        self, bucket: str, prefix: str = "", limit: int | None = None
+    ) -> list[ObjectMetadata]:
         d = self._require_bucket(bucket)
         out = []
         for p in sorted(d.rglob("*")):
@@ -271,6 +276,8 @@ class LocalFSBackend(ObjectStorageBackend):
             key = p.relative_to(d).as_posix()
             if key.startswith(prefix):
                 out.append(await self.stat_object(bucket, key))
+                if limit is not None and len(out) >= limit:
+                    break
         return out
 
     def presign_get(self, bucket: str, key: str) -> str:
@@ -403,9 +410,11 @@ class S3Backend(ObjectStorageBackend):
         except Exception as e:
             raise self._wrap(e) from e
 
-    async def list_objects(self, bucket: str, prefix: str = "") -> list[ObjectMetadata]:
+    async def list_objects(
+        self, bucket: str, prefix: str = "", limit: int | None = None
+    ) -> list[ObjectMetadata]:
         try:
-            res = await self._client.list_objects(bucket, prefix=prefix)
+            res = await self._client.list_objects(bucket, prefix=prefix, limit=limit)
         except Exception as e:
             raise self._wrap(e) from e
         return [

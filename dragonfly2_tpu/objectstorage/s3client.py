@@ -147,8 +147,15 @@ class S3Client:
         parts = urlsplit(cfg.endpoint)
         if not parts.scheme or not parts.netloc:
             raise S3Error(f"bad S3 endpoint: {cfg.endpoint!r}")
-        self._base = f"{parts.scheme}://{parts.netloc}"
-        self._host = parts.netloc
+        netloc = parts.netloc
+        # aiohttp strips a default port when deriving Host from the URL; the
+        # signed host header must match what goes on the wire, so normalize
+        # ':80'/':443' away up front.
+        default_port = {"http": ":80", "https": ":443"}.get(parts.scheme)
+        if default_port and netloc.endswith(default_port):
+            netloc = netloc[: -len(default_port)]
+        self._base = f"{parts.scheme}://{netloc}"
+        self._host = netloc
         self._timeout = aiohttp.ClientTimeout(total=timeout)
         self._session: aiohttp.ClientSession | None = None
 
@@ -356,9 +363,19 @@ class S3Client:
         resp.release()
 
     async def list_objects(
-        self, bucket: str, *, prefix: str = "", delimiter: str = "", max_keys: int = 1000
+        self,
+        bucket: str,
+        *,
+        prefix: str = "",
+        delimiter: str = "",
+        max_keys: int = 1000,
+        limit: int | None = None,
     ) -> S3ListResult:
-        """ListObjectsV2 with continuation (ref s3.go GetObjectMetadatas)."""
+        """ListObjectsV2 with continuation (ref s3.go GetObjectMetadatas).
+
+        `max_keys` is the per-page size; pagination follows continuation
+        tokens to exhaustion unless `limit` caps the total number of object
+        entries materialized (the result may then be a truncated view)."""
         out = S3ListResult()
         token = ""
         while True:
@@ -387,6 +404,9 @@ class S3Client:
                     # dedup across pages: a prefix spanning a page boundary
                     # may be announced on both sides of it
                     out.common_prefixes.append(p)
+            if limit is not None and len(out.objects) >= limit:
+                del out.objects[limit:]
+                break
             if (root.findtext(f"{ns}IsTruncated") or "").lower() == "true":
                 token = root.findtext(f"{ns}NextContinuationToken") or ""
                 if not token:

@@ -445,6 +445,77 @@ class TestHttpsInterception:
 
         run(body())
 
+    def test_connect_mitm_keepalive_two_requests(self, run, tmp_path, tls_world):
+        """Two sequential requests ride ONE CONNECT tunnel: length-framed
+        responses are marked keep-alive, and a client 'Connection: close' on
+        the second request is honored (registry clients do token-fetch +
+        manifest on one connection)."""
+
+        async def body():
+            from dragonfly2_tpu.daemon.proxy import HttpsHijack
+            from dragonfly2_tpu.daemon.source import SourceRegistry
+
+            async def read_response(reader):
+                status = (await reader.readline()).decode().split()[1]
+                headers = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    k, v = line.decode().split(":", 1)
+                    headers[k.strip().lower()] = v.strip()
+                body = await reader.readexactly(int(headers.get("content-length", "0")))
+                return status, headers, body
+
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            files = {"a.bin": PAYLOAD, "b.txt": b"second-req"}
+            async with TlsOrigin(files, tls_world["server_ctx"]) as origin:
+                engine = make_engine(tmp_path, client, "kapeer")
+                engine.sources = SourceRegistry(http_ssl=tls_world["trust_ctx"])
+                await engine.start()
+                proxy = ProxyServer(
+                    engine,
+                    config=ProxyConfig(
+                        rules=[ProxyRule(regex=r"\.bin$")],
+                        https_hijack=HttpsHijack(forger=tls_world["forger"]),
+                        upstream_ssl=tls_world["trust_ctx"],
+                    ),
+                )
+                await proxy.start()
+                try:
+                    reader, writer = await asyncio.open_connection("127.0.0.1", proxy.port)
+                    writer.write(
+                        f"CONNECT localhost:{origin.port} HTTP/1.1\r\n\r\n".encode()
+                    )
+                    await writer.drain()
+                    assert b"200" in await reader.readline()
+                    while (await reader.readline()) not in (b"\r\n", b"\n", b""):
+                        pass
+                    await writer.start_tls(
+                        tls_world["trust_ctx"], server_hostname="localhost"
+                    )
+                    writer.write(b"GET /a.bin HTTP/1.1\r\nHost: localhost\r\n\r\n")
+                    await writer.drain()
+                    st, h, data = await read_response(reader)
+                    assert st == "200" and data == PAYLOAD
+                    assert h.get("connection") == "keep-alive"
+                    assert h.get("x-dragonfly-via") == "p2p"
+                    writer.write(
+                        b"GET /b.txt HTTP/1.1\r\nHost: localhost\r\n"
+                        b"Connection: close\r\n\r\n"
+                    )
+                    await writer.drain()
+                    st, h, data = await read_response(reader)
+                    assert st == "200" and data == b"second-req"
+                    assert h.get("connection") == "close"
+                    writer.close()
+                finally:
+                    await proxy.stop()
+                    await engine.stop()
+
+        run(body())
+
     def test_sni_hijack_serves_via_p2p(self, run, tmp_path, tls_world):
         """Raw TLS to the SNI proxy (no CONNECT): SNI is peeked, TLS is
         terminated with a forged leaf, and the request rides P2P."""
