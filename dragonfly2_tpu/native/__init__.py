@@ -8,10 +8,16 @@ embeddings at refresh time, the C++ library scores (child, parent) batches
 through the MLP head with no Python/JAX on the hot path.
 """
 
+from dragonfly2_tpu.native.microbatch import MicroBatchScorer
 from dragonfly2_tpu.native.scorer import (
     NativeScorer,
     build_native_lib,
     export_scorer_artifact,
 )
 
-__all__ = ["NativeScorer", "build_native_lib", "export_scorer_artifact"]
+__all__ = [
+    "MicroBatchScorer",
+    "NativeScorer",
+    "build_native_lib",
+    "export_scorer_artifact",
+]

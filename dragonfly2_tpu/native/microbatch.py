@@ -1,0 +1,92 @@
+"""Micro-batching facade over the native scorer's multi-round FFI entry.
+
+The scheduler serves many concurrent AnnouncePeer streams on one asyncio
+loop; each scheduling round needs one ~40-candidate scoring call. Crossing
+the FFI per round caps throughput at the single-call rate, so under load this
+facade queues concurrent rounds and flushes them as ONE
+``df_scorer_score_rounds`` call (scorer.cc) — the amortized path behind the
+10k-calls/s north star (BASELINE.md config 5; the reference's intent was a
+TF-Serving Predict RPC per round, pkg/rpc/tfserving/client/client_v1.go:82-102,
+which it never implemented).
+
+Design: an explicit flush loop, not per-call timers. A caller appends its
+round to the pending list and awaits its future; the single flusher task
+drains everything pending in one native call, then yields to the loop. Under
+no load a round still completes in one loop tick (no artificial latency
+floor); under load the queue depth self-adjusts to the arrival rate.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class MicroBatchScorer:
+    """Coalesces concurrent score() calls into multi-round native calls.
+
+    All rounds in one flush must share the candidate batch width B (rounds
+    are padded up to the widest round in the flush; padding rows reuse index
+    0 with zero features and are sliced off on return).
+    """
+
+    def __init__(self, scorer, *, max_rounds_per_flush: int = 64):
+        self._scorer = scorer  # NativeScorer (or anything with score_rounds)
+        self._max_rounds = max_rounds_per_flush
+        self._pending: list[tuple[np.ndarray, np.ndarray, np.ndarray, asyncio.Future]] = []
+        self._flusher: Optional[asyncio.Task] = None
+        self.flushes = 0
+        self.rounds = 0
+
+    @property
+    def ready(self) -> bool:
+        return getattr(self._scorer, "ready", False)
+
+    async def score(
+        self, pair_feats: np.ndarray, *, child: np.ndarray, parent: np.ndarray
+    ) -> np.ndarray:
+        """Queue one scoring round; resolves after the next flush."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending.append((np.asarray(pair_feats), np.asarray(child), np.asarray(parent), fut))
+        if self._flusher is None or self._flusher.done():
+            self._flusher = asyncio.create_task(self._flush_loop())
+        return await fut
+
+    async def _flush_loop(self) -> None:
+        # Yield once so callers scheduled in the same tick can enqueue before
+        # the first drain — this is what turns N concurrent rounds into one
+        # native call instead of N.
+        await asyncio.sleep(0)
+        while self._pending:
+            batch, self._pending = self._pending[: self._max_rounds], self._pending[self._max_rounds :]
+            try:
+                self._run_native(batch)
+            except Exception as e:  # pragma: no cover - defensive
+                for *_r, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+            await asyncio.sleep(0)
+
+    def _run_native(self, batch) -> None:
+        fp = self._scorer.feature_dim
+        widths = [len(c) for _f, c, _p, _fut in batch]
+        B = max(widths)
+        M = len(batch)
+        feats = np.zeros((M, B, fp), np.float32)
+        child = np.zeros((M, B), np.int32)
+        parent = np.zeros((M, B), np.int32)
+        for m, (f, c, p, _fut) in enumerate(batch):
+            feats[m, : widths[m]] = f
+            child[m, : widths[m]] = c
+            parent[m, : widths[m]] = p
+        out = self._scorer.score_rounds(feats, child=child, parent=parent)
+        self.flushes += 1
+        self.rounds += M
+        for m, (*_r, fut) in enumerate(batch):
+            if not fut.done():
+                fut.set_result(out[m, : widths[m]])

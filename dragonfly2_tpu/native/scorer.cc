@@ -6,8 +6,28 @@
 // no Python, no JAX runtime on the hot path. The trainer exports cached
 // GraphSAGE node embeddings plus the pairwise MLP head (models/graphsage.py
 // TopoScorer.head: Dense→gelu→Dense→gelu→Dense→sigmoid) into a flat binary;
-// this library mmap-loads it and scores a batch of (child, parent, features)
-// candidates per call.
+// this library mmap-loads it and scores batches of (child, parent, features)
+// candidates.
+//
+// Serving math. The head's first layer sees x = [z_c, z_p, z_c∘z_p, feats].
+// Because z is a FIXED node table at serving time, the z_c and z_p
+// contributions to layer 1 are linear in a per-node vector and are
+// precomputed at load time:
+//     uc[n] = W1[0:D]ᵀ z[n]        up[n] = W1[D:2D]ᵀ z[n]       ([N, H1] each)
+// so a scoring round only contracts the (z_c∘z_p, feats) tail — (D+FP) input
+// dims instead of (3D+FP), a ~2.8× FLOP cut at the shipped shapes
+// (D=128, FP=16, H1=256).
+//
+// Entry points:
+//   df_scorer_score        — one scheduling round (B candidate pairs)
+//   df_scorer_score_rounds — M queued rounds in ONE FFI call (the 10k-calls/s
+//                            amortized path; rounds are independent, so this
+//                            is a flat (M·B)-row batch through the same GEMMs)
+//
+// Thread safety: concurrent calls on ONE handle are serialized by design
+// (scratch buffers live in the handle); use one handle per thread for
+// parallel serving. OpenMP (when compiled in) parallelizes INSIDE a call
+// across row blocks.
 //
 // Build: g++ -O3 -shared -fPIC -o libdfscorer.so scorer.cc  (see scorer.py)
 //
@@ -16,8 +36,8 @@
 //   u32 N (nodes)  u32 D (embed dim)  u32 FP (pair-feature dim)
 //   u32 H1  u32 H2 (head hidden dims)
 //   f32 z[N*D]                      cached node embeddings (row-major)
-//   f32 W1[(3D+FP)*H1]  f32 b1[H1]  head layer 0 (kernel column-major-in =
-//   f32 W2[H1*H2]       f32 b2[H2]    flax [in, out] row-major)
+//   f32 W1[(3D+FP)*H1]  f32 b1[H1]  head layer 0 (kernel = flax [in, out]
+//   f32 W2[H1*H2]       f32 b2[H2]    row-major)
 //   f32 W3[H2*1]        f32 b3[1]
 
 #include <algorithm>
@@ -41,51 +61,118 @@ struct Header {
   uint32_t magic, version, n, d, fp, h1, h2;
 };
 
+// Rational tanh (Eigen's float coefficients): 7 FMAs + one divide, fully
+// vectorizable — std::tanh would cost a libm call per element and the gelu
+// pass touches H1+H2 = 384 activations per candidate. Max abs error vs
+// libm tanhf is ~1e-6, far inside the bf16 tolerance the JAX-parity test
+// allows.
+inline float fast_tanh(float x) {
+  x = std::min(std::max(x, -7.90531110763549805f), 7.90531110763549805f);
+  const float x2 = x * x;
+  float p = -2.76076847742355e-16f;
+  p = p * x2 + 2.00018790482477e-13f;
+  p = p * x2 + -8.60467152213735e-11f;
+  p = p * x2 + 5.12229709037114e-08f;
+  p = p * x2 + 1.48572235717979e-05f;
+  p = p * x2 + 6.37261928875436e-04f;
+  p = p * x2 + 4.89352455891786e-03f;
+  p = p * x;
+  float q = 1.19825839466702e-06f;
+  q = q * x2 + 1.18534705686654e-04f;
+  q = q * x2 + 2.26843463243900e-03f;
+  q = q * x2 + 4.89352518554385e-03f;
+  return p / q;
+}
+
 inline float gelu(float x) {
   // tanh approximation — matches jax.nn.gelu(approximate=True), the flax
   // default used by TopoScorer.head
   const float kC = 0.7978845608028654f;  // sqrt(2/pi)
-  return 0.5f * x * (1.0f + std::tanh(kC * (x + 0.044715f * x * x * x)));
+  return 0.5f * x * (1.0f + fast_tanh(kC * (x + 0.044715f * x * x * x)));
 }
 
 inline float sigmoidf(float x) { return 1.0f / (1.0f + std::exp(-x)); }
 
-// Y[B, out] = X[B, in] · W[in, out] + bias  (W row-major [in][out], flax
-// layout). Loop order (i, b, o): each W row streams through cache once per
-// batch instead of once per sample — the weight matrices dominate memory
-// traffic at the ~40-candidate batch sizes the scheduler sends.
-void gemm(const float* __restrict__ X, const float* __restrict__ W,
-          const float* __restrict__ bias, float* __restrict__ Y, int B, int in,
-          int out) {
-  for (int b = 0; b < B; ++b) {
-    float* Yrow = Y + static_cast<size_t>(b) * out;
-    for (int o = 0; o < out; ++o) Yrow[o] = bias[o];
-  }
-  // 8-way unroll over the contraction dim: one pass over the Y slab handles
-  // 8 input features (8 W rows live in L1), cutting accumulator re-stream
-  // traffic 8x versus the naive (i, b, o) order.
-  int i = 0;
-  for (; i + 8 <= in; i += 8) {
-    const float* W0 = W + static_cast<size_t>(i) * out;
-    for (int b = 0; b < B; ++b) {
-      const float* xb = X + static_cast<size_t>(b) * in + i;
-      const float x0 = xb[0], x1 = xb[1], x2 = xb[2], x3 = xb[3];
-      const float x4 = xb[4], x5 = xb[5], x6 = xb[6], x7 = xb[7];
-      float* Yrow = Y + static_cast<size_t>(b) * out;
-      for (int o = 0; o < out; ++o) {
-        Yrow[o] += x0 * W0[o] + x1 * W0[out + o] + x2 * W0[2 * out + o] +
-                   x3 * W0[3 * out + o] + x4 * W0[4 * out + o] +
-                   x5 * W0[5 * out + o] + x6 * W0[6 * out + o] +
-                   x7 * W0[7 * out + o];
+// 16-lane float vector via GNU vector extensions (gcc/clang): one AVX-512
+// zmm or a ymm pair. Local float[16] accumulator arrays looked equivalent but
+// gcc 12 spilled them to the stack inside the FMA loop; typed vector
+// variables stay in registers.
+typedef float v16 __attribute__((vector_size(64)));
+typedef float v16u __attribute__((vector_size(64), aligned(4), may_alias));
+
+inline v16 loadu16(const float* p) { return *reinterpret_cast<const v16u*>(p); }
+inline void storeu16(float* p, v16 v) { *reinterpret_cast<v16u*>(p) = v; }
+
+// Y[R, out] += X[R, in] · W[in, out]   (W row-major [in][out], flax layout;
+// Y PRE-INITIALIZED by the caller with bias / per-node partials).
+//
+// Register-blocked micro-kernel: 8 rows × 16 cols of Y live in 8 vector
+// registers across the whole contraction — Y is read and written exactly
+// once, and each streamed W vector feeds 8 FMAs.
+void gemm_acc(const float* __restrict__ X, const float* __restrict__ W,
+              float* __restrict__ Y, int R, int in, int out) {
+  constexpr int RB = 8, CB = 16;
+  int r = 0;
+  for (; r + RB <= R; r += RB) {
+    const float* x[RB];
+    float* y[RB];
+    for (int k = 0; k < RB; ++k) {
+      x[k] = X + static_cast<size_t>(r + k) * in;
+      y[k] = Y + static_cast<size_t>(r + k) * out;
+    }
+    int o = 0;
+    for (; o + CB <= out; o += CB) {
+      v16 a0 = loadu16(y[0] + o), a1 = loadu16(y[1] + o);
+      v16 a2 = loadu16(y[2] + o), a3 = loadu16(y[3] + o);
+      v16 a4 = loadu16(y[4] + o), a5 = loadu16(y[5] + o);
+      v16 a6 = loadu16(y[6] + o), a7 = loadu16(y[7] + o);
+      const float* w = W + o;
+      for (int i = 0; i < in; ++i, w += out) {
+        const v16 wv = loadu16(w);
+        a0 += x[0][i] * wv;
+        a1 += x[1][i] * wv;
+        a2 += x[2][i] * wv;
+        a3 += x[3][i] * wv;
+        a4 += x[4][i] * wv;
+        a5 += x[5][i] * wv;
+        a6 += x[6][i] * wv;
+        a7 += x[7][i] * wv;
       }
+      storeu16(y[0] + o, a0);
+      storeu16(y[1] + o, a1);
+      storeu16(y[2] + o, a2);
+      storeu16(y[3] + o, a3);
+      storeu16(y[4] + o, a4);
+      storeu16(y[5] + o, a5);
+      storeu16(y[6] + o, a6);
+      storeu16(y[7] + o, a7);
+    }
+    for (; o < out; ++o) {
+      const float* w = W + o;
+      float acc[RB];
+      for (int k = 0; k < RB; ++k) acc[k] = y[k][o];
+      for (int i = 0; i < in; ++i, w += out) {
+        const float wv = *w;
+        for (int k = 0; k < RB; ++k) acc[k] += x[k][i] * wv;
+      }
+      for (int k = 0; k < RB; ++k) y[k][o] = acc[k];
     }
   }
-  for (; i < in; ++i) {
-    const float* Wrow = W + static_cast<size_t>(i) * out;
-    for (int b = 0; b < B; ++b) {
-      const float xi = X[static_cast<size_t>(b) * in + i];
-      float* Yrow = Y + static_cast<size_t>(b) * out;
-      for (int o = 0; o < out; ++o) Yrow[o] += xi * Wrow[o];
+  for (; r < R; ++r) {
+    const float* xr = X + static_cast<size_t>(r) * in;
+    float* yr = Y + static_cast<size_t>(r) * out;
+    int o = 0;
+    for (; o + CB <= out; o += CB) {
+      v16 a = loadu16(yr + o);
+      const float* w = W + o;
+      for (int i = 0; i < in; ++i, w += out) a += xr[i] * loadu16(w);
+      storeu16(yr + o, a);
+    }
+    for (; o < out; ++o) {
+      float a = yr[o];
+      const float* w = W + o;
+      for (int i = 0; i < in; ++i, w += out) a += xr[i] * *w;
+      yr[o] = a;
     }
   }
 }
@@ -97,6 +184,12 @@ extern "C" {
 struct DfScorer {
   Header hdr;
   std::vector<float> z, w1, b1, w2, b2, w3, b3;
+  // load-time precompute: first-layer contributions of each node's embedding
+  // in child position (uc) and parent position (up), [N, H1] each
+  std::vector<float> uc, up;
+  // per-handle scratch reused across calls (no per-call malloc on the hot
+  // path); sliced disjointly by OpenMP row blocks inside one call
+  std::vector<float> sx, sy1, sy2;
 };
 
 DfScorer* df_scorer_load(const char* path) {
@@ -121,6 +214,14 @@ DfScorer* df_scorer_load(const char* path) {
     delete s;
     return nullptr;
   }
+  // Precompute uc = z · W1[0:D], up = z · W1[D:2D]  (one-time ~2·N·D·H1 MACs)
+  const Header& h = s->hdr;
+  s->uc.assign((size_t)h.n * h.h1, 0.0f);
+  s->up.assign((size_t)h.n * h.h1, 0.0f);
+  gemm_acc(s->z.data(), s->w1.data(), s->uc.data(), (int)h.n, (int)h.d,
+           (int)h.h1);
+  gemm_acc(s->z.data(), s->w1.data() + (size_t)h.d * h.h1, s->up.data(),
+           (int)h.n, (int)h.d, (int)h.h1);
   return s;
 }
 
@@ -130,62 +231,85 @@ int32_t df_scorer_num_nodes(const DfScorer* s) { return (int32_t)s->hdr.n; }
 int32_t df_scorer_embed_dim(const DfScorer* s) { return (int32_t)s->hdr.d; }
 int32_t df_scorer_feature_dim(const DfScorer* s) { return (int32_t)s->hdr.fp; }
 
-// Score `batch` (child, parent) pairs; feats is [batch, FP] row-major.
-// Returns 0 on success, -1 on an out-of-range node index.
-int32_t df_scorer_score(const DfScorer* s, const int32_t* child,
-                        const int32_t* parent, const float* feats,
-                        int32_t batch, float* out) {
+// Score `rounds` independent scheduling rounds of `batch` (child, parent)
+// pairs each in ONE call: child/parent are [rounds*batch] i32, feats is
+// [rounds*batch, FP] row-major, out is [rounds*batch] f32. The multi-round
+// entry amortizes FFI + dispatch overhead across rounds (north-star config 5's
+// 10k-calls/s path). Returns 0 on success, -1 on an out-of-range node index.
+int32_t df_scorer_score_rounds(DfScorer* s, const int32_t* child,
+                               const int32_t* parent, const float* feats,
+                               int32_t rounds, int32_t batch, float* out) {
   const Header& h = s->hdr;
-  const int32_t in_dim = 3 * h.d + h.fp;
-  // validate all indices up front, then run three batched GEMMs
-  for (int32_t b = 0; b < batch; ++b) {
+  const int64_t total64 = (int64_t)rounds * batch;
+  if (total64 <= 0 || total64 > (int64_t)1 << 24) return total64 == 0 ? 0 : -2;
+  const int32_t R = (int32_t)total64;
+  const int D = (int)h.d, FP = (int)h.fp, H1 = (int)h.h1, H2 = (int)h.h2;
+  const int in1 = D + FP;  // contraction after the uc/up precompute
+  for (int32_t b = 0; b < R; ++b) {
     const int32_t c = child[b], p = parent[b];
     if (c < 0 || p < 0 || (uint32_t)c >= h.n || (uint32_t)p >= h.n) return -1;
   }
-  std::vector<float> x((size_t)batch * in_dim);
-  std::vector<float> y1((size_t)batch * h.h1), y2((size_t)batch * h.h2);
+  s->sx.resize((size_t)R * in1);
+  s->sy1.resize((size_t)R * H1);
+  s->sy2.resize((size_t)R * H2);
+  float* X = s->sx.data();
+  float* Y1 = s->sy1.data();
+  float* Y2 = s->sy2.data();
+  // W1 tail = rows [2D, 3D+FP) — the z_c∘z_p and pair-feature blocks, which
+  // are contiguous in the artifact's row-major kernel
+  const float* W1t = s->w1.data() + (size_t)2 * D * h.h1;
 
-  // Slice the batch across threads when OpenMP is available (TPU-VM serving
-  // hosts have dozens of cores; the container CI has one and runs the serial
-  // path). Each slice runs the full pipeline independently.
-  int slices = 1;
+  int nblk = 1;
 #ifdef _OPENMP
-  slices = std::min<int>(omp_get_max_threads(), std::max<int32_t>(1, batch / 8));
+  nblk = std::min<int>(omp_get_max_threads(), std::max<int32_t>(1, R / 64));
 #endif
-  const int32_t chunk = (batch + slices - 1) / slices;
+  const int32_t chunk = (R + nblk - 1) / nblk;
 #ifdef _OPENMP
-#pragma omp parallel for schedule(static) num_threads(slices)
+#pragma omp parallel for schedule(static) num_threads(nblk) if (nblk > 1)
 #endif
-  for (int si = 0; si < slices; ++si) {
-    const int32_t b0 = si * chunk;
-    const int32_t bn = std::min<int32_t>(batch - b0, chunk);
+  for (int blk = 0; blk < nblk; ++blk) {
+    const int32_t b0 = blk * chunk;
+    const int32_t bn = std::min<int32_t>(R - b0, chunk);
     if (bn <= 0) continue;
+    // stage 1: build the reduced input rows + preload Y1 with
+    // b1 + uc[child] + up[parent]
     for (int32_t b = b0; b < b0 + bn; ++b) {
-      float* xb = x.data() + (size_t)b * in_dim;
-      const float* zc = s->z.data() + (size_t)child[b] * h.d;
-      const float* zp = s->z.data() + (size_t)parent[b] * h.d;
-      for (uint32_t i = 0; i < h.d; ++i) {
-        xb[i] = zc[i];
-        xb[h.d + i] = zp[i];
-        xb[2 * h.d + i] = zc[i] * zp[i];
-      }
-      std::memcpy(xb + 3 * h.d, feats + (size_t)b * h.fp, h.fp * sizeof(float));
+      float* xb = X + (size_t)b * in1;
+      const float* zc = s->z.data() + (size_t)child[b] * D;
+      const float* zp = s->z.data() + (size_t)parent[b] * D;
+      for (int i = 0; i < D; ++i) xb[i] = zc[i] * zp[i];
+      std::memcpy(xb + D, feats + (size_t)b * FP, FP * sizeof(float));
+      float* yb = Y1 + (size_t)b * H1;
+      const float* ucr = s->uc.data() + (size_t)child[b] * H1;
+      const float* upr = s->up.data() + (size_t)parent[b] * H1;
+      for (int i = 0; i < H1; ++i) yb[i] = s->b1[i] + ucr[i] + upr[i];
     }
-    float* x0 = x.data() + (size_t)b0 * in_dim;
-    float* y1p = y1.data() + (size_t)b0 * h.h1;
-    float* y2p = y2.data() + (size_t)b0 * h.h2;
-    gemm(x0, s->w1.data(), s->b1.data(), y1p, bn, in_dim, h.h1);
-    for (size_t i = 0; i < (size_t)bn * h.h1; ++i) y1p[i] = gelu(y1p[i]);
-    gemm(y1p, s->w2.data(), s->b2.data(), y2p, bn, h.h1, h.h2);
-    for (size_t i = 0; i < (size_t)bn * h.h2; ++i) y2p[i] = gelu(y2p[i]);
+    float* Xp = X + (size_t)b0 * in1;
+    float* Y1p = Y1 + (size_t)b0 * H1;
+    float* Y2p = Y2 + (size_t)b0 * H2;
+    gemm_acc(Xp, W1t, Y1p, bn, in1, H1);
+    for (size_t i = 0; i < (size_t)bn * H1; ++i) Y1p[i] = gelu(Y1p[i]);
     for (int32_t b = b0; b < b0 + bn; ++b) {
-      const float* yb = y2.data() + (size_t)b * h.h2;
+      float* yb = Y2 + (size_t)b * H2;
+      std::memcpy(yb, s->b2.data(), H2 * sizeof(float));
+    }
+    gemm_acc(Y1p, s->w2.data(), Y2p, bn, H1, H2);
+    for (size_t i = 0; i < (size_t)bn * H2; ++i) Y2p[i] = gelu(Y2p[i]);
+    for (int32_t b = b0; b < b0 + bn; ++b) {
+      const float* yb = Y2 + (size_t)b * H2;
       float o = s->b3[0];
-      for (uint32_t i = 0; i < h.h2; ++i) o += yb[i] * s->w3[i];
+      for (int i = 0; i < H2; ++i) o += yb[i] * s->w3[i];
       out[b] = sigmoidf(o);
     }
   }
   return 0;
+}
+
+// Single-round entry (kept for API compatibility; one round of `batch` pairs).
+int32_t df_scorer_score(DfScorer* s, const int32_t* child,
+                        const int32_t* parent, const float* feats,
+                        int32_t batch, float* out) {
+  return df_scorer_score_rounds(s, child, parent, feats, 1, batch, out);
 }
 
 }  // extern "C"

@@ -126,6 +126,22 @@ class NativeScorer:
             ctypes.c_int32,
             ctypes.POINTER(ctypes.c_float),
         ]
+        self._dll.df_scorer_score_rounds.restype = ctypes.c_int32
+        self._dll.df_scorer_score_rounds.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int32,
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_float),
+        ]
+        # bound-method + pointer-type lookups cached off the hot path: at the
+        # 10k-calls/s target every getattr/py-object allocation per call counts
+        self._score_fn = self._dll.df_scorer_score
+        self._score_rounds_fn = self._dll.df_scorer_score_rounds
+        self._pi32 = ctypes.POINTER(ctypes.c_int32)
+        self._pf32 = ctypes.POINTER(ctypes.c_float)
         self._handle = self._dll.df_scorer_load(str(artifact_path).encode())
         if not self._handle:
             raise IOError(f"failed to load scorer artifact {artifact_path}")
@@ -151,13 +167,47 @@ class NativeScorer:
                 f"pair_feats shape {feats.shape} != ({batch}, {self.feature_dim})"
             )
         out = np.empty(batch, np.float32)
-        rc = self._dll.df_scorer_score(
+        rc = self._score_fn(
             self._handle,
-            c.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            p.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
-            feats.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            c.ctypes.data_as(self._pi32),
+            p.ctypes.data_as(self._pi32),
+            feats.ctypes.data_as(self._pf32),
             batch,
-            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            out.ctypes.data_as(self._pf32),
+        )
+        if rc != 0:
+            raise ValueError(f"native scorer rejected batch (rc={rc}): bad node index")
+        return out
+
+    def score_rounds(
+        self, pair_feats: np.ndarray, *, child: np.ndarray, parent: np.ndarray
+    ) -> np.ndarray:
+        """Score M queued scheduling rounds in ONE FFI call (amortized path).
+
+        pair_feats: [M, B, FP]; child/parent: [M, B] int32. Returns [M, B]
+        float32. Rounds are independent, so the native side runs one flat
+        (M·B)-row batch through the GEMMs — FFI, validation, and dispatch
+        overhead is paid once per M rounds instead of per round.
+        """
+        feats = np.ascontiguousarray(pair_feats, np.float32)
+        c = np.ascontiguousarray(child, np.int32)
+        p = np.ascontiguousarray(parent, np.int32)
+        if feats.ndim != 3 or c.shape != feats.shape[:2] or p.shape != c.shape:
+            raise ValueError(
+                f"shape mismatch: feats {feats.shape}, child {c.shape}, parent {p.shape}"
+            )
+        rounds, batch, fp = feats.shape
+        if fp != self.feature_dim:
+            raise ValueError(f"pair_feats last dim {fp} != {self.feature_dim}")
+        out = np.empty((rounds, batch), np.float32)
+        rc = self._score_rounds_fn(
+            self._handle,
+            c.ctypes.data_as(self._pi32),
+            p.ctypes.data_as(self._pi32),
+            feats.ctypes.data_as(self._pf32),
+            rounds,
+            batch,
+            out.ctypes.data_as(self._pf32),
         )
         if rc != 0:
             raise ValueError(f"native scorer rejected batch (rc={rc}): bad node index")
