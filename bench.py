@@ -190,8 +190,11 @@ def bench_scoring(rounds: int = 2000, candidates: int = 40) -> tuple[float, floa
     return rounds / total, float(np.percentile(lat, 50) * 1000)
 
 
+_ROUNDS_PER_FFI_CALL = 8  # M queued rounds per amortized native call
+
+
 def bench_native_scoring(
-    rounds: int = 5000, candidates: int = 40, rounds_per_call: int = 8
+    rounds: int = 5000, candidates: int = 40, rounds_per_call: int = _ROUNDS_PER_FFI_CALL
 ) -> tuple[float, float, float, float]:
     """The production serving path (north-star config 5): C++ scorer with
     cached embeddings, no JAX on the hot path. Measures BOTH entry points:
@@ -325,7 +328,7 @@ def main() -> None:
         "native_scoring_calls_per_sec": round(native_calls_per_sec, 1),
         "native_scoring_p50_ms": round(native_p50_ms, 4),
         "native_single_round_calls_per_sec": round(native_single_rps, 1),
-        "native_rounds_per_ffi_call": 8,
+        "native_rounds_per_ffi_call": _ROUNDS_PER_FFI_CALL,
         "native_multi_call_p50_ms": round(native_multi_call_p50_ms, 4),
         "jax_scoring_calls_per_sec": round(jax_calls_per_sec, 1),
         "jax_scoring_p50_ms": round(jax_p50_ms, 3),

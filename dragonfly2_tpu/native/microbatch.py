@@ -73,20 +73,38 @@ class MicroBatchScorer:
             await asyncio.sleep(0)
 
     def _run_native(self, batch) -> None:
+        # Per-round validation BEFORE assembly: the native call rejects the
+        # whole flat batch on any bad index, so one round carrying a stale
+        # node id (e.g. from a pre-refresh graph) must fail alone, not take
+        # down 63 healthy concurrent rounds with it.
+        n = self._scorer.num_nodes
+        good = []
+        for f, c, p, fut in batch:
+            if c.min(initial=0) < 0 or p.min(initial=0) < 0 or (
+                len(c) and (c.max() >= n or p.max() >= n)
+            ):
+                if not fut.done():
+                    fut.set_exception(
+                        ValueError(f"node index out of range for {n}-node artifact")
+                    )
+            else:
+                good.append((f, c, p, fut))
+        if not good:
+            return
         fp = self._scorer.feature_dim
-        widths = [len(c) for _f, c, _p, _fut in batch]
+        widths = [len(c) for _f, c, _p, _fut in good]
         B = max(widths)
-        M = len(batch)
+        M = len(good)
         feats = np.zeros((M, B, fp), np.float32)
         child = np.zeros((M, B), np.int32)
         parent = np.zeros((M, B), np.int32)
-        for m, (f, c, p, _fut) in enumerate(batch):
+        for m, (f, c, p, _fut) in enumerate(good):
             feats[m, : widths[m]] = f
             child[m, : widths[m]] = c
             parent[m, : widths[m]] = p
         out = self._scorer.score_rounds(feats, child=child, parent=parent)
         self.flushes += 1
         self.rounds += M
-        for m, (*_r, fut) in enumerate(batch):
+        for m, (*_r, fut) in enumerate(good):
             if not fut.done():
                 fut.set_result(out[m, : widths[m]])

@@ -24,9 +24,11 @@
 //                            amortized path; rounds are independent, so this
 //                            is a flat (M·B)-row batch through the same GEMMs)
 //
-// Thread safety: concurrent calls on ONE handle are serialized by design
-// (scratch buffers live in the handle); use one handle per thread for
-// parallel serving. OpenMP (when compiled in) parallelizes INSIDE a call
+// Thread safety: scratch buffers live in the handle, so concurrent scoring
+// calls on ONE handle are serialized by an internal mutex (ctypes releases
+// the GIL during the call — without the lock two Python threads sharing a
+// scorer would race on the scratch vectors). For parallel serving use one
+// handle per thread; OpenMP (when compiled in) parallelizes INSIDE a call
 // across row blocks.
 //
 // Build: g++ -O3 -shared -fPIC -o libdfscorer.so scorer.cc  (see scorer.py)
@@ -46,6 +48,7 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <mutex>
 #include <vector>
 
 #ifdef _OPENMP
@@ -188,8 +191,10 @@ struct DfScorer {
   // in child position (uc) and parent position (up), [N, H1] each
   std::vector<float> uc, up;
   // per-handle scratch reused across calls (no per-call malloc on the hot
-  // path); sliced disjointly by OpenMP row blocks inside one call
+  // path); sliced disjointly by OpenMP row blocks inside one call, guarded
+  // across calls by `mu`
   std::vector<float> sx, sy1, sy2;
+  std::mutex mu;
 };
 
 DfScorer* df_scorer_load(const char* path) {
@@ -249,6 +254,7 @@ int32_t df_scorer_score_rounds(DfScorer* s, const int32_t* child,
     const int32_t c = child[b], p = parent[b];
     if (c < 0 || p < 0 || (uint32_t)c >= h.n || (uint32_t)p >= h.n) return -1;
   }
+  std::lock_guard<std::mutex> lock(s->mu);
   s->sx.resize((size_t)R * in1);
   s->sy1.resize((size_t)R * H1);
   s->sy2.resize((size_t)R * H2);

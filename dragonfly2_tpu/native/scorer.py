@@ -209,6 +209,11 @@ class NativeScorer:
             batch,
             out.ctypes.data_as(self._pf32),
         )
+        if rc == -2:
+            raise ValueError(
+                f"native scorer rejected batch: {rounds}x{batch} rows exceeds the "
+                "2^24-row per-call cap"
+            )
         if rc != 0:
             raise ValueError(f"native scorer rejected batch (rc={rc}): bad node index")
         return out

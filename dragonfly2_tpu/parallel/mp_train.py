@@ -1,0 +1,78 @@
+"""One process of a multi-process sharded GNN training job (config 3 shape).
+
+Launched per-host by `distributed.launch_localhost` (tests / dry runs) or by
+the real pod launcher: initializes jax.distributed from DF_DIST_* env, builds
+the global ("data", "model") mesh over ALL processes' devices, and runs
+DF_MP_STEPS training steps where each process feeds only its own batch rows
+(`distributed.process_local_batch`). Process 0 prints the loss trajectory as
+`MP_LOSSES <json>`.
+
+This is the code path the reference never had (its trainer dropped dataset
+chunks on the floor, pkg/rpc/trainer/server/server.go:59): data parallelism
+across hosts over DCN/Gloo, tensor parallelism inside a host — the same jit
+and shardings as single-process training; only initialization and batch
+feeding differ.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+
+def main() -> None:
+    from dragonfly2_tpu.parallel import distributed as dist
+
+    cfg = dist.DistributedConfig.from_env()
+    dist.initialize(cfg)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from dragonfly2_tpu.parallel import mesh as meshlib
+    from dragonfly2_tpu.trainer import synthetic, train_gnn
+    from dragonfly2_tpu.trainer.synthetic import PairBatch
+
+    steps = int(os.environ.get("DF_MP_STEPS", "12"))
+    num_nodes = int(os.environ.get("DF_MP_NODES", "128"))
+    mesh = meshlib.make_mesh()  # all processes' devices → global mesh
+    cluster = synthetic.make_cluster(
+        num_nodes=num_nodes, num_neighbors=8, num_pairs=4096, seed=3
+    )
+    tcfg = train_gnn.GNNTrainConfig(
+        hidden=32,
+        embed_dim=16,
+        num_layers=2,
+        batch_size=meshlib.pad_to_multiple(256, mesh.shape[meshlib.DATA_AXIS]),
+        warmup_steps=2,
+    )
+    state = train_gnn.init_state(tcfg, cluster.graph, rng_seed=0)
+    state, g, step_fn = train_gnn.shard_for_training(state, cluster.graph, mesh)
+
+    batch_sh = meshlib.batch_sharding(mesh)
+    lo, hi = dist.local_row_slice(tcfg.batch_size)
+    rng = np.random.default_rng(0)  # same seed everywhere → same global batch
+    losses: list[float] = []
+    for _ in range(steps):
+        b = synthetic.sample_batch(cluster.pairs, tcfg.batch_size, rng)
+        gb = PairBatch(
+            *(
+                dist.process_local_batch(batch_sh, a[lo:hi], (tcfg.batch_size,) + a.shape[1:])
+                for a in b
+            )
+        )
+        state, loss = step_fn(state, g, gb)
+        losses.append(float(loss))
+    jax.block_until_ready(state.params)
+    if jax.process_index() == 0:
+        print(
+            f"mp_train ok: procs={jax.process_count()} devices={len(jax.devices())} "
+            f"mesh={dict(mesh.shape)} steps={steps}",
+            flush=True,
+        )
+        print("MP_LOSSES " + json.dumps([round(v, 6) for v in losses]), flush=True)
+
+
+if __name__ == "__main__":
+    main()

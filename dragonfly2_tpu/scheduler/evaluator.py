@@ -86,6 +86,13 @@ class Evaluator:
         feats = build_pair_features(child, parents, self.topology)
         return feats @ BASE_WEIGHTS
 
+    async def evaluate_async(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:
+        """Async scoring entry: the base evaluator is pure numpy, so this is
+        just the sync path; MLEvaluator overrides it to await the micro-batched
+        native scorer (concurrent scheduling rounds coalesce into one FFI call).
+        """
+        return self.evaluate(child, parents)
+
     def is_bad_node(self, peer: Peer) -> bool:
         """Piece-cost outlier ejection (ref evaluator_base.go:193-229)."""
         if peer.fsm.current == "failed":
@@ -114,31 +121,64 @@ class MLEvaluator(Evaluator):
     def __init__(self, scorer=None, node_index: dict[str, int] | None = None):
         self._scorer = scorer
         self._node_index = node_index or {}
+        self._microbatch = None
 
-    def attach_scorer(self, scorer, node_index: dict[str, int]) -> None:
+    def attach_scorer(self, scorer, node_index: dict[str, int], *, microbatch=None) -> None:
         """Hot-swap the model (called when the trainer publishes a version);
-        until then evaluate() serves the base fallback."""
+        until then evaluate() serves the base fallback.
+
+        microbatch: optional native.MicroBatchScorer wrapping `scorer` — when
+        set, evaluate_async coalesces concurrent scheduling rounds into one
+        multi-round FFI call (the 10k-calls/s serving path); the sync
+        evaluate() keeps calling `scorer` directly.
+        """
         self._scorer = scorer
         self._node_index = node_index
+        self._microbatch = microbatch
 
-    def evaluate(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:
-        base = super().evaluate(child, parents)
-        if not parents or not getattr(self._scorer, "ready", False):
-            return base
+    def _prepare(self, child: Peer, parents: Sequence[Peer]):
+        """Shared pre-scoring step: (base, feats, child_ids, parent_ids, known)
+        or None when the ML path can't score this round (unknown hosts)."""
+        base = Evaluator.evaluate(self, child, parents)
         child_idx = self._node_index.get(child.host.id)
         parent_idx = [self._node_index.get(p.host.id) for p in parents]
         known = np.array([i is not None for i in parent_idx]) & (child_idx is not None)
         if not known.any():
-            return base
+            return base, None, None, None, None
         feats = build_pair_features(child, parents, self.topology)
+        c = np.full(len(parents), child_idx if child_idx is not None else 0, np.int32)
+        p = np.array([i if i is not None else 0 for i in parent_idx], np.int32)
+        return base, feats, c, p, known
+
+    def evaluate(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:
+        if not parents or not getattr(self._scorer, "ready", False):
+            return super().evaluate(child, parents)
+        base, feats, c, p, known = self._prepare(child, parents)
+        if feats is None:
+            return base
         try:
-            ml = self._scorer.score(
-                feats,
-                child=np.full(len(parents), child_idx if child_idx is not None else 0, np.int32),
-                parent=np.array([i if i is not None else 0 for i in parent_idx], np.int32),
-            )
+            ml = self._scorer.score(feats, child=c, parent=p)
         except Exception:
             logger.exception("ml scorer failed; using base evaluator")
+            return base
+        return np.where(known, ml, base).astype(np.float32)
+
+    async def evaluate_async(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:
+        """Micro-batched scoring: concurrent rounds on the event loop land in
+        ONE native multi-round call; falls back to the sync path when no
+        micro-batcher is attached, and to the base score on scorer errors."""
+        mb = self._microbatch
+        if mb is None or not getattr(mb, "ready", False):
+            return self.evaluate(child, parents)
+        if not parents:
+            return np.zeros(0, dtype=np.float32)
+        base, feats, c, p, known = self._prepare(child, parents)
+        if feats is None:
+            return base
+        try:
+            ml = await mb.score(feats, child=c, parent=p)
+        except Exception:
+            logger.exception("micro-batched ml scorer failed; using base evaluator")
             return base
         return np.where(known, ml, base).astype(np.float32)
 

@@ -108,24 +108,43 @@ class Scheduling:
             depth_ok,
         ]
 
+    def _sample_candidates(self, child: Peer, blocklist: set[str]) -> list[Peer]:
+        """Sample ≤40 random DAG peers and run the 8 filters."""
+        task = child.task
+        sample = [v.value for v in task.dag.random_vertices(self.config.filter_parent_limit, self._rng)]
+        filters = self._filters(child, set(blocklist))
+        return [p for p in sample if all(f(p) for f in filters)]
+
+    def _top_parents(self, child: Peer, candidates: list[Peer], scores) -> list[Peer]:
+        order = np.argsort(-np.asarray(scores), kind="stable")
+        top = [candidates[i] for i in order[: self.config.candidate_parent_limit]]
+        logger.debug(
+            "schedule %s: %d candidates, top %s",
+            child.id, len(candidates), [p.id for p in top],
+        )
+        return top
+
     def find_candidate_parents(
         self, child: Peer, blocklist: set[str] = frozenset()
     ) -> list[Peer]:
         """One filtering+scoring round: sample ≤40, filter, score, top-4."""
-        task = child.task
-        sample = [v.value for v in task.dag.random_vertices(self.config.filter_parent_limit, self._rng)]
-        filters = self._filters(child, set(blocklist))
-        candidates = [p for p in sample if all(f(p) for f in filters)]
+        candidates = self._sample_candidates(child, blocklist)
         if not candidates:
             return []
-        scores = np.asarray(self.evaluator.evaluate(child, candidates))
-        order = np.argsort(-scores, kind="stable")
-        top = [candidates[i] for i in order[: self.config.candidate_parent_limit]]
-        logger.debug(
-            "schedule %s: %d sampled, %d candidates, top %s",
-            child.id, len(sample), len(candidates), [p.id for p in top],
-        )
-        return top
+        return self._top_parents(child, candidates, self.evaluator.evaluate(child, candidates))
+
+    async def find_candidate_parents_async(
+        self, child: Peer, blocklist: set[str] = frozenset()
+    ) -> list[Peer]:
+        """Async variant of find_candidate_parents: scoring awaits the
+        evaluator's async entry, so concurrent scheduling rounds coalesce in
+        the native scorer's micro-batcher instead of crossing the FFI one by
+        one (MLEvaluator.evaluate_async)."""
+        candidates = self._sample_candidates(child, blocklist)
+        if not candidates:
+            return []
+        scores = await self.evaluator.evaluate_async(child, candidates)
+        return self._top_parents(child, candidates, scores)
 
     def find_success_parent(self, child: Peer, blocklist: set[str] = frozenset()) -> Peer | None:
         """SMALL-scope path: a single finished parent (ref FindSuccessParent)."""
@@ -152,7 +171,7 @@ class Scheduling:
             if attempt >= cfg.retry_back_to_source_limit and child.task.can_back_to_source():
                 child.fsm.fire("back_to_source")
                 return ScheduleOutcome(back_to_source=True, rounds=attempt)
-            parents = self.find_candidate_parents(child, blocklist)
+            parents = await self.find_candidate_parents_async(child, blocklist)
             if parents:
                 task = child.task
                 task.delete_parents(child.id)

@@ -123,6 +123,67 @@ class TestEvaluator:
         assert np.isfinite(feats).all()
 
 
+class _FakeNativeScorer:
+    """score_rounds-shaped fake: deterministic index-derived scores, counts
+    native calls so tests can assert coalescing (the real C++ scorer's
+    multi/single bit-identity is covered in test_native.py)."""
+
+    ready = True
+    feature_dim = 16
+    num_nodes = 1000
+
+    def __init__(self):
+        self.round_calls = 0
+
+    def score_rounds(self, feats, *, child, parent):
+        self.round_calls += 1
+        return ((child + parent) % 97).astype(np.float32) / 97.0
+
+    def score(self, feats, *, child, parent):
+        return self.score_rounds(feats[None], child=child[None], parent=parent[None])[0]
+
+
+class TestMicroBatchedScheduling:
+    def _ml_setup(self, n_hosts=8):
+        from dragonfly2_tpu.native import MicroBatchScorer
+
+        pool, task, hosts = make_pool_with_task(n_hosts)
+        children = [add_running_peer(pool, task, hosts[i]) for i in (0, 1)]
+        parents = [add_running_peer(pool, task, hosts[i], pieces=4) for i in range(2, n_hosts)]
+        fake = _FakeNativeScorer()
+        ev = new_evaluator("ml")
+        node_index = {h.id: i for i, h in enumerate(hosts)}
+        ev.attach_scorer(fake, node_index, microbatch=MicroBatchScorer(fake))
+        return pool, task, children, parents, fake, ev
+
+    def test_concurrent_rounds_coalesce_into_one_native_call(self, run):
+        pool, task, children, parents, fake, ev = self._ml_setup()
+        s = Scheduling(ev)
+
+        async def go():
+            return await asyncio.gather(
+                *(s.find_candidate_parents_async(c) for c in children)
+            )
+
+        results = run(go())
+        assert fake.round_calls == 1, "two concurrent rounds must share one FFI call"
+        assert all(len(r) == 4 for r in results)
+        # selection must agree with the sync (non-batched) path round for round
+        for child, got in zip(children, results):
+            expect = s.find_candidate_parents(child)
+            assert [p.id for p in got] == [p.id for p in expect]
+
+    def test_async_falls_back_to_base_without_microbatch(self, run):
+        pool, task, hosts = make_pool_with_task(4)
+        child = add_running_peer(pool, task, hosts[0])
+        for h in hosts[1:]:
+            add_running_peer(pool, task, h, pieces=2)
+        ev = new_evaluator("ml")  # no scorer attached → base fallback
+        s = Scheduling(ev)
+        got = run(s.find_candidate_parents_async(child))
+        assert [p.id for p in got] == [p.id for p in s.find_candidate_parents(child)]
+
+
 class TestScheduling:
     def test_filters_exclude_invalid(self, run):
         pool, task, hosts = make_pool_with_task(6)
