@@ -37,12 +37,13 @@ _SMALL_SAMPLE_MEAN_FACTOR = 20.0
 
 
 def build_pair_features(
-    child: Peer, parents: Sequence[Peer], topology=None
+    child: Peer, parents: Sequence[Peer], topology=None, bandwidth=None
 ) -> np.ndarray:
     """Feature matrix [len(parents), FEATURE_DIM] per models.features schema.
 
     topology: scheduler.networktopology.NetworkTopology (or None) — fills
-    rtt_norm from live probe data."""
+    rtt_norm from live probe data. bandwidth: telemetry.BandwidthHistory (or
+    None) — fills bandwidth_norm from observed transfer history."""
     n = len(parents)
     f = np.zeros((n, FEATURE_DIM), dtype=np.float32)
     task = child.task
@@ -59,7 +60,7 @@ def build_pair_features(
         f[i, 6] = min(rtt, 1000.0) / 1000.0 if rtt is not None else 0.0
         costs = p.piece_costs_ms
         f[i, 7] = (sum(costs) / len(costs) / 30_000.0) if costs else 0.0
-        f[i, 8] = 0.0  # bandwidth history (telemetry-fed)
+        f[i, 8] = bandwidth.normalized(h.id, child_host.id) if bandwidth is not None else 0.0
         f[i, 9] = min(p.depth(), 10) / 10.0
         f[i, 10] = child.finished_piece_ratio()
         f[i, 11] = (
@@ -79,11 +80,12 @@ class Evaluator:
 
     name = "base"
     topology = None  # NetworkTopology, attached by the scheduler service
+    bandwidth = None  # telemetry.BandwidthHistory, attached by the service
 
     def evaluate(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:
         if not parents:
             return np.zeros(0, dtype=np.float32)
-        feats = build_pair_features(child, parents, self.topology)
+        feats = build_pair_features(child, parents, self.topology, self.bandwidth)
         return feats @ BASE_WEIGHTS
 
     async def evaluate_async(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:
@@ -122,6 +124,7 @@ class MLEvaluator(Evaluator):
         self._scorer = scorer
         self._node_index = node_index or {}
         self._microbatch = None
+        self.refreshed_at: float | None = None
 
     def attach_scorer(self, scorer, node_index: dict[str, int], *, microbatch=None) -> None:
         """Hot-swap the model (called when the trainer publishes a version);
@@ -132,20 +135,36 @@ class MLEvaluator(Evaluator):
         multi-round FFI call (the 10k-calls/s serving path); the sync
         evaluate() keeps calling `scorer` directly.
         """
+        import time
+
+        from dragonfly2_tpu.scheduler import metrics
+
         self._scorer = scorer
         self._node_index = node_index
         self._microbatch = microbatch
+        self.refreshed_at = time.time()
+        metrics.ML_EMBEDDINGS_REFRESH_TIMESTAMP.set(self.refreshed_at)
+
+    def embeddings_age_s(self) -> float | None:
+        """Seconds since the serving embeddings were refreshed (staleness);
+        None while no model is attached."""
+        import time
+
+        return None if self.refreshed_at is None else time.time() - self.refreshed_at
 
     def _prepare(self, child: Peer, parents: Sequence[Peer]):
         """Shared pre-scoring step: (base, feats, child_ids, parent_ids, known)
-        or None when the ML path can't score this round (unknown hosts)."""
-        base = Evaluator.evaluate(self, child, parents)
+        with feats=None when the ML path can't score this round (unknown
+        hosts). Builds the feature matrix ONCE and derives the base score
+        from it — feature building is the per-candidate Python loop on the
+        hot scoring path."""
+        feats = build_pair_features(child, parents, self.topology, self.bandwidth)
+        base = (feats @ BASE_WEIGHTS).astype(np.float32)
         child_idx = self._node_index.get(child.host.id)
         parent_idx = [self._node_index.get(p.host.id) for p in parents]
         known = np.array([i is not None for i in parent_idx]) & (child_idx is not None)
         if not known.any():
             return base, None, None, None, None
-        feats = build_pair_features(child, parents, self.topology)
         c = np.full(len(parents), child_idx if child_idx is not None else 0, np.int32)
         p = np.array([i if i is not None else 0 for i in parent_idx], np.int32)
         return base, feats, c, p, known

@@ -262,9 +262,20 @@ class ManagerLink:
         except FileNotFoundError:
             logger.warning("active model %s artifact missing at %r", row["version"], path)
             return
-        self.service.evaluator.attach_scorer(scorer, node_index)
+        # Native scorers get the micro-batching facade: concurrent scheduling
+        # rounds on the service loop coalesce into one multi-round FFI call
+        # (native/microbatch.py) instead of crossing ctypes per round.
+        microbatch = None
+        if hasattr(scorer, "score_rounds"):
+            from dragonfly2_tpu.native import MicroBatchScorer
+
+            microbatch = MicroBatchScorer(scorer)
+        self.service.evaluator.attach_scorer(scorer, node_index, microbatch=microbatch)
         self._active_model_version = row["version"]
-        logger.info("ml evaluator upgraded to model %s (%d hosts)", row["version"], len(node_index))
+        logger.info(
+            "ml evaluator upgraded to model %s (%d hosts, microbatch=%s)",
+            row["version"], len(node_index), microbatch is not None,
+        )
 
     @staticmethod
     def _load_scorer(path: str):

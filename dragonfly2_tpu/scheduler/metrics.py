@@ -38,3 +38,11 @@ HOSTS_GAUGE = _r.gauge("hosts", "Live hosts in the resource pool", subsystem="sc
 PROBES_SYNCED_TOTAL = _r.counter(
     "probes_synced_total", "Network-topology probe results ingested", subsystem="scheduler"
 )
+# Staleness of the ml evaluator's cached GraphSAGE embeddings: age = now() -
+# this timestamp at query side (standard Prometheus freshness pattern). 0 =
+# no model attached yet (base fallback serving).
+ML_EMBEDDINGS_REFRESH_TIMESTAMP = _r.gauge(
+    "ml_embeddings_refresh_timestamp_seconds",
+    "Unix time the ml evaluator last received fresh scorer embeddings",
+    subsystem="scheduler",
+)

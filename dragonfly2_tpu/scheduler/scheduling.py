@@ -173,12 +173,25 @@ class Scheduling:
                 return ScheduleOutcome(back_to_source=True, rounds=attempt)
             parents = await self.find_candidate_parents_async(child, blocklist)
             if parents:
+                # The await above suspended between filtering and commit, so a
+                # concurrent round may have consumed upload slots or added
+                # edges that invalidate these candidates (the coalescing path
+                # makes this overlap the COMMON case). Re-validate at commit:
+                # stale candidates are skipped, a CycleError round retries.
                 task = child.task
                 task.delete_parents(child.id)
+                committed = []
                 for p in parents:
-                    task.add_edge(p.id, child.id)
-                child.schedule_rounds += 1
-                return ScheduleOutcome(parents=parents, rounds=attempt + 1)
+                    if p.host.free_upload_slots <= 0:
+                        continue
+                    try:
+                        task.add_edge(p.id, child.id)
+                    except Exception:
+                        continue  # raced into a cycle/duplicate; skip
+                    committed.append(p)
+                if committed:
+                    child.schedule_rounds += 1
+                    return ScheduleOutcome(parents=committed, rounds=attempt + 1)
             await asyncio.sleep(cfg.retry_interval)
         # retries exhausted: last resort is back-to-source, else failure
         if child.task.can_back_to_source():

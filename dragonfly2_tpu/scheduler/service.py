@@ -104,6 +104,7 @@ class SchedulerService:
         seed_trigger: Callable[[Task], Awaitable[None]] | None = None,
     ):
         from dragonfly2_tpu.scheduler.networktopology import NetworkTopology
+        from dragonfly2_tpu.telemetry import BandwidthHistory
 
         self.pool = ResourcePool(gc_policy)
         self.evaluator = evaluator or new_evaluator("base")
@@ -111,6 +112,12 @@ class SchedulerService:
         self.telemetry = telemetry
         self.topology = NetworkTopology(telemetry=telemetry)
         self.evaluator.topology = self.topology  # rtt_norm feature source
+        self.bandwidth = BandwidthHistory()  # bandwidth_norm feature source
+        if telemetry is not None:
+            # warm-start from persisted download records so the feature
+            # survives scheduler restarts
+            self.bandwidth.load_from(telemetry)
+        self.evaluator.bandwidth = self.bandwidth
         self.seed_trigger = seed_trigger
         self._seed_triggered: set[str] = set()
 
@@ -394,7 +401,16 @@ class SchedulerService:
                 peer.fsm.fire("fail")
             if not task.has_available_peer() and task.fsm.can("fail"):
                 task.fsm.fire("fail")
+        # Record FIRST, observe SECOND: the persisted pair_features must carry
+        # the schedule-time history, not this download's own bandwidth —
+        # otherwise f[8] equals the label on first transfers and the trainer
+        # learns to read the answer off the feature (train/serve skew).
         self._record_download(peer, success, bandwidth_bps)
+        if success and bandwidth_bps > 0:
+            # feed the bandwidth-history EWMA (feature f[8]) before the
+            # parent edges are dropped below
+            for parent in task.parents_of(peer_id):
+                self.bandwidth.observe(parent.host.id, peer.host.id, bandwidth_bps)
         # The peer stops downloading either way: release its parents' upload
         # slots now, not at the 24h GC (it stays in the DAG as a parent).
         task.delete_parents(peer_id)
@@ -419,7 +435,7 @@ class SchedulerService:
             back_to_source=peer.fsm.is_(PEER_BACK_TO_SOURCE) or peer.state == PEER_SUCCEEDED and not parents,
         )
         if parents:
-            feats = build_pair_features(peer, parents, self.topology)
+            feats = build_pair_features(peer, parents, self.topology, self.bandwidth)
             for p, f in zip(parents, feats):
                 self.telemetry.downloads.append(
                     parent_peer_id=p.id.encode()[:64],
@@ -471,6 +487,7 @@ class SchedulerService:
             self.leave_peer(pid)
         del self.pool.hosts[host_id]
         self.topology.forget_host(host_id)
+        self.bandwidth.forget_host(host_id)
 
     # ---- network topology probes (ref SyncProbes, finished here) ----
 

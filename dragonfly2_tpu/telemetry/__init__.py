@@ -7,6 +7,10 @@ data loader is a zero-copy `np.load` into device arrays instead of CSV
 parsing (SURVEY.md §7 hard part: "CSV→Arrow schema fidelity").
 """
 
+from dragonfly2_tpu.telemetry.bandwidth import (  # noqa: F401
+    BANDWIDTH_NORM_BPS,
+    BandwidthHistory,
+)
 from dragonfly2_tpu.telemetry.records import (  # noqa: F401
     DOWNLOAD_DTYPE,
     PROBE_DTYPE,

@@ -1,0 +1,86 @@
+"""Observed-bandwidth history: the serving-side store behind feature f[8].
+
+The reference's download records carry per-transfer bandwidth into CSVs that
+only the (never-implemented) trainer would read (scheduler/storage/types.go
+Download.Bandwidth); nothing fed it back into scheduling. Here the loop is
+closed: every successful peer result updates an EWMA keyed by
+(parent_host, child_host) with a per-parent-host aggregate fallback, the
+feature builder reads it at scoring time (models.features "bandwidth_norm"),
+and on boot the history warm-starts from the telemetry store's persisted
+download records — so the ML plane scores with the bandwidth eye open.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+# 1 GiB/s — the reference's default total download/upload rate limit
+# (client/config/constants.go:46-47); bandwidth_norm divides by this.
+BANDWIDTH_NORM_BPS = float(1 << 30)
+
+
+class BandwidthHistory:
+    """EWMA bandwidth tracker keyed by host pair, with parent-host fallback.
+
+    alpha: EWMA weight of a new observation. Pair-specific history answers
+    "how fast was THIS parent for THIS child's host"; the per-parent aggregate
+    answers for children that never downloaded from it before.
+    """
+
+    def __init__(self, alpha: float = 0.3):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.alpha = alpha
+        self._pair: dict[tuple[str, str], float] = {}
+        self._parent: dict[str, float] = {}
+
+    def observe(self, parent_host_id: str, child_host_id: str, bps: float) -> None:
+        if not parent_host_id or not np.isfinite(bps) or bps <= 0:
+            return
+        a = self.alpha
+        key = (parent_host_id, child_host_id)
+        prev = self._pair.get(key)
+        self._pair[key] = bps if prev is None else (1 - a) * prev + a * bps
+        prev = self._parent.get(parent_host_id)
+        self._parent[parent_host_id] = bps if prev is None else (1 - a) * prev + a * bps
+
+    def query(self, parent_host_id: str, child_host_id: str) -> Optional[float]:
+        """Best available estimate in bytes/s, or None with no history."""
+        v = self._pair.get((parent_host_id, child_host_id))
+        if v is not None:
+            return v
+        return self._parent.get(parent_host_id)
+
+    def normalized(self, parent_host_id: str, child_host_id: str) -> float:
+        """Feature-space value: observed bps / 1 GiB/s, clipped to [0, 1];
+        0.0 means "no history" (matches the feature's training-time prior)."""
+        v = self.query(parent_host_id, child_host_id)
+        if v is None:
+            return 0.0
+        return float(min(v / BANDWIDTH_NORM_BPS, 1.0))
+
+    def forget_host(self, host_id: str) -> None:
+        self._parent.pop(host_id, None)
+        for key in [k for k in self._pair if host_id in k]:
+            del self._pair[key]
+
+    def load_from(self, telemetry) -> int:
+        """Warm-start from persisted download records (oldest first, so the
+        EWMA ends weighted toward recent transfers). Returns rows ingested."""
+        recs = telemetry.downloads.load_all()
+        n = 0
+        for r in recs:
+            if not r["success"] or r["bandwidth_bps"] <= 0:
+                continue
+            parent = bytes(r["parent_host_id"]).rstrip(b"\x00").decode(errors="replace")
+            child = bytes(r["child_host_id"]).rstrip(b"\x00").decode(errors="replace")
+            if not parent:
+                continue
+            self.observe(parent, child, float(r["bandwidth_bps"]))
+            n += 1
+        return n
+
+    def __len__(self) -> int:
+        return len(self._pair)

@@ -8,8 +8,10 @@ The generator builds a ground-truth cluster with latent host capacities and
 datacenter structure, derives probe RTTs and observed transfer bandwidths from
 it (plus noise), and emits the dense TopoGraph + (child, parent) training
 pairs. Learnability is by construction: bandwidth is a deterministic-plus-noise
-function of latent structure that is *not* directly present in the features,
-so the GNN must actually use the graph to beat the linear baseline.
+function of latent structure that the features only echo partially (f[8]
+carries a noisy history for ~60% of pairs, mirroring the serving-side
+BandwidthHistory; the rest is absent), so the GNN must use the graph to rank
+the history-less pairs and beat the linear baseline.
 """
 
 from __future__ import annotations
@@ -117,7 +119,16 @@ def make_cluster(
     feats[:, 5] = same_idc * (0.6 + 0.4 * rng.random(num_pairs))  # location
     feats[:, 6] = np.where(same_idc > 0, 0.03, 0.5) * (1 + 0.2 * rng.standard_normal(num_pairs))
     feats[:, 7] = np.clip(0.2 + 0.3 * rng.random(num_pairs), 0, 1)
-    feats[:, 8] = 0.0  # bandwidth history unknown at schedule time
+    # Bandwidth history (serving-side BandwidthHistory EWMA): a noisy,
+    # partially-observed echo of the true bandwidth — ~60% of pairs have
+    # prior transfer history, the rest score with the 0.0 "no history" prior
+    # the feature contract defines (telemetry/bandwidth.py).
+    has_history = rng.random(num_pairs) < 0.6
+    feats[:, 8] = np.where(
+        has_history,
+        np.clip(bw * (1 + 0.25 * rng.standard_normal(num_pairs)), 0, 1),
+        0.0,
+    )
     feats[:, 9] = rng.random(num_pairs) * 0.4
     feats[:, 10] = rng.random(num_pairs)
     feats[:, 11] = 0.3 + 0.4 * rng.random(num_pairs)

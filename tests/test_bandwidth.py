@@ -1,0 +1,71 @@
+"""BandwidthHistory unit tests: EWMA math, pair/parent fallback, persistence
+warm-start (the serving store behind pair feature f[8], telemetry/bandwidth.py)."""
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.telemetry import BandwidthHistory, TelemetryStorage
+from dragonfly2_tpu.telemetry.bandwidth import BANDWIDTH_NORM_BPS
+
+
+def test_ewma_and_pair_priority():
+    h = BandwidthHistory(alpha=0.5)
+    h.observe("pa", "c1", 100.0)
+    h.observe("pa", "c1", 200.0)
+    assert h.query("pa", "c1") == pytest.approx(150.0)  # 0.5*100 + 0.5*200
+    # different child, no pair history → parent aggregate
+    assert h.query("pa", "c2") == pytest.approx(150.0)
+    # unknown parent → None; normalized → the 0.0 "no history" prior
+    assert h.query("px", "c1") is None
+    assert h.normalized("px", "c1") == 0.0
+
+
+def test_normalized_clips_to_unit():
+    h = BandwidthHistory()
+    h.observe("pa", "c1", 5 * BANDWIDTH_NORM_BPS)
+    assert h.normalized("pa", "c1") == 1.0
+    h2 = BandwidthHistory()
+    h2.observe("pb", "c1", BANDWIDTH_NORM_BPS / 4)
+    assert h2.normalized("pb", "c1") == pytest.approx(0.25)
+
+
+def test_rejects_garbage_observations():
+    h = BandwidthHistory()
+    h.observe("", "c1", 100.0)
+    h.observe("pa", "c1", 0.0)
+    h.observe("pa", "c1", -5.0)
+    h.observe("pa", "c1", float("nan"))
+    h.observe("pa", "c1", float("inf"))
+    assert len(h) == 0 and h.query("pa", "c1") is None
+
+
+def test_forget_host():
+    h = BandwidthHistory()
+    h.observe("pa", "c1", 100.0)
+    h.observe("pb", "c1", 100.0)
+    h.forget_host("pa")
+    assert h.query("pa", "c1") is None
+    assert h.query("pb", "c1") is not None
+    h.forget_host("c1")  # child side forgotten too
+    assert h.query("pb", "c1") == pytest.approx(100.0)  # parent aggregate remains
+
+
+def test_load_from_telemetry(tmp_path):
+    ts = TelemetryStorage(tmp_path)
+    common = dict(
+        task_id=b"t", child_peer_id=b"cp", parent_peer_id=b"pp",
+        piece_count=3, piece_size=1024, content_length=4096,
+        piece_cost_ms_mean=4.0, back_to_source=False,
+        pair_features=np.zeros(16, np.float32),
+    )
+    ts.downloads.append(child_host_id=b"c1", parent_host_id=b"pa",
+                        bandwidth_bps=1e8, success=True, **common)
+    ts.downloads.append(child_host_id=b"c1", parent_host_id=b"pb",
+                        bandwidth_bps=2e8, success=False, **common)  # skipped
+    ts.downloads.append(child_host_id=b"c1", parent_host_id=b"",
+                        bandwidth_bps=2e8, success=True, **common)  # back-to-source, skipped
+    ts.flush()
+    h = BandwidthHistory()
+    assert h.load_from(ts) == 1
+    assert h.query("pa", "c1") == pytest.approx(1e8)
+    assert h.query("pb", "c1") is None
