@@ -150,6 +150,7 @@ class PeerTaskConductor:
         config: ConductorConfig | None = None,
         http_session: aiohttp.ClientSession | None = None,
         headers: dict[str, str] | None = None,
+        shaper=None,
     ):
         self.peer_id = peer_id
         self.meta = meta
@@ -160,7 +161,14 @@ class PeerTaskConductor:
         self.headers = headers or None  # origin request headers (auth etc.)
         self.cfg = config or ConductorConfig()
         self.dispatcher = PieceDispatcher()
-        self.bucket = TokenBucket(self.cfg.download_rate_bps, burst=64 << 20)
+        # With a node-wide shaper (daemon/traffic_shaper.py) the conductor
+        # draws from a dynamically-allocated slice of the HOST budget; the
+        # standalone per-task bucket is the no-engine fallback (tests, direct
+        # conductor use).
+        if shaper is not None:
+            self.bucket = shaper.open_flow(peer_id)
+        else:
+            self.bucket = TokenBucket(self.cfg.download_rate_bps, burst=64 << 20)
         self._session = http_session
         self._owns_session = http_session is None
         self.ts: TaskStorage | None = None
@@ -184,6 +192,9 @@ class PeerTaskConductor:
             await self._safe_report_peer(success=False)
             raise
         finally:
+            close = getattr(self.bucket, "close", None)
+            if close is not None:
+                close()  # release this task's slice of the host budget
             if self._owns_session and self._session is not None:
                 await self._session.close()
 

@@ -80,7 +80,13 @@ class PeerEngine:
         location: str = "",
         upload_port: int = 0,
         conductor_config: ConductorConfig | None = None,
+        total_download_rate_bps: float | None = None,
     ):
+        from dragonfly2_tpu.daemon.traffic_shaper import (
+            TOTAL_DOWNLOAD_RATE_BPS,
+            SamplingTrafficShaper,
+        )
+
         self.ip = ip
         self.hostname = hostname or f"peer-{idgen.local_ip()}"
         # TCP RPC port, set by the daemon server when it listens on TCP —
@@ -94,6 +100,17 @@ class PeerEngine:
         self.sources = SourceRegistry()
         self.upload = UploadServer(self.storage, host=ip, port=upload_port)
         self.conductor_config = conductor_config or ConductorConfig()
+        # ONE host-wide download budget shared by all concurrent conductors
+        # (ref NewSamplingTrafficShaper, traffic_shaper.go:139) — per-task
+        # buckets alone would oversubscribe the host N×.
+        self.shaper = SamplingTrafficShaper(
+            total_rate_bps=(
+                TOTAL_DOWNLOAD_RATE_BPS
+                if total_download_rate_bps is None
+                else total_download_rate_bps
+            ),
+            per_flow_cap_bps=self.conductor_config.download_rate_bps,
+        )
         self._started = False
 
     @property
@@ -181,6 +198,7 @@ class PeerEngine:
             sources=self.sources,
             config=self.conductor_config,
             headers=headers,
+            shaper=self.shaper,
         )
         producer = asyncio.ensure_future(conductor.run())
         # Wait until the conductor registered storage + metadata. Polling:

@@ -46,13 +46,30 @@ class TokenBucket:
             return
         async with self._lock:
             # Loop instead of clamping: tokens taken by try_acquire() during the
-            # sleep must extend the wait, not be forgiven as debt.
-            while True:
+            # sleep must extend the wait, not be forgiven as debt. `take`
+            # re-clamps to the CURRENT burst each pass — set_rate() may shrink
+            # the bucket below n mid-wait (traffic-shaper reallocation) and a
+            # fixed n would then never be satisfiable.
+            while n > 0:
                 self._refill()
-                if self._tokens >= n:
-                    self._tokens -= n
-                    return
-                await asyncio.sleep((n - self._tokens) / self.rate)
+                take = min(n, self.burst)
+                if self._tokens >= take:
+                    self._tokens -= take
+                    n -= take
+                    continue
+                await asyncio.sleep((take - self._tokens) / self.rate)
+
+    def set_rate(self, rate: float, burst: float | None = None) -> None:
+        """Retarget the bucket (traffic-shaper reallocation). Accrued tokens
+        are settled at the OLD rate first; a waiter inside acquire() picks up
+        the new rate on its next loop iteration."""
+        if rate <= 0:
+            raise ValueError("rate must be > 0")
+        self._refill()
+        self.rate = float(rate)
+        if burst is not None:
+            self.burst = float(burst)
+            self._tokens = min(self._tokens, self.burst)
 
     @property
     def available(self) -> float:
