@@ -192,6 +192,8 @@ class PeerTaskConductor:
             await self._safe_report_peer(success=False)
             raise
         finally:
+            if self.ts is not None:
+                self.ts.unpin()  # storage reclaim may evict us again
             close = getattr(self.bucket, "close", None)
             if close is not None:
                 close()  # release this task's slice of the host budget
@@ -209,6 +211,7 @@ class PeerTaskConductor:
             tag=self.meta.tag,
             application=self.meta.application,
         )
+        self.ts.pin()  # immune to storage reclaim while this download runs
 
         if reg.scope == "empty":
             self.ts.set_task_info(content_length=0, piece_size=1, total_pieces=0)

@@ -81,6 +81,10 @@ class PeerEngine:
         upload_port: int = 0,
         conductor_config: ConductorConfig | None = None,
         total_download_rate_bps: float | None = None,
+        storage_gc_interval: float = 900.0,
+        storage_ttl: float = 24 * 3600,
+        storage_capacity_bytes: int | None = None,
+        disk_gc_threshold: float | None = None,
     ):
         from dragonfly2_tpu.daemon.traffic_shaper import (
             TOTAL_DOWNLOAD_RATE_BPS,
@@ -111,7 +115,31 @@ class PeerEngine:
             ),
             per_flow_cap_bps=self.conductor_config.download_rate_bps,
         )
+        # Periodic storage reclaim (ref gc_manager.go:54-77 ticker →
+        # storage CleanUp): TTL plus capacity/disk watermarks, LRU over
+        # complete tasks, in-progress immune (daemon/storage.py reclaim).
+        from dragonfly2_tpu.utils.gcreg import GC
+
+        self.gc = GC()
+        self.gc.add(
+            "storage-reclaim",
+            storage_gc_interval,
+            lambda: self._run_reclaim(
+                ttl=storage_ttl,
+                capacity_bytes=storage_capacity_bytes,
+                disk_high_ratio=disk_gc_threshold,
+            ),
+        )
         self._started = False
+
+    async def _run_reclaim(self, **kw) -> None:
+        # Off the event loop: the sweep stats every task file and rmtree's
+        # evictees — seconds of blocking disk I/O on a large store would
+        # freeze every active transfer (pins keep the thread from deleting
+        # anything a conductor or an in-flight read is using).
+        removed = await asyncio.to_thread(self.storage.reclaim, **kw)
+        if any(removed.values()):
+            logger.info("storage reclaim: %s", removed)
 
     @property
     def host_id(self) -> str:
@@ -132,10 +160,12 @@ class PeerEngine:
     async def start(self) -> None:
         if not self._started:
             await self.upload.start()
+            self.gc.start()
             self._started = True
 
     async def stop(self) -> None:
         if self._started:
+            self.gc.stop()
             await self.upload.stop()
             await self.sources.close()
             self._started = False

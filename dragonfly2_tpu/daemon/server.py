@@ -158,6 +158,9 @@ async def run_daemon(
     manager_addr: str | None = None,
     announce_interval: float = 30.0,
     probe_interval: float | None = None,
+    storage_ttl: float = 24 * 3600,
+    storage_capacity_bytes: int | None = None,
+    disk_gc_threshold: float | None = None,
     ready_event: asyncio.Event | None = None,
 ) -> None:
     from dragonfly2_tpu.rpc.balancer import make_scheduler_client
@@ -188,6 +191,9 @@ async def run_daemon(
         idc=idc,
         location=location,
         upload_port=upload_port,
+        storage_ttl=storage_ttl,
+        storage_capacity_bytes=storage_capacity_bytes,
+        disk_gc_threshold=disk_gc_threshold,
     )
     await engine.start()
 
@@ -384,6 +390,12 @@ def main() -> None:
     ap.add_argument("--manager", default=None, help="manager address host:port")
     ap.add_argument("--probe-interval", type=float, default=None,
                     help="RTT probe cadence in seconds (default 20 min)")
+    ap.add_argument("--storage-ttl-hours", type=float, default=24.0,
+                    help="reclaim tasks idle past this many hours")
+    ap.add_argument("--storage-capacity-gb", type=float, default=None,
+                    help="evict LRU complete tasks when the store exceeds this size")
+    ap.add_argument("--disk-gc-threshold-pct", type=float, default=None,
+                    help="evict LRU complete tasks when disk usage passes this percent")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
     if args.object_storage_backend == "s3":
@@ -419,6 +431,17 @@ def main() -> None:
             object_storage_backend=args.object_storage_backend,
             manager_addr=args.manager,
             probe_interval=args.probe_interval,
+            storage_ttl=args.storage_ttl_hours * 3600,
+            storage_capacity_bytes=(
+                int(args.storage_capacity_gb * (1 << 30))
+                if args.storage_capacity_gb is not None
+                else None
+            ),
+            disk_gc_threshold=(
+                args.disk_gc_threshold_pct / 100.0
+                if args.disk_gc_threshold_pct is not None
+                else None
+            ),
         )
     )
 

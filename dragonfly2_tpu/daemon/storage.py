@@ -50,6 +50,13 @@ class TaskStorage:
         self._bitset = Bitset(meta.finished_pieces)
         self._lock = asyncio.Lock()
         self._progress = asyncio.Event()  # replaced on every notify
+        # Reclaim protection: `pins` counts live users (a running conductor,
+        # an in-flight serving read) — reclaim never deletes a pinned task.
+        # `last_access` tracks READS in memory (writes refresh
+        # meta.updated_at; a popular seed task that only serves would
+        # otherwise look idle and be evicted first).
+        self.pins = 0
+        self.last_access = time.time()
         # In-memory change counter for push-style piece announcements: child
         # peers long-poll "metadata changed past version N" instead of
         # re-fetching on a timer (ref peertask_piecetask_synchronizer.go
@@ -155,10 +162,22 @@ class TaskStorage:
         return await self.read_range(r)
 
     async def read_range(self, r: Range) -> bytes:
-        async with self._lock:
-            with open(self.data_path, "rb") as f:
-                f.seek(r.start)
-                return f.read(r.length)
+        self.last_access = time.time()
+        self.pins += 1  # a concurrent (threaded) reclaim must not rmtree us mid-read
+        try:
+            async with self._lock:
+                with open(self.data_path, "rb") as f:
+                    f.seek(r.start)
+                    return f.read(r.length)
+        finally:
+            self.pins -= 1
+
+    def pin(self) -> None:
+        """Mark a live user (running conductor); pair with unpin()."""
+        self.pins += 1
+
+    def unpin(self) -> None:
+        self.pins = max(0, self.pins - 1)
 
     def mark_done(self) -> None:
         self.meta.done = True
@@ -249,7 +268,10 @@ class StorageManager:
     def find_completed_task(self, task_id: str) -> TaskStorage | None:
         """Reuse fast path (ref FindCompletedTask, storage_manager.go:100-105)."""
         ts = self._tasks.get(task_id)
-        return ts if ts is not None and ts.meta.done and ts.is_complete() else None
+        if ts is not None and ts.meta.done and ts.is_complete():
+            ts.last_access = time.time()  # reuse counts as use for LRU
+            return ts
+        return None
 
     def find_partial_task(self, task_id: str) -> TaskStorage | None:
         ts = self._tasks.get(task_id)
@@ -265,15 +287,67 @@ class StorageManager:
     def tasks(self) -> list[TaskStorage]:
         return list(self._tasks.values())
 
-    def reclaim(self, *, ttl: float = 24 * 3600) -> int:
-        """Drop tasks idle past ttl (ref Reclaimer + gc_manager.go loop)."""
+    def reclaim(
+        self,
+        *,
+        ttl: float = 24 * 3600,
+        capacity_bytes: int | None = None,
+        capacity_low_ratio: float = 0.8,
+        disk_high_ratio: float | None = None,
+        disk_low_ratio: float | None = None,
+    ) -> dict[str, int]:
+        """TTL + capacity reclaim (ref Reclaimer iface storage_manager.go:106,
+        CleanUp :912, and the diskGCThreshold/diskGCThresholdPercent configs).
+
+        Two triggers beyond the idle-TTL sweep:
+          capacity_bytes   — store-size budget: evict when total stored bytes
+                             exceed it, down to capacity_low_ratio of it
+          disk_high_ratio  — whole-filesystem watermark: evict when the disk
+                             holding the store passes it, down to
+                             disk_low_ratio (defaults to the high mark)
+        Eviction is LRU over COMPLETE tasks by last write OR serving read
+        (a popular seed task that only serves must rank hot, not idle);
+        PINNED tasks — a running conductor, an in-flight read — are immune
+        in BOTH sweeps, so neither trigger ever deletes a live transfer.
+        Returns removal counts by trigger.
+        """
         now = time.time()
-        n = 0
+
+        def last_used(ts: TaskStorage) -> float:
+            return max(ts.meta.updated_at, ts.last_access)
+
+        removed_ttl = 0
         for tid, ts in list(self._tasks.items()):
-            if now - ts.meta.updated_at > ttl:
+            if ts.pins <= 0 and now - last_used(ts) > ttl:
                 self.delete_task(tid)
-                n += 1
-        return n
+                removed_ttl += 1
+
+        to_free = 0.0
+        total = self.total_bytes()
+        if capacity_bytes is not None and total > capacity_bytes:
+            to_free = max(to_free, total - capacity_bytes * capacity_low_ratio)
+        if disk_high_ratio is not None:
+            import shutil
+
+            du = shutil.disk_usage(self.root)
+            if du.used / du.total > disk_high_ratio:
+                low = disk_low_ratio if disk_low_ratio is not None else disk_high_ratio
+                to_free = max(to_free, du.used - low * du.total)
+
+        removed_capacity = 0
+        if to_free > 0:
+            complete_lru = sorted(
+                (ts for ts in self._tasks.values() if ts.meta.done and ts.pins <= 0),
+                key=last_used,
+            )
+            for ts in complete_lru:
+                size = ts.data_path.stat().st_size if ts.data_path.exists() else 0
+                self.delete_task(ts.meta.task_id)
+                removed_capacity += 1
+                to_free -= size
+                if to_free <= 0:
+                    break
+        return {"ttl": removed_ttl, "capacity": removed_capacity}
 
     def total_bytes(self) -> int:
         return sum(

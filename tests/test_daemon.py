@@ -2,6 +2,7 @@
 
 import asyncio
 import hashlib
+import time
 
 import numpy as np
 import pytest
@@ -30,6 +31,124 @@ class TestStorage:
             await ts.write_piece(1, b"bbbb")
             assert ts.is_complete()
             assert await ts.read_range(Range(2, 6)) == b"aabbbb"
+
+        run(body())
+
+    def test_capacity_reclaim_evicts_lru_complete_only(self, run, tmp_path):
+        """Filling the store past the capacity budget evicts LEAST-RECENTLY-
+        UPDATED complete tasks until back under the low watermark; in-progress
+        downloads are immune (ref storage_manager.go:912 CleanUp)."""
+
+        async def body():
+            sm = StorageManager(tmp_path)
+
+            async def make_task(tid, *, done, age):
+                ts = sm.register_task(tid, url=f"http://x/{tid}")
+                ts.set_task_info(content_length=1000, piece_size=1000, total_pieces=1)
+                await ts.write_piece(0, b"x" * 1000)
+                if done:
+                    ts.mark_done()
+                ts.meta.updated_at = time.time() - age
+                ts.save_metadata()
+                ts.meta.updated_at = time.time() - age  # save refreshes; pin it
+                return ts
+
+            await make_task("old-complete", done=True, age=500)
+            await make_task("mid-complete", done=True, age=300)
+            await make_task("new-complete", done=True, age=10)
+            await make_task("in-progress", done=False, age=900)  # oldest but live
+
+            assert sm.total_bytes() == 4000
+            # budget 2500: must evict down to 2000 (low ratio 0.8)
+            removed = sm.reclaim(ttl=1e9, capacity_bytes=2500, capacity_low_ratio=0.8)
+            assert removed == {"ttl": 0, "capacity": 2}
+            assert sm.get("old-complete") is None  # LRU evicted first
+            assert sm.get("mid-complete") is None
+            assert sm.get("new-complete") is not None
+            assert sm.get("in-progress") is not None  # immune despite being oldest
+            assert sm.total_bytes() == 2000
+            # under budget now: another sweep removes nothing
+            assert sm.reclaim(ttl=1e9, capacity_bytes=2500) == {"ttl": 0, "capacity": 0}
+
+        run(body())
+
+    def test_pinned_tasks_immune_to_both_sweeps(self, run, tmp_path):
+        """A pinned task (running conductor / in-flight read) survives TTL
+        and capacity reclaim no matter how old it looks."""
+
+        async def body():
+            sm = StorageManager(tmp_path)
+            ts = sm.register_task("pinned", url="http://x/p")
+            ts.set_task_info(content_length=100, piece_size=100, total_pieces=1)
+            await ts.write_piece(0, b"z" * 100)
+            ts.mark_done()
+            ts.meta.updated_at = ts.last_access = time.time() - 1e6
+            ts.pin()
+            removed = sm.reclaim(ttl=1.0, capacity_bytes=10)
+            assert removed == {"ttl": 0, "capacity": 0}
+            assert sm.get("pinned") is not None
+            ts.unpin()
+            removed = sm.reclaim(ttl=1.0, capacity_bytes=10)
+            assert removed["ttl"] == 1 and sm.get("pinned") is None
+
+        run(body())
+
+    def test_serving_reads_keep_task_hot_in_lru(self, run, tmp_path):
+        """A complete task that only SERVES (reads, no writes) must rank
+        hotter than a written-more-recently-but-unread one."""
+
+        async def body():
+            sm = StorageManager(tmp_path)
+
+            async def mk(tid, age):
+                ts = sm.register_task(tid, url=f"http://x/{tid}")
+                ts.set_task_info(content_length=100, piece_size=100, total_pieces=1)
+                await ts.write_piece(0, b"q" * 100)
+                ts.mark_done()
+                ts.meta.updated_at = ts.last_access = time.time() - age
+                return ts
+
+            popular = await mk("popular", 900)  # old writes...
+            fresh_unread = await mk("fresh-unread", 300)
+            await popular.read_piece(0)  # ...but serving right now
+            removed = sm.reclaim(ttl=1e9, capacity_bytes=150, capacity_low_ratio=0.9)
+            assert removed["capacity"] == 1
+            assert sm.get("popular") is not None  # read recency saved it
+            assert sm.get("fresh-unread") is None
+
+        run(body())
+
+    def test_ttl_reclaim_still_sweeps(self, run, tmp_path):
+        async def body():
+            sm = StorageManager(tmp_path)
+            ts = sm.register_task("stale", url="http://x/s")
+            ts.set_task_info(content_length=4, piece_size=4, total_pieces=1)
+            await ts.write_piece(0, b"data")
+            ts.meta.updated_at = ts.last_access = time.time() - 10_000
+            fresh = sm.register_task("fresh", url="http://x/f")
+            fresh.set_task_info(content_length=4, piece_size=4, total_pieces=1)
+            removed = sm.reclaim(ttl=3600)
+            assert removed["ttl"] == 1
+            assert sm.get("stale") is None and sm.get("fresh") is not None
+
+        run(body())
+
+    def test_disk_threshold_reclaim(self, run, tmp_path):
+        """A disk-usage watermark below current usage forces eviction of
+        complete tasks (the whole-filesystem trigger)."""
+
+        async def body():
+            sm = StorageManager(tmp_path)
+            ts = sm.register_task("done1", url="http://x/1")
+            ts.set_task_info(content_length=100, piece_size=100, total_pieces=1)
+            await ts.write_piece(0, b"y" * 100)
+            ts.mark_done()
+            live = sm.register_task("live1", url="http://x/2")
+            live.set_task_info(content_length=100, piece_size=100, total_pieces=1)
+            # threshold 0.0: any usage is over; everything evictable must go
+            removed = sm.reclaim(ttl=1e9, disk_high_ratio=0.0)
+            assert removed["capacity"] == 1
+            assert sm.get("done1") is None and sm.get("live1") is not None
 
         run(body())
 
