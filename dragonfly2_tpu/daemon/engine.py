@@ -209,11 +209,21 @@ class PeerEngine:
         import asyncio
 
         ts = self.storage.find_completed_task(meta.task_id)
-        if ts is not None and await asyncio.to_thread(ts.verify):
-            # verify() hashes the whole file — off the event loop
-            logger.info("task %s: reuse fast path", meta.task_id[:12])
-            return ts, None
         if ts is not None:
+            # Pin across the verify AND the caller's subsequent use (export /
+            # stream): the reclaim sweep runs in a thread and must never
+            # rmtree a task an operation holds. Callers unpin when done.
+            ts.pin()
+            ok = False
+            try:
+                # verify() hashes the whole file — off the event loop
+                ok = await asyncio.to_thread(ts.verify)
+            finally:
+                if not ok:
+                    ts.unpin()
+            if ok:
+                logger.info("task %s: reuse fast path", meta.task_id[:12])
+                return ts, None
             # completed-but-corrupt local copy: purge so the conductor
             # re-fetches instead of short-circuiting on the full bitset
             logger.warning("task %s: local copy corrupt, purging", meta.task_id[:12])
@@ -238,6 +248,7 @@ class PeerEngine:
         while True:
             ts = self.storage.get(meta.task_id)
             if ts is not None and ts.meta.total_pieces >= 0:
+                ts.pin()  # released by the caller when its operation completes
                 return ts, producer
             if producer.done():
                 producer.result()  # raise the failure
@@ -264,22 +275,26 @@ class PeerEngine:
             metrics.SEED_TASK_TOTAL.inc()
 
         ts, producer = await self._reuse_or_conduct(meta, headers, seed=seed)
-        if producer is not None:
-            metrics.CONCURRENT_TASKS.inc()
-            try:
-                with default_tracer().span(
-                    "daemon.peer_task", task_id=meta.task_id, url=url
-                ):
-                    ts = await producer
-            except Exception:
-                metrics.TASK_RESULT_TOTAL.inc(success="false")
-                raise
-            finally:
-                metrics.CONCURRENT_TASKS.dec()
-            metrics.TASK_RESULT_TOTAL.inc(success="true")
-        if output is not None:
-            await ts.export_to(output)
-        return ts
+        pinned = ts  # engine-held pin for this operation (reclaim immunity)
+        try:
+            if producer is not None:
+                metrics.CONCURRENT_TASKS.inc()
+                try:
+                    with default_tracer().span(
+                        "daemon.peer_task", task_id=meta.task_id, url=url
+                    ):
+                        ts = await producer
+                except Exception:
+                    metrics.TASK_RESULT_TOTAL.inc(success="false")
+                    raise
+                finally:
+                    metrics.CONCURRENT_TASKS.dec()
+                metrics.TASK_RESULT_TOTAL.inc(success="true")
+            if output is not None:
+                await ts.export_to(output)
+            return ts
+        finally:
+            pinned.unpin()
 
     async def stream_task(
         self,
@@ -317,6 +332,7 @@ class PeerEngine:
                     producer.cancel()
                 raise
             finally:
+                ts.unpin()  # the stream held the operation pin to the last chunk
                 if producer is not None:
                     metrics.CONCURRENT_TASKS.dec()
 

@@ -56,7 +56,10 @@ class TaskStorage:
         # meta.updated_at; a popular seed task that only serves would
         # otherwise look idle and be evicted first).
         self.pins = 0
-        self.last_access = time.time()
+        # From updated_at, NOT now(): tasks restored from disk at daemon boot
+        # must keep their real age, or a daily-restarted daemon never
+        # TTL-evicts and its LRU order resets to arbitrary on every boot.
+        self.last_access = meta.updated_at
         # In-memory change counter for push-style piece announcements: child
         # peers long-poll "metadata changed past version N" instead of
         # re-fetching on a timer (ref peertask_piecetask_synchronizer.go
@@ -229,12 +232,16 @@ class TaskStorage:
         dest = Path(dest)
         dest.parent.mkdir(parents=True, exist_ok=True)
         dest.unlink(missing_ok=True)
+        self.last_access = time.time()
+        self.pins += 1  # a threaded reclaim must not rmtree us mid-export
         try:
             os.link(self.data_path, dest)
         except OSError:
             import shutil
 
-            shutil.copyfile(self.data_path, dest)
+            await asyncio.to_thread(shutil.copyfile, self.data_path, dest)
+        finally:
+            self.pins -= 1
 
 
 class StorageManager:

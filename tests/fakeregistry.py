@@ -1,0 +1,107 @@
+"""In-process OCI distribution registry fixture (aiohttp).
+
+Serves the slice of the distribution spec the oras source client uses:
+bearer-token auth challenge, manifest by tag, content-addressed blobs with
+Range support. Mirrors how tests/fakes3.py stands in for S3.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+
+from aiohttp import web
+
+TOKEN = "fixture-bearer-token"
+
+
+class FakeRegistry:
+    def __init__(self, *, require_auth: bool = True):
+        self.require_auth = require_auth
+        self.blobs: dict[str, bytes] = {}  # digest -> bytes
+        self.manifests: dict[tuple[str, str], dict] = {}  # (repo, tag) -> manifest
+        self.token_fetches = 0
+        self.app = web.Application()
+        self.app.router.add_get("/token", self._token)
+        self.app.router.add_get("/v2/{repo:.+}/manifests/{tag}", self._manifest)
+        self.app.router.add_get("/v2/{repo:.+}/blobs/{digest}", self._blob)
+        self._runner: web.AppRunner | None = None
+        self.port = 0
+
+    def push(self, repo: str, tag: str, payload: bytes) -> str:
+        """Store payload as a single-layer oras artifact; returns its digest."""
+        digest = "sha256:" + hashlib.sha256(payload).hexdigest()
+        self.blobs[digest] = payload
+        self.manifests[(repo, tag)] = {
+            "schemaVersion": 2,
+            "mediaType": "application/vnd.oci.image.manifest.v1+json",
+            "layers": [
+                {
+                    "mediaType": "application/vnd.oci.image.layer.v1.tar",
+                    "digest": digest,
+                    "size": len(payload),
+                }
+            ],
+        }
+        return digest
+
+    async def start(self) -> int:
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            await self._runner.cleanup()
+
+    # ---- handlers ----
+
+    def _authed(self, request: web.Request) -> bool:
+        if not self.require_auth:
+            return True
+        return request.headers.get("Authorization") == f"Bearer {TOKEN}"
+
+    def _challenge(self, request: web.Request) -> web.Response:
+        realm = f"http://127.0.0.1:{self.port}/token"
+        return web.Response(
+            status=401,
+            headers={
+                "WWW-Authenticate": f'Bearer realm="{realm}",service="fixture",scope="repository:x:pull"'
+            },
+        )
+
+    async def _token(self, request: web.Request) -> web.Response:
+        self.token_fetches += 1
+        return web.json_response({"token": TOKEN})
+
+    async def _manifest(self, request: web.Request) -> web.Response:
+        if not self._authed(request):
+            return self._challenge(request)
+        key = (request.match_info["repo"], request.match_info["tag"])
+        m = self.manifests.get(key)
+        if m is None:
+            return web.Response(status=404)
+        return web.Response(
+            body=json.dumps(m).encode(),
+            content_type="application/vnd.oci.image.manifest.v1+json",
+        )
+
+    async def _blob(self, request: web.Request) -> web.Response:
+        if not self._authed(request):
+            return self._challenge(request)
+        blob = self.blobs.get(request.match_info["digest"])
+        if blob is None:
+            return web.Response(status=404)
+        rng = request.headers.get("Range")
+        if rng:
+            lo_s, _, hi_s = rng.split("=", 1)[1].partition("-")
+            lo, hi = int(lo_s), int(hi_s) if hi_s else len(blob) - 1
+            return web.Response(
+                body=blob[lo : hi + 1],
+                status=206,
+                headers={"Content-Range": f"bytes {lo}-{hi}/{len(blob)}"},
+            )
+        return web.Response(body=blob)

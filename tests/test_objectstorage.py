@@ -341,6 +341,39 @@ class TestS3Source:
 
         run(body())
 
+    def test_oss_source_is_s3_dialect(self, run, monkeypatch):
+        """oss:// rides the same SigV4 client bound to OSS_* env (ref
+        ossprotocol — the reference points aws-sdk-go at an OSS endpoint the
+        same way); entry URLs keep the oss scheme."""
+
+        async def body():
+            from dragonfly2_tpu.daemon.source import OSSSourceClient, SourceRegistry
+            from dragonfly2_tpu.utils.pieces import Range
+            from tests.fakes3 import FakeS3
+
+            async with FakeS3() as s3:
+                monkeypatch.setenv("OSS_ENDPOINT", s3.endpoint)
+                monkeypatch.setenv("OSS_ACCESS_KEY_ID", "testkey")
+                monkeypatch.setenv("OSS_ACCESS_KEY_SECRET", "testsecret")
+                oss = OSSSourceClient()
+                await oss._c().create_bucket("buck")
+                await oss._c().put_object("buck", "dir/f.bin", b"oss-payload")
+                await oss._c().put_object("buck", "dir/sub/g.bin", b"x")
+                reg = SourceRegistry()
+                reg.register("oss", oss)
+                info = await reg.info("oss://buck/dir/f.bin")
+                assert info.content_length == 11 and info.supports_range
+                got = b""
+                async for chunk in reg.download("oss://buck/dir/f.bin", Range(4, 7)):
+                    got += chunk
+                assert got == b"payload"
+                entries = await reg.list_entries("oss://buck/dir")
+                assert {(e.name, e.is_dir) for e in entries} == {("f.bin", False), ("sub", True)}
+                assert all(e.url.startswith("oss://") for e in entries)
+                await reg.close()
+
+        run(body())
+
     def test_listing_for_recursive(self, run, tmp_path):
         async def body():
             from dragonfly2_tpu.daemon.source import S3SourceClient, SourceRegistry

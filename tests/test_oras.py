@@ -1,0 +1,134 @@
+"""oras:// OCI-registry source client (daemon/oras_source.py; ref
+pkg/source/clients/orasprotocol/oras_source_client.go) against the fixture
+registry, through to a full P2P download."""
+
+import hashlib
+import os
+
+import pytest
+
+from dragonfly2_tpu.daemon.oras_source import ORASSourceClient
+from dragonfly2_tpu.daemon.source import SourceError, SourceRegistry
+from dragonfly2_tpu.utils.pieces import Range
+from tests.fakeregistry import FakeRegistry
+
+
+@pytest.fixture(autouse=True)
+def plain_http(monkeypatch):
+    monkeypatch.setenv("DF_ORAS_PLAIN_HTTP", "127.0.0.1")
+
+
+def test_url_parsing():
+    assert ORASSourceClient.parse("oras://reg.io/repo:v1") == ("reg.io", "repo", "v1")
+    assert ORASSourceClient.parse("oras://reg.io:5000/org/app/model:latest") == (
+        "reg.io:5000", "org/app/model", "latest",
+    )
+    assert ORASSourceClient.parse("oras://reg.io/repo") == ("reg.io", "repo", "latest")
+    with pytest.raises(SourceError):
+        ORASSourceClient.parse("oras://reg.io")
+    with pytest.raises(SourceError):
+        ORASSourceClient.parse("oras://reg.io/repo:")
+
+
+def test_info_download_and_token_dance(run):
+    async def body():
+        reg = FakeRegistry()
+        payload = os.urandom(200_000)
+        reg.push("org/model", "v1", payload)
+        await reg.start()
+        try:
+            c = ORASSourceClient()
+            url = f"oras://127.0.0.1:{reg.port}/org/model:v1"
+            info = await c.info(url)
+            assert info.content_length == len(payload) and info.supports_range
+            assert info.etag == "sha256:" + hashlib.sha256(payload).hexdigest()
+            got = b"".join([chunk async for chunk in c.download(url)])
+            assert got == payload
+            # ranged read (the piece engine's shape)
+            part = b"".join(
+                [chunk async for chunk in c.download(url, rng=Range(1000, 4096))]
+            )
+            assert part == payload[1000:5096]
+            # ONE token fetch covered all requests (cached per host+repo)
+            assert reg.token_fetches == 1
+            await c.close()
+        finally:
+            await reg.stop()
+
+    run(body())
+
+
+def test_missing_artifact_raises(run):
+    async def body():
+        reg = FakeRegistry()
+        await reg.start()
+        try:
+            c = ORASSourceClient()
+            with pytest.raises(SourceError, match="404"):
+                await c.info(f"oras://127.0.0.1:{reg.port}/no/such:v9")
+            await c.close()
+        finally:
+            await reg.stop()
+
+    run(body())
+
+
+def test_registry_exposes_oras_scheme(run):
+    async def body():
+        reg = FakeRegistry(require_auth=False)
+        payload = b"oras artifact payload"
+        reg.push("r", "t", payload)
+        await reg.start()
+        try:
+            sources = SourceRegistry()
+            url = f"oras://127.0.0.1:{reg.port}/r:t"
+            info = await sources.info(url)
+            assert info.content_length == len(payload)
+            got = b"".join([c async for c in sources.download(url)])
+            assert got == payload
+            await sources.close()
+        finally:
+            await reg.stop()
+
+    run(body())
+
+
+def test_e2e_oras_pull_through_p2p(run, tmp_path):
+    """VERDICT r3 #6 done-criterion: a fixture registry blob pulled through
+    the P2P engine — peer A back-to-sources from the registry, peer B gets
+    the pieces from peer A, sha256-verified."""
+    from dragonfly2_tpu.daemon.engine import InProcessSchedulerClient, PeerEngine
+    from dragonfly2_tpu.scheduler.service import SchedulerService
+
+    async def body():
+        reg = FakeRegistry()
+        payload = os.urandom(3_000_000)  # multi-piece at the 1 MiB piece size
+        reg.push("org/weights", "r4", payload)
+        await reg.start()
+        svc = SchedulerService()
+        sched = InProcessSchedulerClient(svc)
+        a = PeerEngine(storage_root=tmp_path / "a", scheduler=sched, hostname="pa")
+        b = PeerEngine(storage_root=tmp_path / "b", scheduler=sched, hostname="pb")
+        try:
+            await a.start()
+            await b.start()
+            url = f"oras://127.0.0.1:{reg.port}/org/weights:r4"
+            ts_a = await a.download_task(url)
+            assert ts_a.meta.done
+            ts_b = await b.download_task(url)
+            want = hashlib.sha256(payload).hexdigest()
+            for ts in (ts_a, ts_b):
+                got = hashlib.sha256(ts.data_path.read_bytes()).hexdigest()
+                assert got == want
+            # peer B actually used the P2P path: its completion report carried
+            # observed bandwidth attributed to peer A's host (parents existed
+            # at report time), which only happens on parent downloads
+            assert svc.bandwidth.query(a.host_id, b.host_id) is not None
+            # operation pins released: tasks are reclaim-eligible again
+            assert ts_a.pins == 0 and ts_b.pins == 0
+        finally:
+            await a.stop()
+            await b.stop()
+            await reg.stop()
+
+    run(body())
