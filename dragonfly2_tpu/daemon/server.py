@@ -161,6 +161,8 @@ async def run_daemon(
     storage_ttl: float = 24 * 3600,
     storage_capacity_bytes: int | None = None,
     disk_gc_threshold: float | None = None,
+    total_download_rate_bps: float | None = None,
+    per_task_rate_bps: float | None = None,
     ready_event: asyncio.Event | None = None,
 ) -> None:
     from dragonfly2_tpu.rpc.balancer import make_scheduler_client
@@ -182,6 +184,11 @@ async def run_daemon(
     scheduler = make_scheduler_client(scheduler_addr, resolve=resolve)
     if hasattr(scheduler, "start_resolver"):
         scheduler.start_resolver()
+    from dragonfly2_tpu.daemon.conductor import ConductorConfig
+
+    conductor_config = None
+    if per_task_rate_bps is not None:
+        conductor_config = ConductorConfig(download_rate_bps=per_task_rate_bps)
     engine = PeerEngine(
         storage_root=storage_root,
         scheduler=scheduler,
@@ -191,6 +198,8 @@ async def run_daemon(
         idc=idc,
         location=location,
         upload_port=upload_port,
+        conductor_config=conductor_config,
+        total_download_rate_bps=total_download_rate_bps,
         storage_ttl=storage_ttl,
         storage_capacity_bytes=storage_capacity_bytes,
         disk_gc_threshold=disk_gc_threshold,
@@ -355,46 +364,66 @@ def _host_stats() -> dict:
 
 
 def main() -> None:
-    ap = argparse.ArgumentParser(description="dragonfly2_tpu peer daemon")
-    ap.add_argument("--scheduler", required=True, help="scheduler address host:port")
-    ap.add_argument("--storage", default=os.path.expanduser("~/.dragonfly2_tpu/storage"))
-    ap.add_argument("--sock", default="/tmp/dragonfly2_tpu_daemon.sock")
-    ap.add_argument("--ip", default="127.0.0.1")
-    ap.add_argument("--hostname", default="")
-    ap.add_argument("--seed", action="store_true", help="run as seed peer")
-    ap.add_argument("--idc", default="")
-    ap.add_argument("--location", default="")
-    ap.add_argument("--upload-port", type=int, default=0)
-    ap.add_argument("--metrics-port", type=int, default=None,
+    import sys
+
+    from dragonfly2_tpu.daemon.config import DaemonYaml
+    from dragonfly2_tpu.utils.config import ConfigError, load_config
+
+    # Two-stage parse (the reference's cobra/viper layering): --config loads
+    # the validated YAML, whose values become the flag DEFAULTS.
+    pre = argparse.ArgumentParser(add_help=False)
+    pre.add_argument("--config", default=None, help="YAML config file (flags override)")
+    cargs, _ = pre.parse_known_args()
+    try:
+        cfg = load_config(DaemonYaml, cargs.config)
+    except (ConfigError, OSError) as e:
+        print(f"daemon: {e}", file=sys.stderr)
+        raise SystemExit(2)
+
+    ap = argparse.ArgumentParser(description="dragonfly2_tpu peer daemon", parents=[pre])
+    ap.add_argument("--scheduler", required=not cfg.scheduler, default=cfg.scheduler or None,
+                    help="scheduler address host:port")
+    ap.add_argument("--storage", default=os.path.expanduser(cfg.storage.root))
+    ap.add_argument("--sock", default=cfg.sock)
+    ap.add_argument("--ip", default=cfg.ip)
+    ap.add_argument("--hostname", default=cfg.hostname)
+    ap.add_argument("--seed", action=argparse.BooleanOptionalAction, default=cfg.seed,
+                    help="run as seed peer (--no-seed overrides a config-file true)")
+    ap.add_argument("--idc", default=cfg.idc)
+    ap.add_argument("--location", default=cfg.location)
+    ap.add_argument("--upload-port", type=int, default=cfg.upload_port)
+    ap.add_argument("--metrics-port", type=int, default=cfg.metrics_port,
                     help="dedicated debug/metrics port (off by default)")
-    ap.add_argument("--proxy-port", type=int, default=None,
+    ap.add_argument("--proxy-port", type=int, default=cfg.proxy.port,
                     help="HTTP proxy / registry-mirror port (off by default)")
-    ap.add_argument("--proxy-rule", action="append", default=[],
-                    help="URL regex routed through P2P (repeatable)")
-    ap.add_argument("--registry-mirror", default=None,
+    ap.add_argument("--proxy-rule", action="append", default=None,
+                    help="URL regex routed through P2P (repeatable; REPLACES config-file rules)")
+    ap.add_argument("--registry-mirror", default=cfg.proxy.registry_mirror,
                     help="upstream registry base URL for mirror mode")
-    ap.add_argument("--hijack-ca-dir", default=None,
+    ap.add_argument("--hijack-ca-dir", default=cfg.proxy.hijack_ca_dir,
                     help="CA dir enabling HTTPS MITM on the proxy (forged leaf certs)")
-    ap.add_argument("--hijack-host", action="append", default=[],
-                    help="host regex to MITM (repeatable; default all when CA set)")
-    ap.add_argument("--sni-proxy-port", type=int, default=None,
+    ap.add_argument("--hijack-host", action="append", default=None,
+                    help="host regex to MITM (repeatable; REPLACES config-file hosts; default all when CA set)")
+    ap.add_argument("--sni-proxy-port", type=int, default=cfg.proxy.sni_port,
                     help="raw-TLS SNI proxy port (off by default)")
-    ap.add_argument("--object-storage-port", type=int, default=None,
+    ap.add_argument("--object-storage-port", type=int, default=cfg.object_storage.port,
                     help="dfstore object gateway port (off by default)")
-    ap.add_argument("--object-storage-root", default=None,
+    ap.add_argument("--object-storage-root", default=cfg.object_storage.root,
                     help="fs backend root (default: <storage>-objects)")
-    ap.add_argument("--object-storage-backend", default="fs", choices=["fs", "s3"],
+    ap.add_argument("--object-storage-backend", default=cfg.object_storage.backend,
+                    choices=["fs", "s3"],
                     help="object store behind the gateway; s3 reads AWS_* env vars")
-    ap.add_argument("--rpc-port", type=int, default=None,
+    ap.add_argument("--rpc-port", type=int, default=cfg.rpc_port,
                     help="TCP RPC port (seed peers always listen; 0 = ephemeral)")
-    ap.add_argument("--manager", default=None, help="manager address host:port")
-    ap.add_argument("--probe-interval", type=float, default=None,
+    ap.add_argument("--manager", default=cfg.manager, help="manager address host:port")
+    ap.add_argument("--probe-interval", type=float, default=cfg.probe_interval,
                     help="RTT probe cadence in seconds (default 20 min)")
-    ap.add_argument("--storage-ttl-hours", type=float, default=24.0,
+    ap.add_argument("--storage-ttl-hours", type=float, default=cfg.storage.ttl_hours,
                     help="reclaim tasks idle past this many hours")
-    ap.add_argument("--storage-capacity-gb", type=float, default=None,
+    ap.add_argument("--storage-capacity-gb", type=float, default=cfg.storage.capacity_gb,
                     help="evict LRU complete tasks when the store exceeds this size")
-    ap.add_argument("--disk-gc-threshold-pct", type=float, default=None,
+    ap.add_argument("--disk-gc-threshold-pct", type=float,
+                    default=cfg.storage.disk_gc_threshold_pct,
                     help="evict LRU complete tasks when disk usage passes this percent")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
@@ -421,10 +450,12 @@ def main() -> None:
             rpc_port=args.rpc_port,
             metrics_port=args.metrics_port,
             proxy_port=args.proxy_port,
-            proxy_rules=args.proxy_rule,
+            proxy_rules=args.proxy_rule if args.proxy_rule is not None else list(cfg.proxy.rules),
             registry_mirror=args.registry_mirror,
             hijack_ca_dir=args.hijack_ca_dir,
-            hijack_hosts=args.hijack_host,
+            hijack_hosts=(
+                args.hijack_host if args.hijack_host is not None else list(cfg.proxy.hijack_hosts)
+            ),
             sni_proxy_port=args.sni_proxy_port,
             object_storage_port=args.object_storage_port,
             object_storage_root=args.object_storage_root,
@@ -442,6 +473,8 @@ def main() -> None:
                 if args.disk_gc_threshold_pct is not None
                 else None
             ),
+            total_download_rate_bps=cfg.rate_limit.total_download_mib_per_s * (1 << 20),
+            per_task_rate_bps=cfg.rate_limit.per_task_mib_per_s * (1 << 20),
         )
     )
 

@@ -119,20 +119,38 @@ async def amain(args: argparse.Namespace) -> None:
 
 
 def main() -> None:
-    p = argparse.ArgumentParser(description="dragonfly2-tpu manager")
-    p.add_argument("--db", default=":memory:")
-    p.add_argument("--host", default="127.0.0.1")
-    p.add_argument("--port", type=int, default=9200)
-    p.add_argument("--rest-port", type=int, default=9201)
-    p.add_argument("--metrics-port", type=int, default=None)
-    p.add_argument("--ca-dir", default=None, help="enable the cluster CA (cert issuance)")
-    p.add_argument("--cert-token", default=os.environ.get("DRAGONFLY_CERT_TOKEN"),
+    import sys
+
+    from dragonfly2_tpu.manager.config import ManagerYaml
+    from dragonfly2_tpu.utils.config import ConfigError, load_config
+
+    pre = argparse.ArgumentParser(add_help=False)
+    pre.add_argument("--config", default=None, help="YAML config file (flags override)")
+    cargs, _ = pre.parse_known_args()
+    try:
+        cfg = load_config(ManagerYaml, cargs.config)
+    except (ConfigError, OSError) as e:
+        print(f"manager: {e}", file=sys.stderr)
+        raise SystemExit(2)
+
+    p = argparse.ArgumentParser(description="dragonfly2-tpu manager", parents=[pre])
+    p.add_argument("--db", default=cfg.db)
+    p.add_argument("--host", default=cfg.host)
+    p.add_argument("--port", type=int, default=cfg.port)
+    p.add_argument("--rest-port", type=int, default=cfg.rest_port)
+    p.add_argument("--metrics-port", type=int, default=cfg.metrics_port)
+    p.add_argument("--ca-dir", default=cfg.security.ca_dir,
+                   help="enable the cluster CA (cert issuance)")
+    p.add_argument("--cert-token",
+                   default=cfg.security.cert_token or os.environ.get("DRAGONFLY_CERT_TOKEN"),
                    help="bootstrap token gating RPC certificate issuance")
-    p.add_argument("--auth-secret", default=os.environ.get("DRAGONFLY_AUTH_SECRET"),
+    p.add_argument("--auth-secret",
+                   default=cfg.security.auth_secret or os.environ.get("DRAGONFLY_AUTH_SECRET"),
                    help="enable REST auth: HMAC secret for bearer tokens")
-    p.add_argument("--admin-password", default=os.environ.get("DRAGONFLY_ADMIN_PASSWORD"),
+    p.add_argument("--admin-password",
+                   default=cfg.security.admin_password or os.environ.get("DRAGONFLY_ADMIN_PASSWORD"),
                    help="bootstrap the admin user on first start")
-    p.add_argument("--keepalive-ttl", type=float, default=60.0)
+    p.add_argument("--keepalive-ttl", type=float, default=cfg.keepalive_ttl)
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args()
     logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)

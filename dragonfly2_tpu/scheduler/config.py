@@ -1,0 +1,72 @@
+"""Scheduler YAML config schema (ref scheduler/config/config.go:76-424).
+
+``python -m dragonfly2_tpu.scheduler.server --config scheduler.yaml`` boots
+from this; CLI flags override file values field for field. Defaults mirror
+the reference's constants (scheduler/config/constants.go:36-93).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from dragonfly2_tpu.utils.config import cfgfield
+
+
+@dataclass
+class SchedulingSection:
+    """Candidate selection budgets (ref constants.go:36-79)."""
+
+    candidate_parent_limit: int = cfgfield(4, minimum=1, maximum=20)
+    filter_parent_limit: int = cfgfield(40, minimum=1, maximum=1000)
+    retry_limit: int = cfgfield(10, minimum=1, maximum=100)
+    retry_back_to_source_limit: int = cfgfield(5, minimum=0, maximum=100)
+    retry_interval: float = cfgfield(0.05, minimum=0.001, maximum=60.0)
+    max_tree_depth: int = cfgfield(4, minimum=1, maximum=64)
+
+
+@dataclass
+class GCSection:
+    """Resource TTLs in seconds (ref constants.go:81-93)."""
+
+    peer_ttl: float = cfgfield(24 * 3600.0, minimum=1.0)
+    task_ttl: float = cfgfield(30 * 60.0, minimum=1.0)  # 30 min idle, matches GCPolicy
+    host_ttl: float = cfgfield(6 * 3600.0, minimum=1.0)
+    interval: float = cfgfield(10.0, minimum=1.0)  # matches run_scheduler default
+
+
+@dataclass
+class SchedulerYaml:
+    host: str = cfgfield("127.0.0.1")
+    port: int = cfgfield(9000, minimum=0, maximum=65535)
+    hostname: str = cfgfield("")
+    idc: str = cfgfield("")
+    location: str = cfgfield("")
+    evaluator: str = cfgfield("base", choices=("base", "ml"))
+    telemetry_dir: Optional[str] = cfgfield(None)
+    metrics_port: Optional[int] = cfgfield(None, minimum=0, maximum=65535)
+    manager: Optional[str] = cfgfield(None, help="manager address host:port")
+    trainer: Optional[str] = cfgfield(None, help="trainer address host:port")
+    trainer_interval: Optional[float] = cfgfield(None, minimum=1.0)
+    scheduling: SchedulingSection = cfgfield(default_factory=SchedulingSection)
+    gc: GCSection = cfgfield(default_factory=GCSection)
+
+    def scheduling_config(self):
+        from dragonfly2_tpu.scheduler.scheduling import SchedulingConfig
+
+        s = self.scheduling
+        return SchedulingConfig(
+            candidate_parent_limit=s.candidate_parent_limit,
+            filter_parent_limit=s.filter_parent_limit,
+            retry_limit=s.retry_limit,
+            retry_back_to_source_limit=s.retry_back_to_source_limit,
+            retry_interval=s.retry_interval,
+            max_tree_depth=s.max_tree_depth,
+        )
+
+    def gc_policy(self):
+        from dragonfly2_tpu.scheduler.resource import GCPolicy
+
+        return GCPolicy(
+            peer_ttl=self.gc.peer_ttl, task_ttl=self.gc.task_ttl, host_ttl=self.gc.host_ttl
+        )

@@ -34,12 +34,19 @@ async def run_scheduler(
     hostname: str = "",
     idc: str = "",
     location: str = "",
+    scheduling_config=None,
+    gc_policy=None,
     ready_event: asyncio.Event | None = None,
 ) -> None:
     from dragonfly2_tpu.scheduler.evaluator import new_evaluator
 
     telemetry = TelemetryStorage(telemetry_dir) if telemetry_dir else None
-    service = SchedulerService(evaluator=new_evaluator(evaluator), telemetry=telemetry)
+    service = SchedulerService(
+        evaluator=new_evaluator(evaluator),
+        telemetry=telemetry,
+        scheduling_config=scheduling_config,
+        gc_policy=gc_policy,
+    )
     server = serve_scheduler(service, host=host, port=port)
     await server.start()
     logger.info("scheduler listening on %s", server.address)
@@ -115,19 +122,36 @@ def _sweep(service: SchedulerService) -> None:
 
 
 def main() -> None:
-    ap = argparse.ArgumentParser(description="dragonfly2_tpu scheduler")
-    ap.add_argument("--host", default="127.0.0.1")
-    ap.add_argument("--port", type=int, default=9000)
-    ap.add_argument("--telemetry-dir", default=None)
-    ap.add_argument("--metrics-port", type=int, default=None)
-    ap.add_argument("--evaluator", default="base", choices=["base", "ml"])
-    ap.add_argument("--manager", default=None, help="manager address host:port")
-    ap.add_argument("--trainer", default=None, help="trainer address host:port")
-    ap.add_argument("--trainer-interval", type=float, default=None,
+    import sys
+
+    from dragonfly2_tpu.scheduler.config import SchedulerYaml
+    from dragonfly2_tpu.utils.config import ConfigError, load_config
+
+    # Two-stage parse (the reference's cobra/viper layering): --config loads
+    # the validated YAML, whose values become the flag DEFAULTS — so explicit
+    # flags override the file, and the file overrides built-in defaults.
+    pre = argparse.ArgumentParser(add_help=False)
+    pre.add_argument("--config", default=None, help="YAML config file (flags override)")
+    cargs, _ = pre.parse_known_args()
+    try:
+        cfg = load_config(SchedulerYaml, cargs.config)
+    except (ConfigError, OSError) as e:
+        print(f"scheduler: {e}", file=sys.stderr)
+        raise SystemExit(2)
+
+    ap = argparse.ArgumentParser(description="dragonfly2_tpu scheduler", parents=[pre])
+    ap.add_argument("--host", default=cfg.host)
+    ap.add_argument("--port", type=int, default=cfg.port)
+    ap.add_argument("--telemetry-dir", default=cfg.telemetry_dir)
+    ap.add_argument("--metrics-port", type=int, default=cfg.metrics_port)
+    ap.add_argument("--evaluator", default=cfg.evaluator, choices=["base", "ml"])
+    ap.add_argument("--manager", default=cfg.manager, help="manager address host:port")
+    ap.add_argument("--trainer", default=cfg.trainer, help="trainer address host:port")
+    ap.add_argument("--trainer-interval", type=float, default=cfg.trainer_interval,
                     help="telemetry upload cadence in seconds (default 7 days)")
-    ap.add_argument("--hostname", default="")
-    ap.add_argument("--idc", default="")
-    ap.add_argument("--location", default="")
+    ap.add_argument("--hostname", default=cfg.hostname)
+    ap.add_argument("--idc", default=cfg.idc)
+    ap.add_argument("--location", default=cfg.location)
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
     logging.basicConfig(
@@ -141,12 +165,15 @@ def main() -> None:
             telemetry_dir=args.telemetry_dir,
             evaluator=args.evaluator,
             metrics_port=args.metrics_port,
+            gc_interval=cfg.gc.interval,
             manager_addr=args.manager,
             trainer_addr=args.trainer,
             trainer_interval=args.trainer_interval,
             hostname=args.hostname,
             idc=args.idc,
             location=args.location,
+            scheduling_config=cfg.scheduling_config(),
+            gc_policy=cfg.gc_policy(),
         )
     )
 

@@ -1,0 +1,166 @@
+"""Validated YAML config surface (utils/config.py + per-service schemas;
+ref client/config/peerhost.go:176-476 Validate(), scheduler/config/config.go)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from dragonfly2_tpu.daemon.config import DaemonYaml
+from dragonfly2_tpu.manager.config import ManagerYaml
+from dragonfly2_tpu.scheduler.config import SchedulerYaml
+from dragonfly2_tpu.utils.config import ConfigError, cfgfield, load_config, validate
+
+
+def test_defaults_without_file():
+    cfg = load_config(SchedulerYaml)
+    assert cfg.port == 9000 and cfg.evaluator == "base"
+    assert cfg.scheduling.candidate_parent_limit == 4
+    assert cfg.gc.host_ttl == 6 * 3600
+
+
+def test_yaml_file_overrides_defaults(tmp_path):
+    f = tmp_path / "s.yaml"
+    f.write_text(
+        """
+port: 9555
+evaluator: ml
+scheduling:
+  retry_limit: 3
+  retry_interval: 0.2
+"""
+    )
+    cfg = load_config(SchedulerYaml, f)
+    assert cfg.port == 9555 and cfg.evaluator == "ml"
+    assert cfg.scheduling.retry_limit == 3
+    assert cfg.scheduling.filter_parent_limit == 40  # untouched default
+    sc = cfg.scheduling_config()
+    assert sc.retry_limit == 3 and sc.retry_interval == pytest.approx(0.2)
+
+
+def test_flag_overrides_beat_file(tmp_path):
+    f = tmp_path / "s.yaml"
+    f.write_text("port: 9555\n")
+    cfg = load_config(SchedulerYaml, f, overrides={"port": 9777, "gc.interval": 5.0})
+    assert cfg.port == 9777 and cfg.gc.interval == 5.0
+
+
+@pytest.mark.parametrize(
+    "yaml_text,path_frag",
+    [
+        ("port: 99999\n", "port"),  # above maximum
+        ("evaluator: quantum\n", "evaluator"),  # not a choice
+        ("scheduling:\n  retry_limit: 0\n", "scheduling.retry_limit"),  # below min
+        ("scheduling:\n  retry_limit: fast\n", "scheduling.retry_limit"),  # wrong type
+        ("no_such_key: 1\n", "no_such_key"),  # unknown key
+        ("scheduling:\n  typo_limit: 1\n", "scheduling.typo_limit"),  # nested unknown
+        ("port: true\n", "port"),  # bool is not an int
+        ("- a\n- b\n", "<root>"),  # not a mapping
+    ],
+)
+def test_field_precise_rejection(tmp_path, yaml_text, path_frag):
+    f = tmp_path / "bad.yaml"
+    f.write_text(yaml_text)
+    with pytest.raises(ConfigError) as ei:
+        load_config(SchedulerYaml, f)
+    assert path_frag in str(ei.value)
+
+
+def test_daemon_schema_sections(tmp_path):
+    f = tmp_path / "d.yaml"
+    f.write_text(
+        """
+scheduler: "10.0.0.1:9000"
+seed: true
+storage:
+  root: /data/df
+  capacity_gb: 100
+  disk_gc_threshold_pct: 90
+proxy:
+  port: 65001
+  rules: ["^http://cdn\\\\."]
+rate_limit:
+  total_download_mib_per_s: 2048
+  per_task_mib_per_s: 512
+"""
+    )
+    cfg = load_config(DaemonYaml, f)
+    assert cfg.seed and cfg.storage.capacity_gb == 100
+    assert cfg.proxy.rules == ["^http://cdn\\."]
+    assert cfg.rate_limit.total_download_mib_per_s == 2048
+
+
+def test_daemon_cross_field_validation(tmp_path):
+    f = tmp_path / "d.yaml"
+    f.write_text("rate_limit:\n  total_download_mib_per_s: 100\n  per_task_mib_per_s: 500\n")
+    with pytest.raises(ConfigError) as ei:
+        load_config(DaemonYaml, f)
+    assert "per_task_mib_per_s" in str(ei.value)
+
+
+def test_manager_schema(tmp_path):
+    f = tmp_path / "m.yaml"
+    f.write_text("db: /var/df/manager.db\nsecurity:\n  auth_secret: s3cret\n")
+    cfg = load_config(ManagerYaml, f)
+    assert cfg.db == "/var/df/manager.db" and cfg.security.auth_secret == "s3cret"
+
+
+def test_validate_catches_post_load_mutation():
+    cfg = load_config(SchedulerYaml)
+    cfg.scheduling.retry_limit = -1
+    with pytest.raises(ConfigError, match="scheduling.retry_limit"):
+        validate(cfg)
+
+
+def test_service_boots_reject_invalid_config(tmp_path):
+    """Done-criterion: each service entrypoint rejects a bad config file with
+    a field-precise error on stderr and exit code 2."""
+    for module, text, frag in (
+        ("dragonfly2_tpu.scheduler.server", "port: 99999\n", "port"),
+        ("dragonfly2_tpu.manager.server", "port: 99999\n", "port"),
+        ("dragonfly2_tpu.daemon.server", "upload_port: 99999\n", "upload_port"),
+    ):
+        bad = tmp_path / f"bad-{frag}.yaml"
+        bad.write_text(text)
+        out = subprocess.run(
+            [sys.executable, "-m", module, "--config", str(bad)],
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert out.returncode == 2, (module, out.stderr)
+        assert frag in out.stderr and "99999" in out.stderr
+
+
+def test_scheduler_boots_from_yaml(tmp_path):
+    """A valid YAML actually boots the scheduler (exit via quick SIGTERM)."""
+    import os
+    import signal
+    import time
+
+    f = tmp_path / "ok.yaml"
+    f.write_text("port: 0\nscheduling:\n  retry_limit: 2\n")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "dragonfly2_tpu.scheduler.server", "--config", str(f)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        deadline = time.time() + 30
+        booted = False
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                break
+            time.sleep(0.3)
+            booted = True  # still running after grace = boot succeeded
+            if time.time() > deadline - 28:
+                break
+        assert booted and proc.poll() is None, proc.stdout.read() if proc.stdout else ""
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
