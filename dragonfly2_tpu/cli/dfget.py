@@ -98,11 +98,12 @@ async def single_download(
             "application": args.application,
             "digest": args.digest if url == args.url else "",
             "filters": args.filter,
+            "range": args.range if url == args.url else "",
         },
         timeout=args.timeout,
     )
     elapsed = time.monotonic() - t0
-    size = result["content_length"]
+    size = result.get("exported_bytes", result["content_length"])
     rate = size / max(elapsed, 1e-6) / (1 << 20)
     print(
         f"downloaded {url} -> {output}: {size} bytes, "
@@ -217,6 +218,8 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--tag", default="")
     ap.add_argument("--application", default="")
     ap.add_argument("--digest", default="", help="expected digest algo:hex")
+    ap.add_argument("--range", default="",
+                    help="byte range START-END (inclusive) to export from the task")
     ap.add_argument("--filter", action="append", default=[], help="query params to drop from task id")
     ap.add_argument("--recursive", action="store_true",
                     help="treat URL as a directory and mirror it under --output")

@@ -33,18 +33,39 @@ class DaemonRpcAdapter:
         self.engine = engine
 
     async def download(self, p: dict) -> dict:
+        rng_s = p.get("range", "")
         ts = await self.engine.download_task(
             p["url"],
-            output=p.get("output"),
+            output=None if rng_s else p.get("output"),
             tag=p.get("tag", ""),
             application=p.get("application", ""),
             digest=p.get("digest", ""),
             filters=tuple(p.get("filters", ())),
             headers=p.get("headers") or None,
         )
+        if rng_s and p.get("output"):
+            # ranged export from the piece store (ref dfget ranged download;
+            # "start-end" inclusive bytes, HTTP Range semantics)
+            from dragonfly2_tpu.utils.pieces import Range
+
+            start_s, _, end_s = rng_s.partition("-")
+            try:
+                start, end = int(start_s), int(end_s)
+            except ValueError:
+                raise RpcError(f"bad range {rng_s!r}: want START-END", code="bad_request")
+            if start < 0 or end < start or end >= ts.meta.content_length:
+                raise RpcError(
+                    f"range {rng_s} out of bounds for {ts.meta.content_length} bytes",
+                    code="bad_request",
+                )
+            await ts.export_range(p["output"], Range(start, end - start + 1))
+            exported = end - start + 1
+        else:
+            exported = ts.meta.content_length
         return {
             "task_id": ts.meta.task_id,
             "content_length": ts.meta.content_length,
+            "exported_bytes": exported,
             "pieces": ts.finished_count(),
             "done": ts.meta.done,
         }

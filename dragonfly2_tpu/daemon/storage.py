@@ -175,6 +175,36 @@ class TaskStorage:
         finally:
             self.pins -= 1
 
+    async def export_range(self, dest: str | Path, r: Range) -> None:
+        """Stream a byte range of the completed task to a file (the dfget
+        --range path; ref client/dfget ranged download — here served from the
+        piece store so later ranged fetches of a cached task cost nothing)."""
+        dest = Path(dest)
+        dest.parent.mkdir(parents=True, exist_ok=True)
+        # unlink FIRST (as export_to does): dest may be a hard link to this
+        # task's own data file from a prior full export — open("wb") would
+        # truncate the shared inode and zero the cached task in the store
+        dest.unlink(missing_ok=True)
+        self.last_access = time.time()
+        self.pins += 1
+        try:
+            def _copy() -> None:
+                with open(self.data_path, "rb") as src, open(dest, "wb") as out:
+                    src.seek(r.start)
+                    remaining = r.length
+                    while remaining > 0:
+                        chunk = src.read(min(1 << 20, remaining))
+                        if not chunk:
+                            raise IOError(
+                                f"range {r.start}+{r.length} past end of task data"
+                            )
+                        out.write(chunk)
+                        remaining -= len(chunk)
+
+            await asyncio.to_thread(_copy)
+        finally:
+            self.pins -= 1
+
     def pin(self) -> None:
         """Mark a live user (running conductor); pair with unpin()."""
         self.pins += 1

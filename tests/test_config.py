@@ -148,16 +148,11 @@ def test_scheduler_boots_from_yaml(tmp_path):
         env={**os.environ, "JAX_PLATFORMS": "cpu"},
     )
     try:
-        deadline = time.time() + 30
-        booted = False
-        while time.time() < deadline:
-            if proc.poll() is not None:
-                break
+        # give it up to 8s to either crash (bad) or settle into serving (good)
+        deadline = time.time() + 8
+        while time.time() < deadline and proc.poll() is None:
             time.sleep(0.3)
-            booted = True  # still running after grace = boot succeeded
-            if time.time() > deadline - 28:
-                break
-        assert booted and proc.poll() is None, proc.stdout.read() if proc.stdout else ""
+        assert proc.poll() is None, proc.stdout.read() if proc.stdout else ""
     finally:
         proc.send_signal(signal.SIGTERM)
         try:

@@ -16,11 +16,27 @@ from dragonfly2_tpu.utils.pieces import parse_http_range
 
 
 class Origin:
-    """Localhost origin fixture with Range support + request counters."""
+    """Localhost origin fixture with Range support + request counters.
 
-    def __init__(self, files: dict[str, bytes], *, support_range: bool = True):
+    Adversarial knobs (ref test/tools/ fixtures):
+      send_content_length=False — chunked responses with no Content-Length
+        and no HEAD metadata (ref test/tools/no-content-length)
+      corrupt_range_shift=N — Range responses silently serve data shifted by
+        N bytes (right length, wrong bytes): digest validation must catch it
+    """
+
+    def __init__(
+        self,
+        files: dict[str, bytes],
+        *,
+        support_range: bool = True,
+        send_content_length: bool = True,
+        corrupt_range_shift: int = 0,
+    ):
         self.files = files
         self.support_range = support_range
+        self.send_content_length = send_content_length
+        self.corrupt_range_shift = corrupt_range_shift
         self.requests = 0
         self.bytes_sent = 0
         self.port = 0
@@ -44,13 +60,34 @@ class Origin:
         if name not in self.files:
             raise web.HTTPNotFound()
         data = self.files[name]
+        if not self.send_content_length:
+            # ref test/tools/no-content-length: no HEAD metadata, chunked
+            # body, no ranges — the client must stream to EOF
+            if request.method == "HEAD":
+                raise web.HTTPMethodNotAllowed("HEAD", ["GET"])
+            self.requests += 1
+            self.bytes_sent += len(data)
+            resp = web.StreamResponse(headers={"Accept-Ranges": "none"})
+            resp.enable_chunked_encoding()
+            await resp.prepare(request)
+            for i in range(0, len(data), 256 * 1024):
+                await resp.write(data[i : i + 256 * 1024])
+            await resp.write_eof()
+            return resp
         if request.method == "HEAD":  # metadata probe: no payload on the wire
-            return web.Response(headers={"Content-Length": str(len(data))})
+            return web.Response(
+                headers={
+                    "Content-Length": str(len(data)),
+                    "Accept-Ranges": "bytes" if self.support_range else "none",
+                }
+            )
         self.requests += 1
         rng = request.headers.get("Range")
         if rng and self.support_range:
             r = parse_http_range(rng, len(data))
-            body = data[r.start : r.start + r.length]
+            shift = self.corrupt_range_shift
+            src = data[r.start + shift : r.start + shift + r.length]
+            body = src.ljust(r.length, b"\x00")[: r.length]
             self.bytes_sent += len(body)
             return web.Response(
                 status=206,
@@ -326,6 +363,105 @@ class TestE2E:
                 if not dl.done():
                     dl.cancel()
                 await upload.stop()
+
+        run(body())
+
+    def test_no_content_length_origin(self, run, tmp_path):
+        """ref test/tools/no-content-length: chunked origin, no HEAD, no CL —
+        the unknown-length streaming path must still produce a digest-exact
+        copy and later peers must ride P2P off it."""
+
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            data = bytes(range(256)) * 30_000  # ~7.3 MiB
+            async with Origin({"f": data}, send_content_length=False) as origin:
+                e1 = make_engine(tmp_path, client, "p1")
+                e2 = make_engine(tmp_path, client, "p2")
+                await e1.start()
+                await e2.start()
+                try:
+                    url = origin.url("f")
+                    out1 = tmp_path / "ncl1.bin"
+                    await e1.download_task(url, output=out1)
+                    assert out1.read_bytes() == data
+                    n = origin.requests
+                    out2 = tmp_path / "ncl2.bin"
+                    await e2.download_task(url, output=out2)
+                    assert out2.read_bytes() == data
+                    assert origin.requests == n  # peer2 rode P2P
+                finally:
+                    await e1.stop()
+                    await e2.stop()
+
+        run(body())
+
+    def test_corrupt_range_origin_rejected(self, run, tmp_path, payload):
+        """Adversarial origin: Range responses shifted one byte (right
+        length, wrong bytes). With a task digest the download must FAIL
+        loudly, and the poisoned copy must not be marked done/reusable."""
+
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            digest = "sha256:" + hashlib.sha256(payload).hexdigest()
+            async with Origin({"f": payload}, corrupt_range_shift=1) as origin:
+                e1 = make_engine(tmp_path, client, "p1")
+                await e1.start()
+                try:
+                    with pytest.raises(Exception) as ei:
+                        await e1.download_task(origin.url("f"), digest=digest)
+                    assert "digest" in str(ei.value).lower()
+                    meta = e1.make_meta(origin.url("f"), digest=digest)
+                    assert e1.storage.find_completed_task(meta.task_id) is None
+                finally:
+                    await e1.stop()
+
+        run(body())
+
+    def test_parent_kill_mid_task_reschedules(self, run, tmp_path, payload):
+        """Mid-download parent death: child must reschedule and finish via
+        back-to-source with a byte-exact result (ref reschedule path,
+        service_v1.go:1033-1151)."""
+
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            async with Origin({"f": payload}) as origin:
+                url = origin.url("f")
+                parent = make_engine(tmp_path, client, "parent")
+                await parent.start()
+                # throttle the child so the parent dies MID-task
+                child = make_engine(
+                    tmp_path, client, "child", total_download_rate_bps=4e6
+                )
+                await child.start()
+                try:
+                    await parent.download_task(url)  # parent holds all pieces
+                    task = asyncio.ensure_future(
+                        child.download_task(url, output=tmp_path / "ck.bin")
+                    )
+                    # wait until the child has SOME bytes but not all
+                    deadline = asyncio.get_running_loop().time() + 10
+                    while asyncio.get_running_loop().time() < deadline:
+                        cts = child.storage.get(
+                            child.make_meta(url).task_id
+                        )
+                        if cts is not None and 0 < cts.finished_count() < 3:
+                            break
+                        await asyncio.sleep(0.02)
+                    # kill the parent mid-task: upload server gone + scheduler
+                    # told the host left (the keepalive-loss path)
+                    await parent.upload.stop()
+                    svc.leave_host(parent.host_id)
+                    ts = await asyncio.wait_for(task, 60)
+                    assert ts.is_complete()
+                    assert (tmp_path / "ck.bin").read_bytes() == payload
+                    # the finish came from origin (back-to-source), not the corpse
+                    assert origin.bytes_sent > len(payload)
+                finally:
+                    await parent.stop()
+                    await child.stop()
 
         run(body())
 

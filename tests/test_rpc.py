@@ -224,6 +224,75 @@ class TestMultiProcess:
                 except subprocess.TimeoutExpired:
                     p.kill()
 
+    def test_cluster_download_100mib_and_range(self, tmp_path):
+        """Scale E2E (VERDICT r3 #8): a 100 MiB, 25-piece payload through the
+        multi-process cluster — peer1 back-to-source, peer2 via P2P, sha256
+        parity — plus a ranged dfget whose output matches the source slice
+        (the reference's sha256sum-offset verification, test/tools/)."""
+        env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+        payload = os.urandom(1 << 20) * 100  # 100 MiB, incompressible head
+        origin_file = tmp_path / "big.bin"
+        origin_file.write_bytes(payload)
+        url = f"file://{origin_file}"
+        procs = []
+        try:
+            sched = subprocess.Popen(
+                [sys.executable, "-m", "dragonfly2_tpu.scheduler.server", "--port", "0"],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+            )
+            procs.append(sched)
+            line = sched.stdout.readline()
+            assert line.startswith("SCHEDULER_READY"), line
+            sched_addr = line.split()[1]
+
+            socks = []
+            for name in ["big1", "big2"]:
+                sock = str(tmp_path / f"{name}.sock")
+                socks.append(sock)
+                d = subprocess.Popen(
+                    [sys.executable, "-m", "dragonfly2_tpu.daemon.server",
+                     "--scheduler", sched_addr, "--sock", sock,
+                     "--storage", str(tmp_path / f"store_{name}"),
+                     "--hostname", name],
+                    stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
+                )
+                procs.append(d)
+                assert d.stdout.readline().startswith("DAEMON_READY")
+
+            def dfget(sock, out, *extra):
+                return subprocess.run(
+                    [sys.executable, "-m", "dragonfly2_tpu.cli.dfget", url,
+                     "-O", str(out), "--sock", sock, "--no-spawn",
+                     "--scheduler", sched_addr, *extra],
+                    capture_output=True, text=True, env=env, timeout=300,
+                )
+
+            want = hashlib.sha256(payload).hexdigest()
+            r1 = dfget(socks[0], tmp_path / "big_out1.bin")
+            assert r1.returncode == 0, r1.stderr
+            assert "25 pieces" in r1.stdout, r1.stdout  # genuinely multi-piece
+            r2 = dfget(socks[1], tmp_path / "big_out2.bin")
+            assert r2.returncode == 0, r2.stderr
+            for out in ["big_out1.bin", "big_out2.bin"]:
+                got = hashlib.sha256((tmp_path / out).read_bytes()).hexdigest()
+                assert got == want, out
+
+            # ranged export from the cached task: sha256 of the output must
+            # equal sha256 of the source slice (sha256sum-offset shape)
+            start, end = 5_000_000, 12_345_678
+            r3 = dfget(socks[1], tmp_path / "slice.bin", "--range", f"{start}-{end}")
+            assert r3.returncode == 0, r3.stderr
+            got = hashlib.sha256((tmp_path / "slice.bin").read_bytes()).hexdigest()
+            assert got == hashlib.sha256(payload[start : end + 1]).hexdigest()
+        finally:
+            for p in procs:
+                p.send_signal(signal.SIGTERM)
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
     def test_recursive_download(self, tmp_path):
         """dfget --recursive mirrors an HTTP auto-index tree with per-file
         sha256 parity (ref test/e2e/dfget_test.go:203-221 recursive case)."""
