@@ -33,12 +33,15 @@ class ManagerRest:
         *,
         auth_secret: str | None = None,
         ca=None,
+        object_storage=None,
     ):
         self.svc = service
         self.jobs = jobs
         self.preheat = PreheatProducer(jobs)
         self.auth_secret = auth_secret  # None → open (dev mode), like ref --disable-auth
         self.ca = ca  # security.ca.CertificateAuthority | None
+        self.object_storage = object_storage  # objectstorage.ObjectStorageBackend | None
+        self._oauth_state_store = None
         from dragonfly2_tpu.security.rbac import Rbac
 
         self.rbac = Rbac()
@@ -46,10 +49,16 @@ class ManagerRest:
     # ---- auth middleware (ref manager/middlewares/jwt.go + permission) ----
 
     _OPEN_PATHS = ("/healthz", "/api/v1/users/signin")
+    # the oauth redirect/callback legs are browser-driven and pre-auth
+    _OPEN_PREFIXES = ("/api/v1/users/signin/oauth/",)
 
     @web.middleware
     async def _auth_middleware(self, req: web.Request, handler):
-        if self.auth_secret is None or req.path in self._OPEN_PATHS:
+        if (
+            self.auth_secret is None
+            or req.path in self._OPEN_PATHS
+            or req.path.startswith(self._OPEN_PREFIXES)
+        ):
             return await handler(req)
         from dragonfly2_tpu.security.tokens import TokenError, verify_token
 
@@ -103,6 +112,19 @@ class ManagerRest:
         # jobs (preheat)
         r.add_post("/api/v1/jobs", self.create_job)
         r.add_get(r"/api/v1/jobs/{id:\d+}", self.get_job)
+        # oauth providers + code-flow sign-in (ref handlers/oauth.go)
+        r.add_get("/api/v1/oauth", self.list_oauth)
+        r.add_post("/api/v1/oauth", self.create_oauth)
+        r.add_get(r"/api/v1/oauth/{id:\d+}", self.get_oauth)
+        r.add_patch(r"/api/v1/oauth/{id:\d+}", self.update_oauth)
+        r.add_delete(r"/api/v1/oauth/{id:\d+}", self.delete_oauth)
+        r.add_get("/api/v1/users/signin/oauth/{name}", self.oauth_signin)
+        r.add_get("/api/v1/users/signin/oauth/{name}/callback", self.oauth_callback)
+        # buckets fronting the object storage backend (ref handlers/bucket.go)
+        r.add_get("/api/v1/buckets", self.list_buckets)
+        r.add_post("/api/v1/buckets", self.create_bucket)
+        r.add_get("/api/v1/buckets/{name}", self.get_bucket)
+        r.add_delete("/api/v1/buckets/{name}", self.delete_bucket)
         return app
 
     # ---- users + certificates ----
@@ -287,6 +309,136 @@ class ManagerRest:
             return _json({"error": str(e)}, status=400)
         return _json(job, status=201)
 
+    # ---- oauth providers + code-flow sign-in (ref handlers/oauth.go) ----
+
+    async def list_oauth(self, req: web.Request) -> web.Response:
+        return _json(self.svc.list_oauth())
+
+    async def create_oauth(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        name = body.pop("name", "")
+        if not name:
+            return _json({"error": "name required"}, status=400)
+        try:
+            return _json(self.svc.create_oauth(name, **body), status=201)
+        except ValueError as e:
+            return _json({"error": str(e)}, status=400)
+
+    async def get_oauth(self, req: web.Request) -> web.Response:
+        row = self.svc.get_oauth(int(req.match_info["id"]))
+        return _json(row) if row else _json({"error": "not found"}, status=404)
+
+    async def update_oauth(self, req: web.Request) -> web.Response:
+        body = await req.json()
+        try:
+            row = self.svc.update_oauth(int(req.match_info["id"]), **body)
+        except ValueError as e:
+            return _json({"error": str(e)}, status=400)
+        return _json(row) if row else _json({"error": "not found"}, status=404)
+
+    async def delete_oauth(self, req: web.Request) -> web.Response:
+        ok = self.svc.delete_oauth(int(req.match_info["id"]))
+        return _json({"ok": ok}, status=200 if ok else 404)
+
+    @property
+    def _oauth_states(self):
+        if getattr(self, "_oauth_state_store", None) is None:
+            import os as _os
+
+            from dragonfly2_tpu.manager.oauth import StateStore
+
+            # random per-process secret when auth is off: states stay
+            # unforgeable either way, and they are single-use in-memory
+            self._oauth_state_store = StateStore(self.auth_secret or _os.urandom(16).hex())
+        return self._oauth_state_store
+
+    async def oauth_signin(self, req: web.Request) -> web.Response:
+        from dragonfly2_tpu.manager import oauth as oauthlib
+
+        name = req.match_info["name"]
+        provider = self.svc.get_oauth_by_name(name, with_secret=True)
+        if provider is None:
+            return _json({"error": "unknown oauth provider"}, status=404)
+        state = self._oauth_states.mint(name)
+        raise web.HTTPFound(oauthlib.authorize_url(provider, state))
+
+    async def oauth_callback(self, req: web.Request) -> web.Response:
+        from dragonfly2_tpu.manager import oauth as oauthlib
+
+        name = req.match_info["name"]
+        provider = self.svc.get_oauth_by_name(name, with_secret=True)
+        if provider is None:
+            return _json({"error": "unknown oauth provider"}, status=404)
+        code = req.query.get("code", "")
+        state = req.query.get("state", "")
+        if not code:
+            return _json({"error": "missing code"}, status=400)
+        if not self._oauth_states.consume(state, name):
+            return _json({"error": "bad, expired, or replayed state"}, status=401)
+        try:
+            token = await oauthlib.exchange_code(provider, code)
+            ident = await oauthlib.fetch_identity(provider, token)
+        except oauthlib.OauthError as e:
+            return _json({"error": str(e)}, status=502)
+        try:
+            user = self.svc.upsert_oauth_user(name, ident["name"], email=ident["email"])
+        except ValueError as e:
+            return _json({"error": str(e)}, status=403)
+        if self.auth_secret is None:
+            return _json({"user": user, "token": ""})
+        from dragonfly2_tpu.security.tokens import sign_token
+
+        jwt = sign_token({"sub": user["name"], "role": user["role"]}, self.auth_secret)
+        return _json({"user": user, "token": jwt})
+
+    # ---- buckets fronting object storage (ref handlers/bucket.go) ----
+
+    def _buckets_backend(self):
+        if self.object_storage is None:
+            raise web.HTTPServiceUnavailable(
+                text='{"error": "object storage not configured"}',
+                content_type="application/json",
+            )
+        return self.object_storage
+
+    async def list_buckets(self, req: web.Request) -> web.Response:
+        backend = self._buckets_backend()
+        rows = await backend.list_buckets()
+        return _json([{"name": b.name, "created_at": b.created_at} for b in rows])
+
+    async def create_bucket(self, req: web.Request) -> web.Response:
+        from dragonfly2_tpu.objectstorage.backend import ObjectStorageError
+
+        backend = self._buckets_backend()
+        body = await req.json()
+        name = body.get("name", "")
+        if not name:
+            return _json({"error": "name required"}, status=400)
+        try:
+            await backend.create_bucket(name)
+        except ObjectStorageError as e:
+            status = 400 if e.code == "invalid" else 409
+            return _json({"error": str(e)}, status=status)
+        return _json({"name": name}, status=201)
+
+    async def get_bucket(self, req: web.Request) -> web.Response:
+        backend = self._buckets_backend()
+        name = req.match_info["name"]
+        if not await backend.bucket_exists(name):
+            return _json({"error": "not found"}, status=404)
+        return _json({"name": name})
+
+    async def delete_bucket(self, req: web.Request) -> web.Response:
+        from dragonfly2_tpu.objectstorage.backend import ObjectStorageError
+
+        backend = self._buckets_backend()
+        try:
+            await backend.delete_bucket(req.match_info["name"])
+        except ObjectStorageError as e:
+            status = {"not_found": 404, "invalid": 400}.get(e.code, 409)
+            return _json({"error": str(e)}, status=status)
+        return _json({"ok": True})
+
     async def get_job(self, req: web.Request) -> web.Response:
         row = self.jobs.state(int(req.match_info["id"]))
         return _json(row) if row else _json({"error": "not found"}, status=404)
@@ -300,9 +452,13 @@ async def start_rest(
     port: int = 0,
     auth_secret: str | None = None,
     ca=None,
+    object_storage=None,
 ) -> tuple[web.AppRunner, int]:
     runner = web.AppRunner(
-        ManagerRest(service, jobs, auth_secret=auth_secret, ca=ca).app(), access_log=None
+        ManagerRest(
+            service, jobs, auth_secret=auth_secret, ca=ca, object_storage=object_storage
+        ).app(),
+        access_log=None,
     )
     await runner.setup()
     site = web.TCPSite(runner, host, port)

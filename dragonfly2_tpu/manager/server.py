@@ -37,6 +37,7 @@ class ManagerServer:
         cert_token: str | None = None,
         auth_secret: str | None = None,
         admin_password: str | None = None,
+        object_storage_dir: str | None = None,
     ):
         self.db = Database(db_path)
         self.service = ManagerService(self.db, keepalive_ttl=keepalive_ttl)
@@ -47,6 +48,11 @@ class ManagerServer:
 
             self.ca = CertificateAuthority(ca_dir)
         self.auth_secret = auth_secret
+        self.object_storage = None
+        if object_storage_dir:
+            from dragonfly2_tpu.objectstorage.backend import LocalFSBackend
+
+            self.object_storage = LocalFSBackend(object_storage_dir)
         if admin_password and not self.db.find("users", name="admin"):
             self.service.create_user("admin", admin_password, role="admin")
             logger.info("bootstrapped admin user")
@@ -73,6 +79,7 @@ class ManagerServer:
             self._rest_runner, self.rest_port = await start_rest(
                 self.service, self.jobs, host=self.rpc.host, port=self.rest_port,
                 auth_secret=self.auth_secret, ca=self.ca,
+                object_storage=self.object_storage,
             )
         if self.metrics_port is not None:
             from dragonfly2_tpu.observability.server import start_debug_server
@@ -111,6 +118,7 @@ async def amain(args: argparse.Namespace) -> None:
         metrics_port=args.metrics_port, keepalive_ttl=args.keepalive_ttl,
         ca_dir=args.ca_dir, cert_token=args.cert_token,
         auth_secret=args.auth_secret, admin_password=args.admin_password,
+        object_storage_dir=args.object_storage_dir,
     )
     await server.start()
     print(f"manager ready rpc={server.address} rest={server.rest_port}", flush=True)
@@ -150,6 +158,8 @@ def main() -> None:
     p.add_argument("--admin-password",
                    default=cfg.security.admin_password or os.environ.get("DRAGONFLY_ADMIN_PASSWORD"),
                    help="bootstrap the admin user on first start")
+    p.add_argument("--object-storage-dir", default=cfg.object_storage_dir,
+                   help="enable buckets CRUD backed by this fs dir")
     p.add_argument("--keepalive-ttl", type=float, default=cfg.keepalive_ttl)
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args()

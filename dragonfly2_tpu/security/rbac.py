@@ -13,16 +13,20 @@ WRITE = "write"
 # resource groups mirror the manager REST surface
 _RESOURCES = (
     "scheduler-clusters", "schedulers", "seed-peers", "applications",
-    "configs", "models", "jobs", "users", "certificates",
+    "configs", "models", "jobs", "users", "certificates", "oauth", "buckets",
 )
 
 ROLES: dict[str, dict[str, set[str]]] = {
     "admin": {r: {READ, WRITE} for r in _RESOURCES},
     "operator": {
-        **{r: {READ, WRITE} for r in ("applications", "configs", "models", "jobs")},
+        **{r: {READ, WRITE} for r in ("applications", "configs", "models", "jobs", "buckets")},
         **{r: {READ} for r in ("scheduler-clusters", "schedulers", "seed-peers")},
     },
-    "guest": {r: {READ} for r in _RESOURCES if r not in ("users", "certificates")},
+    "guest": {
+        r: {READ}
+        for r in _RESOURCES
+        if r not in ("users", "certificates", "oauth")
+    },
 }
 
 
