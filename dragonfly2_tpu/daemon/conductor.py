@@ -152,10 +152,15 @@ class PeerTaskConductor:
         headers: dict[str, str] | None = None,
         shaper=None,
     ):
+        from dragonfly2_tpu.utils.dflog import with_context
+
         self.peer_id = peer_id
         self.meta = meta
         self.host = host
         self.scheduler = scheduler
+        # every line this conductor logs carries its task+peer ids
+        # (ref dflog WithPeer/WithTask structured context)
+        self.log = with_context(logger, task_id=meta.task_id, peer_id=peer_id)
         self.storage = storage
         self.sources = sources
         self.headers = headers or None  # origin request headers (auth etc.)
@@ -410,7 +415,7 @@ class PeerTaskConductor:
                         if time.monotonic() - last_update < self.cfg.no_progress_reschedule:
                             continue
                     if reschedules >= self.cfg.reschedule_limit:
-                        logger.info(
+                        self.log.info(
                             "peer %s: cutover to back-to-source for %d pieces",
                             self.peer_id, len(missing),
                         )
@@ -470,7 +475,7 @@ class PeerTaskConductor:
                 if pid not in current:
                     t.cancel()
                 elif not t.cancelled() and t.exception() is not None:
-                    logger.warning("parent %s sync loop died: %r", pid, t.exception())
+                    self.log.warning("parent %s sync loop died: %r", pid, t.exception())
                 del self._sync_tasks[pid]
         for state in self.dispatcher.usable():
             if state.info.peer_id not in self._sync_tasks:
@@ -516,7 +521,7 @@ class PeerTaskConductor:
                 # off, never kill the sync loop silently
                 state.record(False, 0)
                 self._update_event.set()
-                logger.debug("parent %s metadata sync error: %r", state.info.peer_id, e)
+                self.log.debug("parent %s metadata sync error: %r", state.info.peer_id, e)
                 await asyncio.sleep(0.5)
                 continue
             self._update_event.set()
@@ -528,7 +533,7 @@ class PeerTaskConductor:
                 if not self.ts.has_piece(idx):
                     await self._download_one_piece(session, idx)
             except Exception:
-                logger.debug("piece %d failed", idx, exc_info=True)
+                self.log.debug("piece %d failed", idx, exc_info=True)
             finally:
                 queue.task_done()
 
@@ -559,7 +564,7 @@ class PeerTaskConductor:
             await self.scheduler.report_piece_result(
                 self.peer_id, idx, success=False, cost_ms=cost, parent_id=state.info.peer_id
             )
-            logger.debug("piece %d from %s failed: %s", idx, state.info.peer_id, e)
+            self.log.debug("piece %d from %s failed: %s", idx, state.info.peer_id, e)
             return
         cost = (time.monotonic() - t0) * 1000
         expected = self._piece_digests.get(str(idx), "")
@@ -570,7 +575,7 @@ class PeerTaskConductor:
             await self.scheduler.report_piece_result(
                 self.peer_id, idx, success=False, cost_ms=cost, parent_id=state.info.peer_id
             )
-            logger.warning("piece %d from %s corrupt: %s", idx, state.info.peer_id, e)
+            self.log.warning("piece %d from %s corrupt: %s", idx, state.info.peer_id, e)
             return
         state.record(True, cost)
         self.bytes_from_parents += len(data)
@@ -600,4 +605,4 @@ class PeerTaskConductor:
                 self.peer_id, success=success, bandwidth_bps=bw
             )
         except Exception:
-            logger.exception("report_peer_result failed for %s", self.peer_id)
+            self.log.exception("report_peer_result failed for %s", self.peer_id)

@@ -60,6 +60,7 @@ class DaemonYaml:
     rpc_port: Optional[int] = cfgfield(None, minimum=0, maximum=65535)
     metrics_port: Optional[int] = cfgfield(None, minimum=0, maximum=65535)
     probe_interval: Optional[float] = cfgfield(None, minimum=0.1)
+    log_dir: Optional[str] = cfgfield(None, help="rotating per-component log dir")
     storage: StorageSection = cfgfield(default_factory=StorageSection)
     proxy: ProxySection = cfgfield(default_factory=ProxySection)
     object_storage: ObjectStorageSection = cfgfield(default_factory=ObjectStorageSection)

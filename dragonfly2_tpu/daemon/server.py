@@ -446,6 +446,8 @@ def main() -> None:
     ap.add_argument("--disk-gc-threshold-pct", type=float,
                     default=cfg.storage.disk_gc_threshold_pct,
                     help="evict LRU complete tasks when disk usage passes this percent")
+    ap.add_argument("--log-dir", default=cfg.log_dir,
+                    help="per-component rotating log files (console only when unset)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
     if args.object_storage_backend == "s3":
@@ -453,10 +455,9 @@ def main() -> None:
             ap.error("--object-storage-root applies to the fs backend only")
         if not (os.environ.get("AWS_ENDPOINT_URL") or os.environ.get("DF_S3_ENDPOINT")):
             ap.error("--object-storage-backend s3 requires AWS_ENDPOINT_URL in the environment")
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
+    from dragonfly2_tpu.utils.dflog import setup_logging
+
+    setup_logging(args.log_dir, level=logging.DEBUG if args.verbose else logging.INFO)
     asyncio.run(
         run_daemon(
             scheduler_addr=args.scheduler,

@@ -367,6 +367,23 @@ class SourceRegistry:
         self.register("s3", S3SourceClient())
         self.register("oss", OSSSourceClient())
         self.register("oras", ORASSourceClient())
+        self._register_plugins()
+
+    def _register_plugins(self) -> None:
+        """External protocol clients by import path (ref pkg/source/loader +
+        internal/dfplugin): DRAGONFLY_SOURCE_PLUGINS="scheme=pkg.mod:factory,…"
+        — each factory yields a ResourceClient for its scheme. A bad spec
+        fails the daemon at boot, not on first download."""
+        raw = os.environ.get("DRAGONFLY_SOURCE_PLUGINS", "")
+        if not raw:
+            return
+        from dragonfly2_tpu.utils.plugins import load_object, parse_plugin_map, require_methods
+
+        for scheme, spec in parse_plugin_map(raw).items():
+            client = load_object(spec)
+            require_methods(client, ("info", "download", "close"), spec=spec, kind="source")
+            # urlsplit lowercases schemes, so the registry key must match
+            self.register(scheme.lower(), client)
 
     def register(self, scheme: str, client: ResourceClient) -> None:
         self._clients[scheme] = client
@@ -388,7 +405,11 @@ class SourceRegistry:
             yield chunk
 
     async def list_entries(self, url: str, headers: dict | None = None) -> list[URLEntry]:
-        return await self.client_for(url).list_entries(url, headers)
+        client = self.client_for(url)
+        lister = getattr(client, "list_entries", None)
+        if lister is None:  # duck-typed plugin without listing support
+            raise SourceError(f"scheme does not support listing: {url}")
+        return await lister(url, headers)
 
     async def close(self) -> None:
         seen = set()

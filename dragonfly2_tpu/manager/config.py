@@ -29,6 +29,7 @@ class ManagerYaml:
     rest_port: int = cfgfield(9201, minimum=0, maximum=65535)
     metrics_port: Optional[int] = cfgfield(None, minimum=0, maximum=65535)
     keepalive_ttl: float = cfgfield(60.0, minimum=1.0)
+    log_dir: Optional[str] = cfgfield(None, help="rotating per-component log dir")
     object_storage_dir: Optional[str] = cfgfield(
         None, help="enable buckets CRUD backed by this fs dir"
     )

@@ -161,9 +161,13 @@ def main() -> None:
     p.add_argument("--object-storage-dir", default=cfg.object_storage_dir,
                    help="enable buckets CRUD backed by this fs dir")
     p.add_argument("--keepalive-ttl", type=float, default=cfg.keepalive_ttl)
+    p.add_argument("--log-dir", default=cfg.log_dir,
+                   help="per-component rotating log files (console only when unset)")
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args()
-    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+    from dragonfly2_tpu.utils.dflog import setup_logging
+
+    setup_logging(args.log_dir, level=logging.DEBUG if args.verbose else logging.INFO)
     asyncio.run(amain(args))
 
 

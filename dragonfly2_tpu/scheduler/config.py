@@ -42,14 +42,24 @@ class SchedulerYaml:
     hostname: str = cfgfield("")
     idc: str = cfgfield("")
     location: str = cfgfield("")
-    evaluator: str = cfgfield("base", choices=("base", "ml"))
+    evaluator: str = cfgfield("base", help='"base", "ml", or "plugin:pkg.mod:attr"')
     telemetry_dir: Optional[str] = cfgfield(None)
+    log_dir: Optional[str] = cfgfield(None, help="rotating per-component log dir")
     metrics_port: Optional[int] = cfgfield(None, minimum=0, maximum=65535)
     manager: Optional[str] = cfgfield(None, help="manager address host:port")
     trainer: Optional[str] = cfgfield(None, help="trainer address host:port")
     trainer_interval: Optional[float] = cfgfield(None, minimum=1.0)
     scheduling: SchedulingSection = cfgfield(default_factory=SchedulingSection)
     gc: GCSection = cfgfield(default_factory=GCSection)
+
+    def validate_extra(self, path: str) -> None:
+        from dragonfly2_tpu.utils.config import ConfigError
+
+        if self.evaluator not in ("base", "ml") and not self.evaluator.startswith("plugin:"):
+            raise ConfigError(
+                f"{path}.evaluator" if path else "evaluator",
+                f"{self.evaluator!r} not 'base', 'ml', or 'plugin:pkg.mod:attr'",
+            )
 
     def scheduling_config(self):
         from dragonfly2_tpu.scheduler.scheduling import SchedulingConfig

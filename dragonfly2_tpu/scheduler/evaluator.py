@@ -203,13 +203,39 @@ class MLEvaluator(Evaluator):
 
 
 def new_evaluator(algorithm: str = "base", **kw) -> Evaluator:
-    """Factory (ref evaluator.go:35-54): "base" | "ml"; unknown → base.
+    """Factory (ref evaluator.go:35-54): "base" | "ml" |
+    "plugin:pkg.mod:attr"; unknown → base.
 
     "ml" without a scorer starts in base-fallback mode and upgrades when
     attach_scorer() is called (the scheduler boots before any model exists).
+    "plugin:" loads an external evaluator by import path (the reference's
+    dlopen plugin slot, evaluator/plugin.go:1-39) and duck-checks its
+    interface at boot.
     """
     if algorithm == "ml":
         return MLEvaluator(kw.get("scorer"), kw.get("node_index"))
+    if algorithm.startswith("plugin:"):
+        from dragonfly2_tpu.utils.plugins import load_object, require_methods
+
+        spec = algorithm[len("plugin:"):]
+        obj = load_object(spec, **kw)
+        require_methods(obj, ("evaluate", "is_bad_node"), spec=spec, kind="evaluator")
+        if not callable(getattr(obj, "evaluate_async", None)):
+            # the async scheduling path calls evaluate_async; plugins that
+            # only implement the sync pair get a delegating shim so they
+            # still fail (or work) at boot, never mid-round
+            class _SyncPluginShim:
+                def __init__(self, inner):
+                    self._inner = inner
+
+                def __getattr__(self, name):
+                    return getattr(self._inner, name)
+
+                async def evaluate_async(self, child, parents):
+                    return self._inner.evaluate(child, parents)
+
+            obj = _SyncPluginShim(obj)
+        return obj
     if algorithm != "base":
         logger.warning("unknown evaluator %r, using base", algorithm)
     return Evaluator()

@@ -144,7 +144,8 @@ def main() -> None:
     ap.add_argument("--port", type=int, default=cfg.port)
     ap.add_argument("--telemetry-dir", default=cfg.telemetry_dir)
     ap.add_argument("--metrics-port", type=int, default=cfg.metrics_port)
-    ap.add_argument("--evaluator", default=cfg.evaluator, choices=["base", "ml"])
+    ap.add_argument("--evaluator", default=cfg.evaluator,
+                    help='"base", "ml", or "plugin:pkg.mod:attr"')
     ap.add_argument("--manager", default=cfg.manager, help="manager address host:port")
     ap.add_argument("--trainer", default=cfg.trainer, help="trainer address host:port")
     ap.add_argument("--trainer-interval", type=float, default=cfg.trainer_interval,
@@ -152,12 +153,15 @@ def main() -> None:
     ap.add_argument("--hostname", default=cfg.hostname)
     ap.add_argument("--idc", default=cfg.idc)
     ap.add_argument("--location", default=cfg.location)
+    ap.add_argument("--log-dir", default=cfg.log_dir,
+                    help="per-component rotating log files (console only when unset)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
-    logging.basicConfig(
-        level=logging.DEBUG if args.verbose else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
-    )
+    if args.evaluator not in ("base", "ml") and not args.evaluator.startswith("plugin:"):
+        ap.error(f"--evaluator {args.evaluator!r}: want 'base', 'ml', or 'plugin:pkg.mod:attr'")
+    from dragonfly2_tpu.utils.dflog import setup_logging
+
+    setup_logging(args.log_dir, level=logging.DEBUG if args.verbose else logging.INFO)
     asyncio.run(
         run_scheduler(
             host=args.host,

@@ -1,0 +1,169 @@
+"""Plugin loading (utils/plugins.py; ref internal/dfplugin + evaluator
+plugin.go) and rotating structured logging (utils/dflog.py; ref internal/dflog)."""
+
+import logging
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.utils.dflog import setup_logging, with_context
+from dragonfly2_tpu.utils.plugins import (
+    PluginError,
+    load_object,
+    parse_plugin_map,
+    require_methods,
+)
+
+# ---- a real plugin module for the loader to find (this test module!) ----
+
+
+class PluginEvaluator:
+    """Minimal custom evaluator: scores by parent host port (deterministic)."""
+
+    name = "port-affinity"
+    topology = None
+    bandwidth = None
+
+    def evaluate(self, child, parents):
+        return np.array([p.host.download_port % 97 for p in parents], np.float32)
+
+    async def evaluate_async(self, child, parents):
+        return self.evaluate(child, parents)
+
+    def is_bad_node(self, peer):
+        return False
+
+
+def make_evaluator():
+    return PluginEvaluator()
+
+
+def test_load_object_and_interface_check():
+    obj = load_object("tests.test_plugins_dflog:make_evaluator")
+    # NB: identity check by name — pytest and importlib may hold separate
+    # module objects for this file, so isinstance() across them is false
+    assert type(obj).__name__ == "PluginEvaluator" and obj.name == "port-affinity"
+    require_methods(obj, ("evaluate", "is_bad_node"), spec="x", kind="evaluator")
+    with pytest.raises(PluginError, match="lacks required"):
+        require_methods(object(), ("evaluate",), spec="x", kind="evaluator")
+    with pytest.raises(PluginError, match="not importable"):
+        load_object("no.such.module:thing")
+    with pytest.raises(PluginError, match="no attribute"):
+        load_object("tests.test_plugins_dflog:nope")
+    with pytest.raises(PluginError, match="bad plugin spec"):
+        load_object("justamodule")
+
+
+def test_parse_plugin_map():
+    m = parse_plugin_map("myproto=pkg.mod:f, other=a.b:c")
+    assert m == {"myproto": "pkg.mod:f", "other": "a.b:c"}
+    with pytest.raises(PluginError):
+        parse_plugin_map("missing-equals")
+
+
+def test_evaluator_plugin_slot_end_to_end():
+    """new_evaluator("plugin:...") loads the external evaluator and the
+    scheduling round actually uses its scores."""
+    from dragonfly2_tpu.scheduler.evaluator import new_evaluator
+    from dragonfly2_tpu.scheduler.scheduling import Scheduling
+    from tests.test_scheduler import add_running_peer, make_pool_with_task
+
+    ev = new_evaluator("plugin:tests.test_plugins_dflog:make_evaluator")
+    assert type(ev).__name__ == "PluginEvaluator"
+    pool, task, hosts = make_pool_with_task(6)
+    child = add_running_peer(pool, task, hosts[0])
+    peers = [add_running_peer(pool, task, h, pieces=2) for h in hosts[1:]]
+    s = Scheduling(ev)
+    parents = s.find_candidate_parents(child)
+    # plugin scores by port (8000+i): highest port wins
+    assert parents[0].id == peers[-1].id
+    # bad spec fails loudly at factory time
+    with pytest.raises(PluginError):
+        new_evaluator("plugin:tests.test_plugins_dflog:PluginError")
+
+
+def test_source_plugin_registration(monkeypatch):
+    """DRAGONFLY_SOURCE_PLUGINS registers an external protocol client."""
+    from dragonfly2_tpu.daemon.source import SourceRegistry
+
+    monkeypatch.setenv(
+        "DRAGONFLY_SOURCE_PLUGINS", "exo=tests.test_plugins_dflog:make_source"
+    )
+    reg = SourceRegistry()
+    assert reg.client_for("exo://thing").scheme == "exo"
+    monkeypatch.setenv("DRAGONFLY_SOURCE_PLUGINS", "exo=tests.test_plugins_dflog:nope")
+    with pytest.raises(PluginError):
+        SourceRegistry()
+
+
+def make_source():
+    from dragonfly2_tpu.daemon.source import ResourceClient
+
+    class ExoClient(ResourceClient):
+        scheme = "exo"
+
+    return ExoClient()
+
+
+# ---- dflog ----
+
+
+def test_per_component_rotating_files(tmp_path):
+    handlers = setup_logging(tmp_path, level=logging.DEBUG, max_bytes=500, backups=2)
+    try:
+        logging.getLogger("dragonfly2_tpu.scheduler.service").info("sched line")
+        logging.getLogger("dragonfly2_tpu.daemon.storage").info("storage line")
+        logging.getLogger("dragonfly2_tpu.rpc.core").info("rpc line")
+        logging.getLogger("something.else").info("core line")
+        for h in handlers:
+            h.flush()
+        assert "sched line" in (tmp_path / "scheduler.log").read_text()
+        assert "storage line" in (tmp_path / "storage.log").read_text()
+        assert "rpc line" in (tmp_path / "rpc.log").read_text()
+        assert "core line" in (tmp_path / "core.log").read_text()
+        # routing is exclusive: the scheduler line is nowhere else
+        assert "sched line" not in (tmp_path / "core.log").read_text()
+        # storage beats the shorter daemon prefix
+        assert "storage line" not in (tmp_path / "daemon.log").read_text()
+
+        # rotation: blow past max_bytes and expect backups
+        lg = logging.getLogger("dragonfly2_tpu.rpc.core")
+        for i in range(100):
+            lg.info("filler %04d xxxxxxxxxxxxxxxxxxxxxxxxxxxxx", i)
+        for h in handlers:
+            h.flush()
+        assert (tmp_path / "rpc.log.1").exists()
+    finally:
+        for h in handlers:
+            logging.getLogger().removeHandler(h)
+            h.close()
+
+
+def test_with_context_stamps_ids(tmp_path):
+    handlers = setup_logging(tmp_path, level=logging.INFO)
+    try:
+        base = logging.getLogger("dragonfly2_tpu.daemon.conductor_test")
+        log = with_context(base, task_id="a" * 64, peer_id="p1")
+        log.info("piece %d done", 3)
+        for h in handlers:
+            h.flush()
+        text = (tmp_path / "daemon.log").read_text()
+        # long ids are shortened; message formatting still works
+        assert f"[task_id={'a' * 16} peer_id=p1] piece 3 done" in text
+    finally:
+        for h in handlers:
+            logging.getLogger().removeHandler(h)
+            h.close()
+
+
+def test_setup_logging_idempotent(tmp_path):
+    h1 = setup_logging(tmp_path)
+    h2 = setup_logging(tmp_path)  # replaces, not duplicates
+    try:
+        root = logging.getLogger()
+        dflog_handlers = [h for h in root.handlers if getattr(h, "_dflog", False)]
+        assert len(dflog_handlers) == len(h2)
+    finally:
+        for h in h2:
+            logging.getLogger().removeHandler(h)
+            h.close()
