@@ -357,6 +357,7 @@ class SourceRegistry:
     """Scheme -> client registry (ref pkg/source register/loader)."""
 
     def __init__(self, *, http_ssl=None) -> None:
+        from dragonfly2_tpu.daemon.hdfs_source import HDFSSourceClient
         from dragonfly2_tpu.daemon.oras_source import ORASSourceClient
 
         self._clients: dict[str, ResourceClient] = {}
@@ -367,6 +368,7 @@ class SourceRegistry:
         self.register("s3", S3SourceClient())
         self.register("oss", OSSSourceClient())
         self.register("oras", ORASSourceClient())
+        self.register("hdfs", HDFSSourceClient())
         self._register_plugins()
 
     def _register_plugins(self) -> None:
