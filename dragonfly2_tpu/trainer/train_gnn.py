@@ -85,14 +85,11 @@ def train_step(
     return state.apply_gradients(grads=grads), loss
 
 
-def shard_for_training(
+def _place_sharded(
     state: train_state.TrainState, g: TopoGraph, mesh: Mesh
-) -> tuple[train_state.TrainState, TopoGraph, Callable]:
-    """Place state/graph per the mesh rules and return the jitted step.
-
-    Node rows over "data" (pad N to the dp size first), kernels over "model",
-    batch rows over "data".
-    """
+) -> tuple[train_state.TrainState, Any, TopoGraph, TopoGraph]:
+    """Shared placement: pad node rows to the dp size, kernels over "model",
+    node rows over "data". Returns (state, state_sharding, g, g_sharding)."""
     dp = mesh.shape[meshlib.DATA_AXIS]
     g = pad_graph(g, meshlib.pad_to_multiple(g.node_feats.shape[0], dp))
     param_sh = meshlib.infer_param_sharding(state.params, mesh)
@@ -108,6 +105,18 @@ def shard_for_training(
     state = jax.device_put(state, state_sh)
     g_sh = TopoGraph(*meshlib.graph_shardings(mesh))
     g = jax.device_put(_as_jnp_graph(g), g_sh)
+    return state, state_sh, g, g_sh
+
+
+def shard_for_training(
+    state: train_state.TrainState, g: TopoGraph, mesh: Mesh
+) -> tuple[train_state.TrainState, TopoGraph, Callable]:
+    """Place state/graph per the mesh rules and return the jitted step.
+
+    Node rows over "data" (pad N to the dp size first), kernels over "model",
+    batch rows over "data".
+    """
+    state, state_sh, g, g_sh = _place_sharded(state, g, mesh)
     batch_sh = PairBatch(*([meshlib.batch_sharding(mesh)] * 4))
     step = jax.jit(
         train_step,
@@ -131,6 +140,74 @@ def pad_graph(g: TopoGraph, n_padded: int) -> TopoGraph:
         np.concatenate(
             [g.edge_feats, np.zeros((pad,) + g.edge_feats.shape[1:], np.float32)]
         ),
+    )
+
+
+def shard_for_training_scan(
+    state: train_state.TrainState,
+    g: TopoGraph,
+    pairs: PairBatch,
+    mesh: Mesh,
+    *,
+    batch_size: int = 4096,
+    steps_per_call: int = 10,
+) -> tuple[train_state.TrainState, TopoGraph, PairBatch, Callable]:
+    """Device-resident training: the pair POOL lives on device and each
+    jitted call runs `steps_per_call` optimizer steps via lax.scan, sampling
+    minibatches with the JAX PRNG inside the scan body.
+
+    This removes the per-step host round trip (numpy sampling + H2D transfer
+    + dispatch) that dominates wall clock for a model this size — the
+    scaling-book rule: don't bounce to the host between steps. Returns
+    (state, g, pairs, multi_step) where
+    ``multi_step(state, g, pairs, key) -> (state, losses[steps_per_call])``.
+    """
+    batch_size = meshlib.pad_to_multiple(batch_size, mesh.shape[meshlib.DATA_AXIS])
+    state, state_sh, g, g_sh = _place_sharded(state, g, mesh)
+    # the full pool is small (MBs) and replicated; sampled rows get
+    # constrained onto the data axis inside the step
+    pool_sh = PairBatch(*([NamedSharding(mesh, P())] * 4))
+    pairs = jax.device_put(PairBatch(*(jnp.asarray(a) for a in pairs)), pool_sh)
+    jitted = make_scan_step(
+        mesh, state_sh, g_sh, pool_sh, batch_size=batch_size, steps_per_call=steps_per_call
+    )
+    return state, g, pairs, jitted
+
+
+def make_scan_step(
+    mesh: Mesh,
+    state_sh: Any,
+    g_sh: TopoGraph,
+    pool_sh: PairBatch,
+    *,
+    batch_size: int,
+    steps_per_call: int,
+) -> Callable:
+    """The jitted K-step scan alone, given already-known shardings — lets a
+    caller with placed arrays build variants (e.g. a 1-step lowering for
+    FLOPs accounting) without re-placing state on the device. Shardings can
+    be recovered from placed arrays via ``jax.tree.map(lambda x: x.sharding,
+    tree)``."""
+    batch_sh = NamedSharding(mesh, P(meshlib.DATA_AXIS))
+
+    def multi_step(st, gg, pool, key):
+        n_pool = pool.child.shape[0]
+
+        def one(carry, k):
+            idx = jax.random.randint(k, (batch_size,), 0, n_pool)
+            batch = PairBatch(
+                *(jax.lax.with_sharding_constraint(a[idx], batch_sh) for a in pool)
+            )
+            return train_step(carry, gg, batch)
+
+        keys = jax.random.split(key, steps_per_call)
+        return jax.lax.scan(one, st, keys)
+
+    return jax.jit(
+        multi_step,
+        in_shardings=(state_sh, g_sh, pool_sh, NamedSharding(mesh, P())),
+        out_shardings=(state_sh, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
     )
 
 
