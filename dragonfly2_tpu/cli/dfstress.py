@@ -1,0 +1,108 @@
+"""Load generator for a peer daemon / cluster.
+
+Reference equivalent: test/tools/stress (Makefile:303-309) — a concurrency
+driver that hammers a target and reports latency percentiles. Here it drives
+the daemon's download RPC with N concurrent workers for a duration (or a
+fixed request count) and prints one JSON line: throughput, latency
+p50/p90/p99, error count — the shape CI perf gates consume.
+
+    python -m dragonfly2_tpu.cli.dfstress http://origin/file \\
+        --sock /tmp/df.sock --concurrency 16 --duration 10
+
+Each request downloads the SAME task (reuse fast path after the first), so
+the tool measures control-plane + storage round-trip throughput, not origin
+bandwidth; pass --unique to append a counter query param and force distinct
+tasks (piece engine + scheduler path per request).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+
+import numpy as np
+
+from dragonfly2_tpu.cli.dfget import DEFAULT_SOCK
+from dragonfly2_tpu.rpc.core import RpcClient
+
+
+async def run_stress(args: argparse.Namespace) -> dict:
+    client = RpcClient(args.sock, timeout=args.timeout)
+    latencies: list[float] = []
+    errors = 0
+    counter = 0
+    stop_at = time.monotonic() + args.duration if args.count is None else None
+
+    def next_url() -> str | None:
+        # no await points: atomic on the single-threaded event loop
+        nonlocal counter
+        if args.count is not None and counter >= args.count:
+            return None
+        if stop_at is not None and time.monotonic() >= stop_at:
+            return None
+        counter += 1
+        if args.unique:
+            sep = "&" if "?" in args.url else "?"
+            return f"{args.url}{sep}stress={counter}"
+        return args.url
+
+    async def worker() -> None:
+        nonlocal errors
+        while True:
+            url = next_url()
+            if url is None:
+                return
+            t0 = time.monotonic()
+            try:
+                await client.call(
+                    "download", {"url": url, "output": None}, timeout=args.timeout
+                )
+                latencies.append(time.monotonic() - t0)
+            except Exception:
+                errors += 1
+
+    t0 = time.monotonic()
+    await asyncio.gather(*(worker() for _ in range(args.concurrency)))
+    elapsed = time.monotonic() - t0
+    await client.close()
+
+    lat = np.asarray(latencies) * 1000.0
+    return {
+        "metric": "daemon_download_rps",
+        "value": round(len(latencies) / max(elapsed, 1e-9), 1),
+        "unit": "requests/s",
+        "extra": {
+            "requests": len(latencies),
+            "errors": errors,
+            "elapsed_s": round(elapsed, 2),
+            "concurrency": args.concurrency,
+            "unique_tasks": bool(args.unique),
+            "p50_ms": round(float(np.percentile(lat, 50)), 2) if len(lat) else None,
+            "p90_ms": round(float(np.percentile(lat, 90)), 2) if len(lat) else None,
+            "p99_ms": round(float(np.percentile(lat, 99)), 2) if len(lat) else None,
+        },
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description="dragonfly2_tpu daemon load generator")
+    ap.add_argument("url", help="source URL to download repeatedly")
+    ap.add_argument("--sock", default=DEFAULT_SOCK)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--duration", type=float, default=10.0,
+                    help="seconds to run (ignored with --count)")
+    ap.add_argument("--count", type=int, default=None, help="fixed request count")
+    ap.add_argument("--timeout", type=float, default=60.0)
+    ap.add_argument("--unique", action="store_true",
+                    help="unique task per request (full scheduler+piece path)")
+    args = ap.parse_args(argv)
+    result = asyncio.run(run_stress(args))
+    print(json.dumps(result), flush=True)
+    return 0 if result["extra"]["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

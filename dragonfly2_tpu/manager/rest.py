@@ -48,7 +48,9 @@ class ManagerRest:
 
     # ---- auth middleware (ref manager/middlewares/jwt.go + permission) ----
 
-    _OPEN_PATHS = ("/healthz", "/api/v1/users/signin")
+    # "/" is the console shell itself — it holds the token box, so it must
+    # load pre-auth; every API call it makes is still auth-gated
+    _OPEN_PATHS = ("/", "/healthz", "/api/v1/users/signin")
     # the oauth redirect/callback legs are browser-driven and pre-auth
     _OPEN_PREFIXES = ("/api/v1/users/signin/oauth/",)
 
@@ -80,6 +82,7 @@ class ManagerRest:
     def app(self) -> web.Application:
         app = web.Application(middlewares=[self._auth_middleware])
         r = app.router
+        r.add_get("/", self.console)  # embedded ops console (ref manager dist SPA)
         r.add_get("/healthz", self.healthz)
         # users + auth
         r.add_post("/api/v1/users/signin", self.signin)
@@ -126,6 +129,11 @@ class ManagerRest:
         r.add_get("/api/v1/buckets/{name}", self.get_bucket)
         r.add_delete("/api/v1/buckets/{name}", self.delete_bucket)
         return app
+
+    async def console(self, req: web.Request) -> web.Response:
+        from dragonfly2_tpu.manager.console import CONSOLE_HTML
+
+        return web.Response(text=CONSOLE_HTML, content_type="text/html")
 
     # ---- users + certificates ----
 

@@ -192,7 +192,10 @@ class RpcClient:
         async with self._conn_lock:
             if self._writer is not None and not self._writer.is_closing():
                 return
-            if "/" in self.address and ":" not in self.address:
+            # tcp only when the address ends in ":<digits>"; anything else
+            # (absolute, relative, or colon-containing paths) is a unix socket
+            host, _, port_s = self.address.rpartition(":")
+            if not port_s.isdigit():
                 self._reader, self._writer = await asyncio.open_unix_connection(self.address)
             else:
                 host, port = self.address.rsplit(":", 1)

@@ -521,3 +521,25 @@ def test_buckets_unconfigured_is_503(run, tmp_path):
             await server.stop()
 
     run(body())
+
+
+def test_console_served_at_root(run, tmp_path):
+    """The embedded ops console loads pre-auth at /; API calls stay gated."""
+    import aiohttp
+
+    async def body():
+        server = ManagerServer(db_path=str(tmp_path / "m.db"), auth_secret="s")
+        await server.start()
+        try:
+            async with aiohttp.ClientSession() as sess:
+                base = f"http://127.0.0.1:{server.rest_port}"
+                async with sess.get(f"{base}/") as r:
+                    assert r.status == 200
+                    page = await r.text()
+                    assert "dragonfly2-tpu manager" in page and "/api/v1/schedulers" in page
+                async with sess.get(f"{base}/api/v1/schedulers") as r:
+                    assert r.status == 401  # the page is open; the data is not
+        finally:
+            await server.stop()
+
+    run(body())

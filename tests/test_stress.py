@@ -1,0 +1,53 @@
+"""dfstress load generator (ref test/tools/stress) against a real daemon
+socket + in-process scheduler."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+
+from dragonfly2_tpu.cli import dfstress
+from dragonfly2_tpu.daemon.engine import InProcessSchedulerClient, PeerEngine
+from dragonfly2_tpu.daemon.server import DAEMON_METHODS, DaemonRpcAdapter
+from dragonfly2_tpu.rpc.core import RpcServer
+from dragonfly2_tpu.scheduler.service import SchedulerService
+
+
+def test_stress_fixed_count(run, tmp_path):
+    async def body():
+        data = b"stress-payload" * 1000
+        async def origin(req):
+            return web.Response(body=data)
+        app = web.Application()
+        app.router.add_get("/{name}", origin)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+
+        svc = SchedulerService()
+        engine = PeerEngine(storage_root=tmp_path / "store",
+                            scheduler=InProcessSchedulerClient(svc))
+        await engine.start()
+        sock = str(tmp_path / "d.sock")
+        server = RpcServer(unix_path=sock)
+        server.register_service(DaemonRpcAdapter(engine), DAEMON_METHODS)
+        await server.start()
+        try:
+            ns = type("NS", (), dict(
+                url=f"http://127.0.0.1:{port}/f.bin", sock=sock, concurrency=4,
+                duration=30.0, count=25, timeout=30.0, unique=False,
+            ))()
+            result = await dfstress.run_stress(ns)
+            assert result["extra"]["requests"] == 25
+            assert result["extra"]["errors"] == 0
+            assert result["value"] > 0 and result["extra"]["p50_ms"] > 0
+            json.dumps(result)  # one-line JSON contract
+        finally:
+            await server.stop()
+            await engine.stop()
+            await runner.cleanup()
+
+    run(body())
