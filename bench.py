@@ -35,12 +35,12 @@ import numpy as np
 
 _SECTION_TIMEOUT_S = int(os.environ.get("DF_BENCH_SECTION_TIMEOUT", "420"))
 _PROBE_TIMEOUT_S = int(os.environ.get("DF_BENCH_PROBE_TIMEOUT", "240"))
-# The worker must outlive its own worst case: three SIGALRM-bounded sections
+# The worker must outlive its own worst case: four SIGALRM-bounded sections
 # plus backend init/compile margin — otherwise the supervisor would kill it
 # and discard sections that did complete.
 _WORKER_TIMEOUT_S = max(
     int(os.environ.get("DF_BENCH_WORKER_TIMEOUT", "1500")),
-    3 * _SECTION_TIMEOUT_S + _PROBE_TIMEOUT_S + 120,
+    4 * _SECTION_TIMEOUT_S + _PROBE_TIMEOUT_S + 120,
 )
 
 
@@ -323,6 +323,45 @@ def bench_gnn_train(calls: int = 10, steps_per_call: int = 10) -> tuple[float, f
     return calls * steps_per_call / (time.perf_counter() - t0), flops_per_step
 
 
+def bench_checkpoint_fanout(total_mb: int = 64, files: int = 4) -> float:
+    """North-star config 4 shape at bench scale: a multi-file checkpoint
+    published by one peer and fetched by another THROUGH the P2P piece
+    engine (localhost). Returns aggregate MB/s on the fetching side."""
+    import asyncio
+    import os as _os
+    import tempfile
+    from pathlib import Path
+
+    from dragonfly2_tpu.daemon.engine import InProcessSchedulerClient, PeerEngine
+    from dragonfly2_tpu.scheduler.service import SchedulerService
+    from dragonfly2_tpu.tpuvm.checkpoint import fetch_checkpoint, publish_checkpoint
+
+    async def run(td: str) -> float:
+        ckpt = Path(td) / "ckpt"
+        ckpt.mkdir()
+        per_file = total_mb * (1 << 20) // files
+        for i in range(files):
+            (ckpt / f"shard-{i}.safetensors").write_bytes(_os.urandom(per_file))
+        svc = SchedulerService()
+        sched = InProcessSchedulerClient(svc)
+        a = PeerEngine(storage_root=Path(td) / "a", scheduler=sched, hostname="bench-a")
+        b = PeerEngine(storage_root=Path(td) / "b", scheduler=sched, hostname="bench-b")
+        await a.start()
+        await b.start()
+        try:
+            manifest = await publish_checkpoint(a, ckpt, name="bench")
+            t0 = time.perf_counter()
+            await fetch_checkpoint(b, manifest, Path(td) / "restored", concurrency=files)
+            elapsed = time.perf_counter() - t0
+            return manifest.total_bytes / elapsed / (1 << 20)
+        finally:
+            await a.stop()
+            await b.stop()
+
+    with tempfile.TemporaryDirectory() as td:
+        return asyncio.run(run(td))
+
+
 def main() -> None:
     import jax
 
@@ -350,6 +389,7 @@ def main() -> None:
         native_multi_call_p50_ms,
     ) = run_section("native_scoring", bench_native_scoring, (0.0, 0.0, 0.0, 0.0))
     steps_per_sec, flops_per_step = run_section("gnn_train", bench_gnn_train, (0.0, 0.0))
+    fanout_mbps = run_section("checkpoint_fanout", bench_checkpoint_fanout, 0.0)
     # headline = the production serving path: native C++ scorer when the
     # toolchain exists (config 5 "no GPU"), else the jitted JAX fallback
     calls_per_sec = max(jax_calls_per_sec, native_calls_per_sec)
@@ -362,6 +402,7 @@ def main() -> None:
         "jax_scoring_calls_per_sec": round(jax_calls_per_sec, 1),
         "jax_scoring_p50_ms": round(jax_p50_ms, 3),
         "gnn_train_steps_per_sec": round(steps_per_sec, 2),
+        "checkpoint_fanout_mb_per_s": round(fanout_mbps, 1),
         "backend": backend,
     }
     # Utilization accounting (VERDICT r3 #10): FLOPs/step from XLA cost
