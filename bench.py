@@ -328,7 +328,6 @@ def bench_checkpoint_fanout(total_mb: int = 64, files: int = 4) -> float:
     published by one peer and fetched by another THROUGH the P2P piece
     engine (localhost). Returns aggregate MB/s on the fetching side."""
     import asyncio
-    import os as _os
     import tempfile
     from pathlib import Path
 
@@ -341,7 +340,7 @@ def bench_checkpoint_fanout(total_mb: int = 64, files: int = 4) -> float:
         ckpt.mkdir()
         per_file = total_mb * (1 << 20) // files
         for i in range(files):
-            (ckpt / f"shard-{i}.safetensors").write_bytes(_os.urandom(per_file))
+            (ckpt / f"shard-{i}.safetensors").write_bytes(os.urandom(per_file))
         svc = SchedulerService()
         sched = InProcessSchedulerClient(svc)
         a = PeerEngine(storage_root=Path(td) / "a", scheduler=sched, hostname="bench-a")
