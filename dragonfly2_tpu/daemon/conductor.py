@@ -232,7 +232,9 @@ class PeerTaskConductor:
             self._apply_task_info(reg)
             await self._download_p2p(reg.parents)
 
-        if not self.ts.verify():
+        # verify() hashes the whole file — off the event loop, or a 100 MiB
+        # task would freeze every concurrent transfer for the full pass
+        if not await asyncio.to_thread(self.ts.verify):
             await self._safe_report_peer(success=False)
             raise digestlib.InvalidDigestError(
                 f"task {self.meta.task_id}: content digest mismatch"

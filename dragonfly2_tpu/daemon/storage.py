@@ -60,6 +60,7 @@ class TaskStorage:
         # must keep their real age, or a daily-restarted daemon never
         # TTL-evicts and its LRU order resets to arbitrary on every boot.
         self.last_access = meta.updated_at
+        self._inflight: dict[int, asyncio.Future] = {}  # piece index -> writer
         # In-memory change counter for push-style piece announcements: child
         # peers long-poll "metadata changed past version N" instead of
         # re-fetching on a timer (ref peertask_piecetask_synchronizer.go
@@ -136,25 +137,69 @@ class TaskStorage:
         total = self.meta.total_pieces
         return total >= 0 and self._bitset.count() == total
 
+    # pieces below this hash/write inline; larger ones offload so a 4 MiB
+    # sha256 (~10 ms) + disk write never stalls every other transfer on the
+    # event loop (hashlib releases the GIL for large buffers, so worker
+    # threads truly parallelize on multi-core hosts)
+    _INLINE_HASH_BYTES = 256 << 10
+
     async def write_piece(self, index: int, data: bytes, *, expected_digest: str = "") -> str:
-        """Write one piece at its offset; returns the piece sha256 hex."""
+        """Write one piece at its offset; returns the piece sha256 hex.
+
+        The data write runs OUTSIDE the metadata lock: pieces target disjoint
+        offsets and only become visible when the bitset bit is set, so
+        concurrent piece writers genuinely parallelize. Duplicate writers for
+        the SAME index (p2p/back-source overlap) are serialized by an
+        in-flight future so racing writes can never interleave bytes."""
         if self.meta.piece_size <= 0:
             raise ValueError("task info not set before write_piece")
         r = piece_range(index, self.meta.piece_size, self.meta.content_length)
         if len(data) != r.length:
             raise ValueError(f"piece {index}: got {len(data)} bytes, want {r.length}")
-        d = digestlib.sha256_bytes(data)
+        offload = len(data) > self._INLINE_HASH_BYTES
+        if offload:
+            d = await asyncio.to_thread(digestlib.sha256_bytes, data)
+        else:
+            d = digestlib.sha256_bytes(data)
         if expected_digest and d != expected_digest:
             raise digestlib.InvalidDigestError(
                 f"piece {index} digest mismatch: {d[:12]} != {expected_digest[:12]}"
             )
-        async with self._lock:
+        if self._bitset.test(index):
+            return d  # duplicate download of a finished piece
+        racing = self._inflight.get(index)
+        if racing is not None:
+            await racing  # another writer is landing this exact piece
+            return d
+
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._inflight[index] = fut
+
+        def _write() -> None:
             with open(self.data_path, "r+b") as f:
                 f.seek(r.start)
                 f.write(data)
-            if self._bitset.set(index):
-                self.meta.piece_digests[str(index)] = d
-                self.save_metadata()
+
+        try:
+            if offload:
+                await asyncio.to_thread(_write)
+            else:
+                _write()
+            async with self._lock:  # metadata-only critical section
+                if self._bitset.set(index):
+                    self.meta.piece_digests[str(index)] = d
+                    if offload and len(self.meta.piece_digests) > 64:
+                        # the JSON snapshot grows O(pieces); keep big ones off
+                        # the loop too (lock still held: serializes writers'
+                        # metadata updates, not their data writes)
+                        await asyncio.to_thread(self.save_metadata)
+                    else:
+                        self.save_metadata()
+        finally:
+            self._inflight.pop(index, None)
+            if not fut.done():
+                fut.set_result(None)
         self._notify_progress()
         return d
 
@@ -165,13 +210,23 @@ class TaskStorage:
         return await self.read_range(r)
 
     async def read_range(self, r: Range) -> bytes:
+        # Lock-free: callers only read pieces the bitset says are finished,
+        # and finished bytes are immutable — concurrent writers touch other
+        # offsets. (Serving reads behind a per-task lock would serialize a
+        # seed peer's whole fan-out.)
         self.last_access = time.time()
         self.pins += 1  # a concurrent (threaded) reclaim must not rmtree us mid-read
         try:
-            async with self._lock:
-                with open(self.data_path, "rb") as f:
-                    f.seek(r.start)
-                    return f.read(r.length)
+            if r.length > TaskStorage._INLINE_HASH_BYTES:
+                def _read() -> bytes:
+                    with open(self.data_path, "rb") as f:
+                        f.seek(r.start)
+                        return f.read(r.length)
+
+                return await asyncio.to_thread(_read)
+            with open(self.data_path, "rb") as f:
+                f.seek(r.start)
+                return f.read(r.length)
         finally:
             self.pins -= 1
 
