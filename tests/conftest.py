@@ -26,9 +26,14 @@ import pytest  # noqa: E402
 
 @pytest.fixture
 def run():
-    """Run a coroutine to completion on a fresh event loop."""
+    """Run a coroutine to completion on a fresh event loop.
+
+    debug=True is the asyncio analogue of the reference's `go test -race`
+    CI (SURVEY §5): it surfaces never-awaited coroutines, cross-thread
+    loop-unsafe calls, and >100ms event-loop stalls (the class of bug the
+    storage-hashing offload fixed) as warnings/errors during every test."""
 
     def _run(coro):
-        return asyncio.run(coro)
+        return asyncio.run(coro, debug=True)
 
     return _run
