@@ -88,8 +88,34 @@ class DaemonRpcAdapter:
     async def export_task(self, p: dict) -> None:
         ts = self.engine.storage.get(p["task_id"])
         if ts is None or not ts.meta.done:
-            raise RpcError(f"task {p['task_id']} not complete", code="not_found")
-        await ts.export_to(p["output"])
+            # Not held locally: pull the cache task from the CLUSTER, exactly
+            # like the reference's dfcache Export (client/dfcache/dfcache.go
+            # exportTask runs a download through the daemon) — any peer that
+            # imported or fetched it serves the pieces.
+            try:
+                await self.engine.download_task(
+                    f"d7y://cache/{p['task_id']}", output=p["output"]
+                )
+                return
+            except IOError as e:
+                if "registration refused" in str(e) or "unavailable" in str(e):
+                    # the scheduler's "no peer holds this" refusal — the only
+                    # failure that truly means the content is gone; disk/path/
+                    # network faults propagate as internal errors instead of
+                    # lying that the cache content vanished
+                    raise RpcError(
+                        f"task {p['task_id']} not cached locally or on any peer: {e}",
+                        code="not_found",
+                    )
+                raise
+        # pin across the whole local export: closes the window between this
+        # done-check and export_to's own pin where the threaded reclaim could
+        # evict the task
+        ts.pin()
+        try:
+            await ts.export_to(p["output"])
+        finally:
+            ts.unpin()
 
     async def host_info(self, p: dict | None) -> dict:
         hi = self.engine.host_info()

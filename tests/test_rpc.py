@@ -4,6 +4,7 @@ CLI) — the reference's E2E shape over real sockets."""
 
 import asyncio
 import hashlib
+import json
 import os
 import signal
 import subprocess
@@ -162,43 +163,62 @@ class TestSchedulerWire:
         run(body())
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def spawn_cluster(tmp_path, daemon_names, *, scheduler_args=()):
+    """Boot a real scheduler + N daemons as subprocesses; yields
+    (scheduler_addr, [daemon socks], env). SIGTERM/kill teardown and the
+    READY handshakes live here once instead of per test."""
+    env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+    procs = []
+    try:
+        sched = subprocess.Popen(
+            [sys.executable, "-m", "dragonfly2_tpu.scheduler.server", "--port", "0",
+             *scheduler_args],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, env=env,
+        )
+        procs.append(sched)
+        line = sched.stdout.readline()
+        assert line.startswith("SCHEDULER_READY"), line
+        sched_addr = line.split()[1]
+        socks = []
+        for name in daemon_names:
+            sock = str(tmp_path / f"{name}.sock")
+            socks.append(sock)
+            d = subprocess.Popen(
+                [sys.executable, "-m", "dragonfly2_tpu.daemon.server",
+                 "--scheduler", sched_addr, "--sock", sock,
+                 "--storage", str(tmp_path / f"store_{name}"),
+                 "--hostname", name],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, env=env,
+            )
+            procs.append(d)
+            assert d.stdout.readline().startswith("DAEMON_READY")
+        yield sched_addr, socks, env
+    finally:
+        for p in procs:
+            p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
 class TestMultiProcess:
     """Real processes over real sockets: 1 scheduler + seed daemon + peer
     daemon + dfget CLI (ref E2E: kind cluster with dfget exec, here localhost)."""
 
     def test_cluster_download(self, tmp_path):
-        env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
         payload = bytes(range(256)) * (40 * 1024)  # 10 MiB
         origin_file = tmp_path / "origin.bin"
         origin_file.write_bytes(payload)
         url = f"file://{origin_file}"
-        procs = []
-        try:
-            sched = subprocess.Popen(
-                [sys.executable, "-m", "dragonfly2_tpu.scheduler.server", "--port", "0",
-                 "--telemetry-dir", str(tmp_path / "tel")],
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
-            )
-            procs.append(sched)
-            line = sched.stdout.readline()
-            assert line.startswith("SCHEDULER_READY"), line
-            sched_addr = line.split()[1]
-
-            socks = []
-            for i, name in enumerate(["d1", "d2"]):
-                sock = str(tmp_path / f"{name}.sock")
-                socks.append(sock)
-                d = subprocess.Popen(
-                    [sys.executable, "-m", "dragonfly2_tpu.daemon.server",
-                     "--scheduler", sched_addr, "--sock", sock,
-                     "--storage", str(tmp_path / f"store_{name}"),
-                     "--hostname", name],
-                    stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
-                )
-                procs.append(d)
-                line = d.stdout.readline()
-                assert line.startswith("DAEMON_READY"), line
-
+        with spawn_cluster(
+            tmp_path, ["d1", "d2"], scheduler_args=("--telemetry-dir", str(tmp_path / "tel"))
+        ) as (sched_addr, socks, env):
             def dfget(sock, out):
                 return subprocess.run(
                     [sys.executable, "-m", "dragonfly2_tpu.cli.dfget", url,
@@ -215,50 +235,17 @@ class TestMultiProcess:
             want = hashlib.sha256(payload).hexdigest()
             for out in ["out1.bin", "out2.bin"]:
                 assert hashlib.sha256((tmp_path / out).read_bytes()).hexdigest() == want
-        finally:
-            for p in procs:
-                p.send_signal(signal.SIGTERM)
-            for p in procs:
-                try:
-                    p.wait(timeout=10)
-                except subprocess.TimeoutExpired:
-                    p.kill()
 
     def test_cluster_download_100mib_and_range(self, tmp_path):
         """Scale E2E (VERDICT r3 #8): a 100 MiB, 25-piece payload through the
         multi-process cluster — peer1 back-to-source, peer2 via P2P, sha256
         parity — plus a ranged dfget whose output matches the source slice
         (the reference's sha256sum-offset verification, test/tools/)."""
-        env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
         payload = os.urandom(1 << 20) * 100  # 100 MiB, incompressible head
         origin_file = tmp_path / "big.bin"
         origin_file.write_bytes(payload)
         url = f"file://{origin_file}"
-        procs = []
-        try:
-            sched = subprocess.Popen(
-                [sys.executable, "-m", "dragonfly2_tpu.scheduler.server", "--port", "0"],
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
-            )
-            procs.append(sched)
-            line = sched.stdout.readline()
-            assert line.startswith("SCHEDULER_READY"), line
-            sched_addr = line.split()[1]
-
-            socks = []
-            for name in ["big1", "big2"]:
-                sock = str(tmp_path / f"{name}.sock")
-                socks.append(sock)
-                d = subprocess.Popen(
-                    [sys.executable, "-m", "dragonfly2_tpu.daemon.server",
-                     "--scheduler", sched_addr, "--sock", sock,
-                     "--storage", str(tmp_path / f"store_{name}"),
-                     "--hostname", name],
-                    stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
-                )
-                procs.append(d)
-                assert d.stdout.readline().startswith("DAEMON_READY")
-
+        with spawn_cluster(tmp_path, ["big1", "big2"]) as (sched_addr, socks, env):
             def dfget(sock, out, *extra):
                 return subprocess.run(
                     [sys.executable, "-m", "dragonfly2_tpu.cli.dfget", url,
@@ -284,14 +271,36 @@ class TestMultiProcess:
             assert r3.returncode == 0, r3.stderr
             got = hashlib.sha256((tmp_path / "slice.bin").read_bytes()).hexdigest()
             assert got == hashlib.sha256(payload[start : end + 1]).hexdigest()
-        finally:
-            for p in procs:
-                p.send_signal(signal.SIGTERM)
-            for p in procs:
-                try:
-                    p.wait(timeout=10)
-                except subprocess.TimeoutExpired:
-                    p.kill()
+
+    def test_dfcache_cross_peer_export(self, tmp_path):
+        """dfcache CLI through the multi-process cluster: import on daemon 1,
+        export on daemon 2 — the cache task travels peer-to-peer (ref dfcache
+        Export pulls through the daemon, client/dfcache/dfcache.go:131)."""
+        payload = os.urandom(2_000_000)
+        src = tmp_path / "model.bin"
+        src.write_bytes(payload)
+        with spawn_cluster(tmp_path, ["c1", "c2"]) as (sched_addr, socks, env):
+            def dfcache(sock, *args):
+                return subprocess.run(
+                    [sys.executable, "-m", "dragonfly2_tpu.cli.dfcache",
+                     "--sock", sock, "--no-spawn", *args],
+                    capture_output=True, text=True, env=env, timeout=120,
+                )
+
+            r = dfcache(socks[0], "import", str(src), "--tag", "e2e")
+            assert r.returncode == 0, r.stderr
+            task_id = json.loads(r.stdout)["task_id"]
+            # stat on the importer sees it; daemon 2 does NOT hold it locally
+            assert dfcache(socks[0], "stat", task_id).returncode == 0
+            assert dfcache(socks[1], "stat", task_id).returncode == 1
+            # cross-peer export: daemon 2 pulls the cache task via P2P
+            out = tmp_path / "exported.bin"
+            r = dfcache(socks[1], "export", task_id, "-O", str(out))
+            assert r.returncode == 0, r.stderr
+            assert hashlib.sha256(out.read_bytes()).hexdigest() == hashlib.sha256(payload).hexdigest()
+            # a missing id still fails cleanly
+            r = dfcache(socks[1], "export", "0" * 64, "-O", str(tmp_path / "no.bin"))
+            assert r.returncode == 1 and "not cached" in r.stderr
 
     def test_recursive_download(self, tmp_path):
         """dfget --recursive mirrors an HTTP auto-index tree with per-file
@@ -299,7 +308,6 @@ class TestMultiProcess:
         import socket as _socket
         import urllib.request
 
-        env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
         tree = {
             "a.bin": os.urandom(300_000),
             "sub/b.bin": os.urandom(200_000),
@@ -308,21 +316,19 @@ class TestMultiProcess:
         }
         root = tmp_path / "tree"
         for rel, data in tree.items():
-            p = root / rel
-            p.parent.mkdir(parents=True, exist_ok=True)
-            p.write_bytes(data)
+            f = root / rel
+            f.parent.mkdir(parents=True, exist_ok=True)
+            f.write_bytes(data)
 
-        procs = []
+        with _socket.socket() as sck:
+            sck.bind(("127.0.0.1", 0))
+            http_port = sck.getsockname()[1]
+        origin = subprocess.Popen(
+            [sys.executable, "-m", "http.server", str(http_port),
+             "--bind", "127.0.0.1", "--directory", str(root)],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
         try:
-            with _socket.socket() as s:
-                s.bind(("127.0.0.1", 0))
-                http_port = s.getsockname()[1]
-            origin = subprocess.Popen(
-                [sys.executable, "-m", "http.server", str(http_port),
-                 "--bind", "127.0.0.1", "--directory", str(root)],
-                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL, env=env,
-            )
-            procs.append(origin)
             deadline = time.monotonic() + 10
             while time.monotonic() < deadline:
                 try:
@@ -330,45 +336,25 @@ class TestMultiProcess:
                     break
                 except OSError:
                     time.sleep(0.1)
-
-            sched = subprocess.Popen(
-                [sys.executable, "-m", "dragonfly2_tpu.scheduler.server", "--port", "0",
-                 "--telemetry-dir", str(tmp_path / "tel")],
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
-            )
-            procs.append(sched)
-            line = sched.stdout.readline()
-            assert line.startswith("SCHEDULER_READY"), line
-            sched_addr = line.split()[1]
-
-            sock = str(tmp_path / "dr.sock")
-            d = subprocess.Popen(
-                [sys.executable, "-m", "dragonfly2_tpu.daemon.server",
-                 "--scheduler", sched_addr, "--sock", sock,
-                 "--storage", str(tmp_path / "store"), "--hostname", "dr"],
-                stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True, env=env,
-            )
-            procs.append(d)
-            assert d.stdout.readline().startswith("DAEMON_READY")
-
-            out_dir = tmp_path / "mirror"
-            r = subprocess.run(
-                [sys.executable, "-m", "dragonfly2_tpu.cli.dfget",
-                 f"http://127.0.0.1:{http_port}/", "-O", str(out_dir),
-                 "--recursive", "--reject-regex", r"\.txt$",
-                 "--sock", sock, "--no-spawn", "--scheduler", sched_addr],
-                capture_output=True, text=True, env=env, timeout=120,
-            )
-            assert r.returncode == 0, r.stderr + r.stdout
-            for rel in ["a.bin", "sub/b.bin", "sub/deep/c.bin"]:
-                got = (out_dir / rel).read_bytes()
-                assert hashlib.sha256(got).hexdigest() == hashlib.sha256(tree[rel]).hexdigest(), rel
-            assert not (out_dir / "sub/skip.txt").exists()  # reject regex
+            with spawn_cluster(
+                tmp_path, ["dr"], scheduler_args=("--telemetry-dir", str(tmp_path / "tel"))
+            ) as (sched_addr, socks, env):
+                out_dir = tmp_path / "mirror"
+                r = subprocess.run(
+                    [sys.executable, "-m", "dragonfly2_tpu.cli.dfget",
+                     f"http://127.0.0.1:{http_port}/", "-O", str(out_dir),
+                     "--recursive", "--reject-regex", r"\.txt$",
+                     "--sock", socks[0], "--no-spawn", "--scheduler", sched_addr],
+                    capture_output=True, text=True, env=env, timeout=120,
+                )
+                assert r.returncode == 0, r.stderr + r.stdout
+                for rel in ["a.bin", "sub/b.bin", "sub/deep/c.bin"]:
+                    got = (out_dir / rel).read_bytes()
+                    assert hashlib.sha256(got).hexdigest() == hashlib.sha256(tree[rel]).hexdigest(), rel
+                assert not (out_dir / "sub/skip.txt").exists()  # reject regex
         finally:
-            for p in procs:
-                p.send_signal(signal.SIGTERM)
-            for p in procs:
-                try:
-                    p.wait(timeout=10)
-                except subprocess.TimeoutExpired:
-                    p.kill()
+            origin.send_signal(signal.SIGTERM)
+            try:
+                origin.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                origin.kill()
