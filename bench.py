@@ -315,12 +315,17 @@ def bench_gnn_train(calls: int = 10, steps_per_call: int = 10) -> tuple[float, f
     key, sub = jax.random.split(key)
     state, losses = multi_step(state, g, pool, sub)  # compile
     jax.block_until_ready(losses)
-    t0 = time.perf_counter()
-    for _ in range(calls):
-        key, sub = jax.random.split(key)
-        state, losses = multi_step(state, g, pool, sub)
-    jax.block_until_ready(losses)
-    return calls * steps_per_call / (time.perf_counter() - t0), flops_per_step
+    # median of three timing windows: the tunneled chip shows large
+    # run-to-run variance, and one hot/cold window shouldn't be the record
+    rates = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(calls):
+            key, sub = jax.random.split(key)
+            state, losses = multi_step(state, g, pool, sub)
+        jax.block_until_ready(losses)
+        rates.append(calls * steps_per_call / (time.perf_counter() - t0))
+    return float(np.median(rates)), flops_per_step
 
 
 def bench_checkpoint_fanout(total_mb: int = 64, files: int = 4) -> float:
