@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import weakref
 from pathlib import Path
 from typing import Optional
 
@@ -22,6 +23,23 @@ from dragonfly2_tpu.scheduler.service import HostInfo, SchedulerService, TaskMet
 from dragonfly2_tpu.utils import idgen
 
 logger = logging.getLogger(__name__)
+
+
+class _OncePinRelease:
+    """Release a TaskStorage operation pin exactly once, from whichever of
+    the stream body's finally / generator GC fires first (a stream handed out
+    but never iterated must not leave its task reclaim-immune forever)."""
+
+    __slots__ = ("_ts", "_released")
+
+    def __init__(self, ts: TaskStorage):
+        self._ts = ts
+        self._released = False
+
+    def __call__(self) -> None:
+        if not self._released:
+            self._released = True
+            self._ts.unpin()
 
 
 class InProcessSchedulerClient:
@@ -260,13 +278,21 @@ class PeerEngine:
         url: str,
         *,
         output: str | Path | None = None,
+        output_range: "tuple[int, int] | None" = None,
         seed: bool = False,
         headers: dict[str, str] | None = None,
         **meta_kw,
     ) -> TaskStorage:
-        """Download (or reuse) a task; optionally export to a named file."""
+        """Download (or reuse) a task; optionally export to a named file.
+
+        `output_range=(start, end)` (inclusive bytes, HTTP Range semantics)
+        exports just that slice — performed HERE, under this operation's pin,
+        so a threaded storage reclaim can never evict the task between the
+        download completing and the ranged export reading it. Raises
+        ValueError when the range falls outside the task's content length."""
         from dragonfly2_tpu.daemon import metrics
         from dragonfly2_tpu.observability.tracing import default_tracer
+        from dragonfly2_tpu.utils.pieces import Range
 
         await self.start()
         meta = self.make_meta(url, **meta_kw)
@@ -291,7 +317,16 @@ class PeerEngine:
                     metrics.CONCURRENT_TASKS.dec()
                 metrics.TASK_RESULT_TOTAL.inc(success="true")
             if output is not None:
-                await ts.export_to(output)
+                if output_range is not None:
+                    start, end = output_range
+                    if start < 0 or end < start or end >= ts.meta.content_length:
+                        raise ValueError(
+                            f"range {start}-{end} out of bounds for "
+                            f"{ts.meta.content_length} bytes"
+                        )
+                    await ts.export_range(output, Range(start, end - start + 1))
+                else:
+                    await ts.export_to(output)
             return ts
         finally:
             pinned.unpin()
@@ -317,6 +352,13 @@ class PeerEngine:
 
         ts, producer = await self._reuse_or_conduct(meta, headers)
 
+        # The operation pin from _reuse_or_conduct is normally released by the
+        # body generator's finally — but a caller that never iterates (or
+        # closes) the generator (proxy client gone before the transport reads)
+        # would leak it, making the task permanently reclaim-immune. A
+        # once-only release also wired to the generator's GC covers that path.
+        release = _OncePinRelease(ts)
+
         async def body(ts=ts, producer=producer):
             if producer is not None:
                 metrics.CONCURRENT_TASKS.inc()
@@ -332,11 +374,13 @@ class PeerEngine:
                     producer.cancel()
                 raise
             finally:
-                ts.unpin()  # the stream held the operation pin to the last chunk
+                release()  # the stream held the operation pin to the last chunk
                 if producer is not None:
                     metrics.CONCURRENT_TASKS.dec()
 
-        return ts.meta.content_length, body()
+        gen = body()
+        weakref.finalize(gen, release)
+        return ts.meta.content_length, gen
 
     async def import_file(self, path: str | Path, *, tag: str = "", application: str = "") -> TaskStorage:
         """Import a local file into the P2P cache (ref dfcache Import,

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import time
 from dataclasses import dataclass
 from typing import AsyncIterator, Optional
@@ -42,6 +43,16 @@ _MANIFEST_ACCEPT = ", ".join(
     )
 )
 _RESOLVE_TTL_S = 300.0  # tags move; content-addressed blobs don't
+
+
+def parse_auth_challenge(fields_s: str) -> dict[str, str]:
+    """Quote-aware WWW-Authenticate auth-param parse (RFC 7235 grammar): a
+    naive comma split mangles quoted values containing commas — Docker Hub
+    and Harbor emit scope="repository:a:pull,push"."""
+    return {
+        (m.group(1) or m.group(3)).lower(): (m.group(2) if m.group(1) else m.group(4))
+        for m in re.finditer(r'(\w+)="([^"]*)"|(\w+)=([^",\s]+)', fields_s)
+    }
 
 
 @dataclass
@@ -99,10 +110,7 @@ class ORASSourceClient(ResourceClient):
         kind, _, fields_s = www_auth.partition(" ")
         if kind.lower() != "bearer":
             raise SourceError(f"unsupported registry auth scheme: {kind}")
-        fields = {}
-        for part in fields_s.split(","):
-            k, _, v = part.strip().partition("=")
-            fields[k.lower()] = v.strip('"')
+        fields = parse_auth_challenge(fields_s)
         realm = fields.get("realm")
         if not realm:
             raise SourceError(f"registry auth challenge missing realm: {www_auth}")

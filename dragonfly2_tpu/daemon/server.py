@@ -34,32 +34,31 @@ class DaemonRpcAdapter:
 
     async def download(self, p: dict) -> dict:
         rng_s = p.get("range", "")
-        ts = await self.engine.download_task(
-            p["url"],
-            output=None if rng_s else p.get("output"),
-            tag=p.get("tag", ""),
-            application=p.get("application", ""),
-            digest=p.get("digest", ""),
-            filters=tuple(p.get("filters", ())),
-            headers=p.get("headers") or None,
-        )
-        if rng_s and p.get("output"):
-            # ranged export from the piece store (ref dfget ranged download;
-            # "start-end" inclusive bytes, HTTP Range semantics)
-            from dragonfly2_tpu.utils.pieces import Range
-
+        rng = None
+        if rng_s:
+            # "start-end" inclusive bytes, HTTP Range semantics (ref dfget
+            # ranged download); bounds are validated against the downloaded
+            # content length inside download_task, under its operation pin
             start_s, _, end_s = rng_s.partition("-")
             try:
-                start, end = int(start_s), int(end_s)
+                rng = (int(start_s), int(end_s))
             except ValueError:
                 raise RpcError(f"bad range {rng_s!r}: want START-END", code="bad_request")
-            if start < 0 or end < start or end >= ts.meta.content_length:
-                raise RpcError(
-                    f"range {rng_s} out of bounds for {ts.meta.content_length} bytes",
-                    code="bad_request",
-                )
-            await ts.export_range(p["output"], Range(start, end - start + 1))
-            exported = end - start + 1
+        try:
+            ts = await self.engine.download_task(
+                p["url"],
+                output=p.get("output"),
+                output_range=rng if p.get("output") else None,
+                tag=p.get("tag", ""),
+                application=p.get("application", ""),
+                digest=p.get("digest", ""),
+                filters=tuple(p.get("filters", ())),
+                headers=p.get("headers") or None,
+            )
+        except ValueError as e:
+            raise RpcError(str(e), code="bad_request")
+        if rng and p.get("output"):
+            exported = rng[1] - rng[0] + 1
         else:
             exported = ts.meta.content_length
         return {

@@ -165,12 +165,22 @@ class TaskStorage:
             raise digestlib.InvalidDigestError(
                 f"piece {index} digest mismatch: {d[:12]} != {expected_digest[:12]}"
             )
-        if self._bitset.test(index):
-            return d  # duplicate download of a finished piece
-        racing = self._inflight.get(index)
-        if racing is not None:
-            await racing  # another writer is landing this exact piece
-            return d
+        while True:
+            if self._bitset.test(index):
+                return d  # duplicate download of a finished piece
+            racing = self._inflight.get(index)
+            if racing is None:
+                break  # this writer becomes the primary
+            try:
+                await racing  # another writer is landing this exact piece
+                return d
+            except BaseException:
+                if not racing.done():
+                    raise  # our own cancellation, not the primary's failure
+                # The primary failed/was cancelled — but this writer holds
+                # its own digest-verified bytes: loop to take over the write
+                # (or wait on whichever duplicate claimed primary first)
+                continue
 
         loop = asyncio.get_running_loop()
         fut: asyncio.Future = loop.create_future()
@@ -196,6 +206,15 @@ class TaskStorage:
                         await asyncio.to_thread(self.save_metadata)
                     else:
                         self.save_metadata()
+        except BaseException as exc:
+            # Duplicate writers awaiting the in-flight future must see the
+            # primary's failure — resolving with success here would make them
+            # report a piece as landed whose bitset bit was never set, feeding
+            # false piece successes into scheduler telemetry.
+            if not fut.done():
+                fut.set_exception(IOError(f"piece {index} primary writer failed: {exc!r}"))
+                fut.exception()  # mark retrieved: there may be no waiter
+            raise
         finally:
             self._inflight.pop(index, None)
             if not fut.done():

@@ -408,9 +408,15 @@ class SchedulerService:
         self._record_download(peer, success, bandwidth_bps)
         if success and bandwidth_bps > 0:
             # feed the bandwidth-history EWMA (feature f[8]) before the
-            # parent edges are dropped below
-            for parent in task.parents_of(peer_id):
-                self.bandwidth.observe(parent.host.id, peer.host.id, bandwidth_bps)
+            # parent edges are dropped below — apportioned across parents:
+            # bandwidth_bps is the child's AGGREGATE rate, so crediting it
+            # whole to each of up to 4 parents would overstate every parent's
+            # EWMA (and the trainer's labels) by the parent-count factor
+            parents = task.parents_of(peer_id)
+            if parents:
+                per_parent = bandwidth_bps / len(parents)
+                for parent in parents:
+                    self.bandwidth.observe(parent.host.id, peer.host.id, per_parent)
         # The peer stops downloading either way: release its parents' upload
         # slots now, not at the 24h GC (it stays in the DAG as a parent).
         task.delete_parents(peer_id)
@@ -422,6 +428,13 @@ class SchedulerService:
         task = peer.task
         parents = task.parents_of(peer.id)
         costs = peer.piece_costs_ms
+        # Per-ROW bandwidth is apportioned across parents: each row is one
+        # (parent, child) pair and bandwidth_bps is the child's aggregate, so
+        # stamping the aggregate into every row would overstate the trainer's
+        # per-pair labels AND the warm-start (BandwidthHistory.load_from
+        # replays rows through observe) by the parent-count factor — the
+        # persisted rows must agree with the apportioned live observe below.
+        row_bw = bandwidth_bps / len(parents) if parents else bandwidth_bps
         base = dict(
             task_id=task.id.encode()[:64],
             child_peer_id=peer.id.encode()[:64],
@@ -429,7 +442,7 @@ class SchedulerService:
             piece_count=peer.finished_pieces.count(),
             piece_size=task.piece_size or 0,
             content_length=task.content_length or -1,
-            bandwidth_bps=bandwidth_bps,
+            bandwidth_bps=row_bw,
             piece_cost_ms_mean=float(np.mean(costs)) if costs else 0.0,
             success=success,
             back_to_source=peer.fsm.is_(PEER_BACK_TO_SOURCE) or peer.state == PEER_SUCCEEDED and not parents,

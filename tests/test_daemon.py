@@ -164,6 +164,67 @@ class TestStorage:
 
         run(body())
 
+    def test_write_piece_primary_failure_duplicate_takes_over(self, run, tmp_path):
+        """A duplicate writer parked on the in-flight future must never report
+        success for a piece whose bitset bit was never set (ADVICE r4 medium).
+        Holding its own digest-verified bytes, it takes over the write when
+        the primary fails rather than discarding them."""
+
+        async def body():
+            sm = StorageManager(tmp_path)
+            ts = sm.register_task("f" * 64, url="http://x/f")
+            size = 512 * 1024  # > _INLINE_HASH_BYTES: offloaded, real await points
+            ts.set_task_info(content_length=size, piece_size=size, total_pieces=1)
+            data = b"z" * size
+
+            # Waiter path: the duplicate parked on a failed in-flight future
+            # takes over and lands the piece itself.
+            loop = asyncio.get_running_loop()
+            fut = loop.create_future()
+            ts._inflight[0] = fut
+            dup = asyncio.ensure_future(ts.write_piece(0, data))
+            await asyncio.sleep(0.05)
+            assert not dup.done()  # parked on the racing future
+            # simulate the primary's failure path: exception set, entry popped
+            fut.set_exception(IOError("primary writer failed: disk full"))
+            fut.exception()
+            ts._inflight.pop(0, None)
+            assert await dup == hashlib.sha256(data).hexdigest()
+            assert ts.has_piece(0)
+
+        run(body())
+
+    def test_write_piece_failure_never_reports_false_success(self, run, tmp_path):
+        """When the disk itself is unwritable, BOTH the primary and any
+        duplicate (after its takeover attempt) fail — no false successes fed
+        to the scheduler; the piece lands once the fault clears."""
+
+        async def body():
+            sm = StorageManager(tmp_path)
+            ts = sm.register_task("e" * 64, url="http://x/e")
+            size = 512 * 1024
+            ts.set_task_info(content_length=size, piece_size=size, total_pieces=1)
+            data = b"z" * size
+            real_path = ts.data_path
+            ts.data_path = tmp_path / "nonexistent-dir" / "data"
+
+            async def late_dup():
+                await asyncio.sleep(0.005)
+                return await ts.write_piece(0, data)
+
+            res = await asyncio.gather(
+                ts.write_piece(0, data), late_dup(), return_exceptions=True
+            )
+            assert all(isinstance(r, Exception) for r in res)
+            assert not ts.has_piece(0)
+
+            # transient failure cleared: the piece can still land
+            ts.data_path = real_path
+            await ts.write_piece(0, data)
+            assert ts.has_piece(0)
+
+        run(body())
+
     def test_reuse_and_persistence(self, run, tmp_path):
         async def body():
             sm = StorageManager(tmp_path)

@@ -30,6 +30,27 @@ def test_url_parsing():
         ORASSourceClient.parse("oras://reg.io/repo:")
 
 
+def test_auth_challenge_parse_quote_aware():
+    """Quoted values containing commas (Docker Hub / Harbor scope lists) must
+    survive the challenge parse intact (ADVICE r4)."""
+    from dragonfly2_tpu.daemon.oras_source import parse_auth_challenge
+
+    fields = parse_auth_challenge(
+        'realm="https://auth.docker.io/token",service="registry.docker.io",'
+        'scope="repository:a/b:pull,push"'
+    )
+    assert fields == {
+        "realm": "https://auth.docker.io/token",
+        "service": "registry.docker.io",
+        "scope": "repository:a/b:pull,push",
+    }
+    # unquoted values and mixed forms still parse
+    assert parse_auth_challenge('realm=http://r/t, error="insufficient_scope"') == {
+        "realm": "http://r/t",
+        "error": "insufficient_scope",
+    }
+
+
 def test_info_download_and_token_dance(run):
     async def body():
         reg = FakeRegistry()

@@ -297,6 +297,41 @@ class TestStreamTask:
 
         run(body())
 
+    def test_abandoned_stream_releases_pin(self, run, tmp_path):
+        """A caller that obtains (length, body) but never iterates the
+        generator must not leak the operation pin — a leaked pin makes the
+        task permanently reclaim-immune (ADVICE r4)."""
+
+        async def body():
+            import gc
+
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            async with Origin({"s.bin": PAYLOAD}) as origin:
+                engine = make_engine(tmp_path, client, "streamleak")
+                await engine.start()
+                try:
+                    length, it = await engine.stream_task(origin.url("s.bin"))
+                    ts = engine.storage.tasks()[0]
+                    assert ts.pins >= 1  # stream holds the operation pin
+                    del it  # abandoned without a single __anext__
+                    gc.collect()
+                    for _ in range(50):  # let any producer task settle
+                        await asyncio.sleep(0.01)
+                        if ts.pins == 0:
+                            break
+                    assert ts.pins == 0
+                    # iterated streams still release exactly once
+                    _, it2 = await engine.stream_task(origin.url("s.bin"))
+                    assert b"".join([c async for c in it2]) == PAYLOAD
+                    gc.collect()
+                    await asyncio.sleep(0)
+                    assert ts.pins == 0
+                finally:
+                    await engine.stop()
+
+        run(body())
+
     def test_stream_failure_propagates(self, run, tmp_path):
         async def body():
             svc = SchedulerService()

@@ -361,6 +361,39 @@ class TestService:
 
         run(body())
 
+    def test_bandwidth_apportioned_across_parents(self, run, tmp_path):
+        """A multi-parent child's aggregate bandwidth is split across its
+        parents' EWMAs — crediting the whole rate to each of up to 4 parents
+        would overstate every parent by the parent-count factor (ADVICE r4)."""
+
+        async def body():
+            svc = self._service(tmp_path)
+            meta = TaskMeta("t1", "http://o/f")
+            # two seed peers on distinct hosts
+            for i in (1, 2):
+                await svc.register_peer(f"p{i}", meta, self._host(i))
+                if i == 1:
+                    svc.report_task_metadata("t1", content_length=100 << 20)
+                for j in range(5):
+                    svc.report_piece_result(f"p{i}", j, success=True, cost_ms=4.0)
+                svc.report_peer_result(f"p{i}", success=True)
+            out3 = await svc.register_peer("p3", meta, self._host(3))
+            assert len(out3.parents) == 2
+            for j in range(5):
+                svc.report_piece_result("p3", j, success=True, cost_ms=4.0, parent_id="p1")
+            svc.report_peer_result("p3", success=True, bandwidth_bps=2e8)
+            # each parent host is credited half the child's aggregate rate
+            assert svc.bandwidth.query("h1", "h3") == pytest.approx(1e8)
+            assert svc.bandwidth.query("h2", "h3") == pytest.approx(1e8)
+            # persisted rows carry the APPORTIONED rate too, so a restart's
+            # warm-start replay agrees with the live EWMA (no double credit)
+            svc.telemetry.flush()
+            svc2 = self._service(tmp_path)
+            assert svc2.bandwidth.query("h1", "h3") == pytest.approx(1e8)
+            assert svc2.bandwidth.query("h2", "h3") == pytest.approx(1e8)
+
+        run(body())
+
     def test_bandwidth_feature_fed_end_to_end(self, run, tmp_path):
         """f[8] (bandwidth_norm) through the full loop: register → download →
         report(bandwidth) → rescore. The feature was a zeroed placeholder for
