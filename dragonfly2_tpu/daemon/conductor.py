@@ -180,6 +180,15 @@ class PeerTaskConductor:
         self.bytes_from_parents = 0
         self.bytes_from_source = 0
         self._piece_digests: dict[str, str] = {}  # learned from parent metadata
+        # Whether the final full-content re-hash can be skipped: true only if
+        # EVERY byte of the task was landed by THIS conductor with each piece
+        # validated against an expected digest at write time, and every such
+        # digest came from a parent that had itself completed (and therefore
+        # verified) the task — a mid-download parent's digests are self-
+        # computed from bytes IT has not verified yet (see _run_inner).
+        self._pieces_unverified = 0
+        self._digests_from_done_parents = True
+        self._had_preexisting_pieces = False
         self._peer_reported = False
         self._t0 = 0.0
         self._sync_tasks: dict[str, asyncio.Task] = {}  # parent_id -> long-poll loop
@@ -217,6 +226,7 @@ class PeerTaskConductor:
             application=self.meta.application,
         )
         self.ts.pin()  # immune to storage reclaim while this download runs
+        self._had_preexisting_pieces = self.ts.finished_count() > 0
 
         if reg.scope == "empty":
             self.ts.set_task_info(content_length=0, piece_size=1, total_pieces=0)
@@ -232,13 +242,28 @@ class PeerTaskConductor:
             self._apply_task_info(reg)
             await self._download_p2p(reg.parents)
 
-        # verify() hashes the whole file — off the event loop, or a 100 MiB
-        # task would freeze every concurrent transfer for the full pass
-        if not await asyncio.to_thread(self.ts.verify):
-            await self._safe_report_peer(success=False)
-            raise digestlib.InvalidDigestError(
-                f"task {self.meta.task_id}: content digest mismatch"
-            )
+        # The full-content re-hash is redundant when every piece this
+        # conductor landed was already validated against an expected digest
+        # from the piece-metadata channel — the same per-piece trust chain the
+        # reference's piece MD5 check uses (piece_manager.go processPieceFromSource
+        # digest verification). Skipping it saves one full read+hash pass per
+        # task — seconds per checkpoint shard on the fan-out path. It still
+        # runs when any piece lacked a digest (back-to-source computes its
+        # own) or when pieces predate this conductor (unknown provenance).
+        every_piece_validated = (
+            not self._had_preexisting_pieces
+            and self._pieces_unverified == 0
+            and self._digests_from_done_parents
+            and self.ts.meta.total_pieces > 0
+        )
+        if not every_piece_validated:
+            # verify() hashes the whole file — off the event loop, or a 100
+            # MiB task would freeze every concurrent transfer for the pass
+            if not await asyncio.to_thread(self.ts.verify):
+                await self._safe_report_peer(success=False)
+                raise digestlib.InvalidDigestError(
+                    f"task {self.meta.task_id}: content digest mismatch"
+                )
         self.ts.mark_done()
         await self._safe_report_peer(success=True)
         return self.ts
@@ -263,6 +288,9 @@ class PeerTaskConductor:
     # ---- back-to-source (ref pieceManager.DownloadSource) ----
 
     async def _download_back_to_source(self) -> None:
+        # source bytes carry no expected piece digests (we compute them as we
+        # write) — the end-of-task full verify must run when a digest is known
+        self._pieces_unverified += 1
         url = self.meta.url
         info = await self.sources.info(url, self.headers)
         if self.ts.meta.content_length < 0:
@@ -507,8 +535,15 @@ class PeerTaskConductor:
                     data = await resp.json()
                 version = data.get("version", version)
                 state.pieces = set(data.get("finished_pieces", ()))
+                parent_done = bool(data.get("done"))
                 for k, v in data.get("piece_digests", {}).items():
-                    self._piece_digests.setdefault(k, v)
+                    if k not in self._piece_digests:
+                        self._piece_digests[k] = v
+                        if not parent_done:
+                            # streaming parent: its digests are self-computed
+                            # over bytes it hasn't end-to-end verified yet, so
+                            # the final full verify must still run here
+                            self._digests_from_done_parents = False
                 if self.ts.meta.content_length < 0 and data.get("content_length", -1) >= 0:
                     self.ts.set_task_info(
                         content_length=data["content_length"],
@@ -570,6 +605,8 @@ class PeerTaskConductor:
             return
         cost = (time.monotonic() - t0) * 1000
         expected = self._piece_digests.get(str(idx), "")
+        if not expected:
+            self._pieces_unverified += 1
         try:
             await self.ts.write_piece(idx, data, expected_digest=expected)
         except (ValueError, digestlib.InvalidDigestError) as e:
@@ -593,7 +630,10 @@ class PeerTaskConductor:
 
     def _http(self) -> aiohttp.ClientSession:
         if self._session is None or self._session.closed:
-            self._session = aiohttp.ClientSession()
+            # 1 MiB read buffer: the 64 KiB default hits the stream reader's
+            # high-water mark hundreds of times per 16 MiB checkpoint piece,
+            # each a transport pause/resume round-trip on the event loop
+            self._session = aiohttp.ClientSession(read_bufsize=1 << 20)
         return self._session
 
     async def _safe_report_peer(self, *, success: bool) -> None:

@@ -17,7 +17,7 @@ from typing import Optional
 
 from dragonfly2_tpu.daemon.conductor import ConductorConfig, PeerTaskConductor, SchedulerClient
 from dragonfly2_tpu.daemon.source import SourceRegistry
-from dragonfly2_tpu.daemon.storage import StorageManager, TaskStorage
+from dragonfly2_tpu.daemon.storage import OncePinRelease, StorageManager, TaskStorage
 from dragonfly2_tpu.daemon.upload import UploadServer
 from dragonfly2_tpu.scheduler.service import HostInfo, SchedulerService, TaskMeta
 from dragonfly2_tpu.utils import idgen
@@ -25,21 +25,10 @@ from dragonfly2_tpu.utils import idgen
 logger = logging.getLogger(__name__)
 
 
-class _OncePinRelease:
-    """Release a TaskStorage operation pin exactly once, from whichever of
-    the stream body's finally / generator GC fires first (a stream handed out
-    but never iterated must not leave its task reclaim-immune forever)."""
-
-    __slots__ = ("_ts", "_released")
-
-    def __init__(self, ts: TaskStorage):
-        self._ts = ts
-        self._released = False
-
-    def __call__(self) -> None:
-        if not self._released:
-            self._released = True
-            self._ts.unpin()
+class RangeOutOfBounds(ValueError):
+    """An output_range outside the downloaded task's content length — a
+    caller error, distinguished from internal ValueErrors so rpc adapters can
+    map ONLY this to bad_request."""
 
 
 class InProcessSchedulerClient:
@@ -186,6 +175,7 @@ class PeerEngine:
             self.gc.stop()
             await self.upload.stop()
             await self.sources.close()
+            self.storage.flush_all()  # persist debounced piece metadata
             self._started = False
 
     # ---- task API (ref StartFileTask / StartSeedTask) ----
@@ -320,7 +310,7 @@ class PeerEngine:
                 if output_range is not None:
                     start, end = output_range
                     if start < 0 or end < start or end >= ts.meta.content_length:
-                        raise ValueError(
+                        raise RangeOutOfBounds(
                             f"range {start}-{end} out of bounds for "
                             f"{ts.meta.content_length} bytes"
                         )
@@ -357,7 +347,7 @@ class PeerEngine:
         # closes) the generator (proxy client gone before the transport reads)
         # would leak it, making the task permanently reclaim-immune. A
         # once-only release also wired to the generator's GC covers that path.
-        release = _OncePinRelease(ts)
+        release = OncePinRelease(ts)
 
         async def body(ts=ts, producer=producer):
             if producer is not None:
@@ -382,7 +372,14 @@ class PeerEngine:
         weakref.finalize(gen, release)
         return ts.meta.content_length, gen
 
-    async def import_file(self, path: str | Path, *, tag: str = "", application: str = "") -> TaskStorage:
+    async def import_file(
+        self,
+        path: str | Path,
+        *,
+        tag: str = "",
+        application: str = "",
+        piece_size: int | None = None,
+    ) -> TaskStorage:
         """Import a local file into the P2P cache (ref dfcache Import,
         client/dfcache/dfcache.go:105 importTask): slice it into pieces in
         local storage, then register with the scheduler as an instantly
@@ -406,7 +403,14 @@ class PeerEngine:
             return str(d), path.stat().st_size
 
         dig, size = await asyncio.to_thread(_hash_and_size)
-        task_id = idgen.persistent_cache_task_id(dig, tag, application)
+        # piece_size override: checkpoint publishers pick larger pieces than
+        # the generic ladder (fewer per-piece round-trips on the fan-out
+        # path). The effective size is baked into the task id, so publishers
+        # using different geometries yield distinct tasks instead of one task
+        # with a conflicting index-keyed digest map.
+        if piece_size is None:
+            piece_size = compute_piece_size(size)
+        task_id = idgen.persistent_cache_task_id(dig, tag, application, piece_size)
         url = f"d7y://cache/{task_id}"
         meta = TaskMeta(
             task_id=task_id, url=url, digest=dig, tag=tag, application=application
@@ -415,7 +419,6 @@ class PeerEngine:
         ts = self.storage.find_completed_task(task_id)
         if ts is None:
             ts = self.storage.register_task(task_id, url=url, tag=tag, digest=dig)
-            piece_size = compute_piece_size(size)
             n = piece_count(size, piece_size)
             ts.set_task_info(
                 content_length=size, piece_size=piece_size, total_pieces=n, digest=dig

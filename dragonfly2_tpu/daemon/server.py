@@ -14,7 +14,7 @@ import asyncio
 import logging
 import os
 
-from dragonfly2_tpu.daemon.engine import PeerEngine
+from dragonfly2_tpu.daemon.engine import PeerEngine, RangeOutOfBounds
 from dragonfly2_tpu.rpc.core import RpcError, RpcServer
 from dragonfly2_tpu.utils.proc import run_until_signalled
 
@@ -55,7 +55,10 @@ class DaemonRpcAdapter:
                 filters=tuple(p.get("filters", ())),
                 headers=p.get("headers") or None,
             )
-        except ValueError as e:
+        except RangeOutOfBounds as e:
+            # ONLY the bounds check maps to bad_request — an internal
+            # ValueError from the download pipeline must stay a server error
+            # (retryable), not be blamed on the client's request
             raise RpcError(str(e), code="bad_request")
         if rng and p.get("output"):
             exported = rng[1] - rng[0] + 1

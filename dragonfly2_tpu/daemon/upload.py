@@ -14,15 +14,38 @@ from __future__ import annotations
 import asyncio
 import logging
 import math
+import time
+import weakref
 from typing import Optional
 
 from aiohttp import web
 
-from dragonfly2_tpu.daemon.storage import StorageManager
+from dragonfly2_tpu.daemon.storage import OncePinRelease, StorageManager, TaskStorage
 from dragonfly2_tpu.utils.pieces import parse_http_range
 from dragonfly2_tpu.utils.ratelimit import TokenBucket
 
 logger = logging.getLogger(__name__)
+
+
+class _PinnedFileResponse(web.FileResponse):
+    """FileResponse holding a storage pin from construction until its own
+    prepare() (which opens the file and sends the ranged body) completes:
+    the threaded storage reclaim must not rmtree the task in the window
+    between the handler returning and aiohttp opening the file. A GC
+    finalizer covers responses aiohttp never prepares (connection lost)."""
+
+    def __init__(self, *args, ts: TaskStorage, **kwargs):
+        super().__init__(*args, **kwargs)
+        release = OncePinRelease(ts)
+        ts.pin()
+        self._df_release = release
+        weakref.finalize(self, release)
+
+    async def prepare(self, request):
+        try:
+            return await super().prepare(request)
+        finally:
+            self._df_release()
 
 
 class UploadServer:
@@ -137,17 +160,22 @@ class UploadServer:
                 raise web.HTTPNotFound(text=f"piece {idx} not yet available")
 
         await self.bucket.acquire(rng.length)
-        data = await ts.read_range(rng)
-        self.bytes_served += len(data)
+        self.bytes_served += rng.length
         self.pieces_served += 1
         from dragonfly2_tpu.daemon import metrics
 
-        metrics.UPLOAD_BYTES.inc(len(data))
-        return web.Response(
-            status=206,
-            body=data,
-            headers={
-                "Content-Range": f"bytes {rng.start}-{rng.end}/{total}",
-                "Content-Type": "application/octet-stream",
-            },
+        metrics.UPLOAD_BYTES.inc(rng.length)
+        ts.last_access = time.time()  # serving keeps the task LRU-hot
+        # Zero-copy serving: FileResponse honors the Range header itself and
+        # sends via loop.sendfile where the platform supports it, so piece
+        # bytes go disk→socket without ever entering Python userspace (the
+        # previous read_range path buffered the whole piece then copied it
+        # through the response). The pinned subclass keeps the task immune to
+        # the threaded reclaim until the file is open and sent; once open,
+        # eviction only unlinks the inode and the send is safe.
+        return _PinnedFileResponse(
+            ts.data_path,
+            ts=ts,
+            chunk_size=1 << 20,
+            headers={"Content-Type": "application/octet-stream"},
         )

@@ -61,12 +61,20 @@ class Manifest:
         return sum(e.size for e in self.files)
 
 
+# Checkpoint-tuned piece size: model shards are large sequential reads, so
+# 16 MiB pieces (vs the generic 4 MiB ladder start) quarter the per-piece
+# round-trips/digests/announcements on the fan-out path. The generic ladder
+# only reaches 16 MiB at 4 GiB files; checkpoints benefit from it immediately.
+CHECKPOINT_PIECE_SIZE = 16 << 20
+
+
 async def publish_checkpoint(
     engine,
     directory: str | Path,
     *,
     name: str = "",
     patterns: tuple[str, ...] = ("*.safetensors", "*.json", "*.model", "*.txt"),
+    piece_size: int = CHECKPOINT_PIECE_SIZE,
 ) -> Manifest:
     """Import a checkpoint directory into the P2P cache; returns the manifest
     (also written into the directory as dragonfly-checkpoint.json)."""
@@ -80,7 +88,7 @@ async def publish_checkpoint(
 
     manifest = Manifest(name=name, created_at=time.time())
     for p in sorted(set(files)):
-        ts = await engine.import_file(p, tag=f"ckpt:{name}")
+        ts = await engine.import_file(p, tag=f"ckpt:{name}", piece_size=piece_size)
         manifest.files.append(
             ManifestEntry(
                 path=p.relative_to(directory).as_posix(),

@@ -55,14 +55,23 @@ def task_id(
     return h.hexdigest()
 
 
-def persistent_cache_task_id(content_digest: str, tag: str = "", application: str = "") -> str:
-    """Task ID for imported cache objects, keyed by content digest not URL."""
+def persistent_cache_task_id(
+    content_digest: str, tag: str = "", application: str = "", piece_size: int = 0
+) -> str:
+    """Task ID for imported cache objects, keyed by content digest not URL.
+
+    piece_size is part of the identity: the id alone determines the task's
+    piece geometry cluster-wide, so two publishers of identical bytes with
+    different piece sizes must land on DIFFERENT tasks — merging them would
+    hand children one index-keyed digest map spanning two geometries."""
     h = hashlib.sha256()
     h.update(content_digest.encode())
     h.update(b"\x00")
     h.update(tag.encode())
     h.update(b"\x00")
     h.update(application.encode())
+    h.update(b"\x00")
+    h.update(str(piece_size).encode())
     return h.hexdigest()
 
 

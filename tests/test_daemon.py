@@ -225,6 +225,38 @@ class TestStorage:
 
         run(body())
 
+    def test_metadata_persistence_debounced(self, run, tmp_path):
+        """Piece writes batch their metadata persistence (a JSON+rename per
+        piece was the top cost of checkpoint fan-out); completion and explicit
+        flush always persist, and a flushed snapshot restores every bit."""
+
+        async def body():
+            sm = StorageManager(tmp_path)
+            ts = sm.register_task("d" * 64, url="http://x/d")
+            n = 40
+            ts.set_task_info(content_length=n * 4, piece_size=4, total_pieces=n)
+            saves = 0
+            orig = ts.save_metadata
+
+            def counting_save():
+                nonlocal saves
+                saves += 1
+                orig()
+
+            ts.save_metadata = counting_save
+            for i in range(n - 1):
+                await ts.write_piece(i, b"abcd")
+            assert saves < n - 1  # debounced: far fewer saves than writes
+            ts.flush_metadata()
+            restored = StorageManager(tmp_path).get("d" * 64)
+            assert restored.finished_count() == n - 1  # flush captured all bits
+            saves_before_last = saves
+            await ts.write_piece(n - 1, b"abcd")  # completion forces a save
+            assert saves == saves_before_last + 1
+            assert StorageManager(tmp_path).get("d" * 64).is_complete()
+
+        run(body())
+
     def test_reuse_and_persistence(self, run, tmp_path):
         async def body():
             sm = StorageManager(tmp_path)

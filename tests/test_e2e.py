@@ -197,6 +197,45 @@ class TestE2E:
 
         run(body())
 
+    def test_p2p_skips_redundant_full_verify(self, run, tmp_path, payload, monkeypatch):
+        """A p2p download whose every piece was validated against an expected
+        digest skips the end-of-task full re-hash (one whole read+hash pass
+        per task — seconds per checkpoint shard); back-to-source, which
+        computes its own digests, still runs it."""
+        from dragonfly2_tpu.daemon.storage import TaskStorage
+
+        calls = []
+        orig = TaskStorage.verify
+
+        def counting_verify(self):
+            calls.append(self.meta.task_id)
+            return orig(self)
+
+        monkeypatch.setattr(TaskStorage, "verify", counting_verify)
+
+        async def body():
+            svc = SchedulerService(telemetry=TelemetryStorage(tmp_path / "telemetry"))
+            client = InProcessSchedulerClient(svc)
+            async with Origin({"model.bin": payload}) as origin:
+                e1 = make_engine(tmp_path, client, "peer1")
+                e2 = make_engine(tmp_path, client, "peer2")
+                await e1.start()
+                await e2.start()
+                try:
+                    url = origin.url("model.bin")
+                    await e1.download_task(url)
+                    assert len(calls) >= 1  # back-to-source verified in full
+                    before_p2p = len(calls)
+                    out = tmp_path / "dl2.bin"
+                    await e2.download_task(url, output=out)
+                    assert out.read_bytes() == payload
+                    assert len(calls) == before_p2p  # p2p path: no second pass
+                finally:
+                    await e1.stop()
+                    await e2.stop()
+
+        run(body())
+
     def test_seed_peer_trigger(self, run, tmp_path, payload):
         async def body():
             svc = SchedulerService()

@@ -118,6 +118,12 @@ class TestFanout:
                     assert got == want
                 # second fetch is a no-op (digest match short-circuit)
                 await fetch_checkpoint(sub, m2, dest)
+                # checkpoint tasks use the checkpoint-tuned piece size, not
+                # the generic ladder (fewer per-piece round-trips on fan-out)
+                from dragonfly2_tpu.tpuvm.checkpoint import CHECKPOINT_PIECE_SIZE
+
+                ts = pub.storage.get(manifest.files[0].task_id)
+                assert ts.meta.piece_size == CHECKPOINT_PIECE_SIZE
             finally:
                 await pub.stop()
                 await sub.stop()
