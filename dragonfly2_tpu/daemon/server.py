@@ -317,6 +317,17 @@ async def run_daemon(
                 "s3", endpoint=s3cfg.endpoint, access_key=s3cfg.access_key,
                 secret_key=s3cfg.secret_key, region=s3cfg.region,
             )
+        elif object_storage_backend in ("oss", "obs"):
+            # the vendors' env conventions (ALIBABA/HUAWEI cloud CLIs)
+            p = object_storage_backend.upper()
+            backend = new_backend(
+                object_storage_backend,
+                endpoint=os.environ.get(f"{p}_ENDPOINT", ""),
+                access_key=os.environ.get(f"{p}_ACCESS_KEY_ID", ""),
+                secret_key=os.environ.get(
+                    f"{p}_ACCESS_KEY_SECRET", os.environ.get(f"{p}_SECRET_ACCESS_KEY", "")
+                ),
+            )
         else:
             backend = new_backend(
                 "fs", root=object_storage_root or (str(storage_root) + "-objects")
@@ -460,8 +471,9 @@ def main() -> None:
     ap.add_argument("--object-storage-root", default=cfg.object_storage.root,
                     help="fs backend root (default: <storage>-objects)")
     ap.add_argument("--object-storage-backend", default=cfg.object_storage.backend,
-                    choices=["fs", "s3"],
-                    help="object store behind the gateway; s3 reads AWS_* env vars")
+                    choices=["fs", "s3", "oss", "obs"],
+                    help="object store behind the gateway; s3 reads AWS_* env "
+                         "vars, oss reads OSS_*, obs reads OBS_*")
     ap.add_argument("--rpc-port", type=int, default=cfg.rpc_port,
                     help="TCP RPC port (seed peers always listen; 0 = ephemeral)")
     ap.add_argument("--manager", default=cfg.manager, help="manager address host:port")
@@ -478,11 +490,19 @@ def main() -> None:
                     help="per-component rotating log files (console only when unset)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
-    if args.object_storage_backend == "s3":
+    if args.object_storage_backend != "fs":
         if args.object_storage_root:
             ap.error("--object-storage-root applies to the fs backend only")
-        if not (os.environ.get("AWS_ENDPOINT_URL") or os.environ.get("DF_S3_ENDPOINT")):
-            ap.error("--object-storage-backend s3 requires AWS_ENDPOINT_URL in the environment")
+        required = {
+            "s3": ("AWS_ENDPOINT_URL", "DF_S3_ENDPOINT"),
+            "oss": ("OSS_ENDPOINT",),
+            "obs": ("OBS_ENDPOINT",),
+        }[args.object_storage_backend]
+        if not any(os.environ.get(v) for v in required):
+            ap.error(
+                f"--object-storage-backend {args.object_storage_backend} "
+                f"requires {required[0]} in the environment"
+            )
     from dragonfly2_tpu.utils.dflog import setup_logging
 
     setup_logging(args.log_dir, level=logging.DEBUG if args.verbose else logging.INFO)

@@ -38,6 +38,7 @@ class ManagerServer:
         auth_secret: str | None = None,
         admin_password: str | None = None,
         object_storage_dir: str | None = None,
+        object_storage=None,
     ):
         self.db = Database(db_path)
         self.service = ManagerService(self.db, keepalive_ttl=keepalive_ttl)
@@ -48,8 +49,10 @@ class ManagerServer:
 
             self.ca = CertificateAuthority(ca_dir)
         self.auth_secret = auth_secret
-        self.object_storage = None
-        if object_storage_dir:
+        # any registry backend instance (fs/s3/oss/obs) may be injected;
+        # object_storage_dir remains the fs convenience path
+        self.object_storage = object_storage
+        if self.object_storage is None and object_storage_dir:
             from dragonfly2_tpu.objectstorage.backend import LocalFSBackend
 
             self.object_storage = LocalFSBackend(object_storage_dir)

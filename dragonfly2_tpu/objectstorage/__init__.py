@@ -6,17 +6,23 @@ without S3-compatible storage (and for tests; this container has no egress)."""
 from dragonfly2_tpu.objectstorage.backend import (
     Bucket,
     LocalFSBackend,
+    OBSBackend,
     ObjectMetadata,
     ObjectStorageBackend,
     ObjectStorageError,
+    OSSBackend,
+    S3Backend,
     new_backend,
 )
 
 __all__ = [
     "Bucket",
     "LocalFSBackend",
+    "OBSBackend",
     "ObjectMetadata",
     "ObjectStorageBackend",
     "ObjectStorageError",
+    "OSSBackend",
+    "S3Backend",
     "new_backend",
 ]

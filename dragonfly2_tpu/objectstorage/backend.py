@@ -429,7 +429,145 @@ class S3Backend(ObjectStorageBackend):
         await self._client.close()
 
 
-_BACKENDS = {"fs": LocalFSBackend, "s3": S3Backend}
+class _OssObsBackend(ObjectStorageBackend):
+    """Shared OSS/OBS adapter over the in-repo legacy header-signing client
+    (objectstorage/ossobs.py) — the bucket-management path the reference
+    serves via the vendor SDKs (pkg/objectstorage/oss.go:1-219,
+    obs.go:1-227). Subclasses pin the dialect."""
+
+    def __init__(self, *, endpoint: str, access_key: str, secret_key: str):
+        from dragonfly2_tpu.objectstorage.ossobs import (
+            OBS_DIALECT,
+            OSS_DIALECT,
+            DialectConfig,
+            OssObsClient,
+        )
+
+        self._client = OssObsClient(
+            DialectConfig(endpoint=endpoint, access_key=access_key, secret_key=secret_key),
+            OSS_DIALECT if self.name == "oss" else OBS_DIALECT,
+        )
+
+    @staticmethod
+    def _wrap(e: Exception) -> ObjectStorageError:
+        from dragonfly2_tpu.objectstorage.ossobs import DialectError
+
+        if isinstance(e, DialectError):
+            if e.status == 404:
+                return ObjectStorageError(str(e), code="not_found")
+            if e.status == 409 or "Exist" in e.code:
+                return ObjectStorageError(str(e), code="already_exists")
+            return ObjectStorageError(str(e), code="invalid" if e.status < 500 else "internal")
+        return ObjectStorageError(str(e))
+
+    async def create_bucket(self, bucket: str) -> None:
+        try:
+            await self._client.create_bucket(bucket)
+        except Exception as e:
+            raise self._wrap(e) from e
+
+    async def delete_bucket(self, bucket: str) -> None:
+        try:
+            await self._client.delete_bucket(bucket)
+        except Exception as e:
+            raise self._wrap(e) from e
+
+    async def list_buckets(self) -> list[Bucket]:
+        try:
+            return [Bucket(name=n) for n in await self._client.list_buckets()]
+        except Exception as e:
+            raise self._wrap(e) from e
+
+    async def bucket_exists(self, bucket: str) -> bool:
+        try:
+            return await self._client.bucket_exists(bucket)
+        except Exception as e:
+            raise self._wrap(e) from e
+
+    async def put_object(
+        self,
+        bucket: str,
+        key: str,
+        data: Union[bytes, AsyncIterator[bytes]],
+        *,
+        content_type: str = "application/octet-stream",
+        user_metadata: dict | None = None,
+    ) -> ObjectMetadata:
+        _safe_key(key)
+        if not isinstance(data, (bytes, bytearray)):
+            # the dialect's legacy signing has no UNSIGNED-PAYLOAD mode;
+            # buffer the stream (multipart upload is the real fix at scale)
+            buf = bytearray()
+            async for chunk in data:
+                buf.extend(chunk)
+            data = bytes(buf)
+        try:
+            etag = await self._client.put_object(
+                bucket, key, bytes(data),
+                content_type=content_type, user_metadata=user_metadata,
+            )
+        except Exception as e:
+            raise self._wrap(e) from e
+        return ObjectMetadata(
+            key=key,
+            content_length=len(data),
+            digest=f"sha256:{hashlib.sha256(bytes(data)).hexdigest()}",
+            etag=etag,
+            content_type=content_type,
+            last_modified=time.time(),
+            user_metadata=dict(user_metadata or {}),
+        )
+
+    async def get_object(self, bucket: str, key: str) -> bytes:
+        try:
+            return await self._client.get_object(bucket, key)
+        except Exception as e:
+            raise self._wrap(e) from e
+
+    async def stat_object(self, bucket: str, key: str) -> ObjectMetadata:
+        try:
+            obj = await self._client.head_object(bucket, key)
+        except Exception as e:
+            raise self._wrap(e) from e
+        return ObjectMetadata(
+            key=key,
+            content_length=obj.size,
+            etag=obj.etag,
+            content_type=obj.content_type or "application/octet-stream",
+            user_metadata=dict(obj.user_metadata),
+        )
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        try:
+            await self._client.delete_object(bucket, key)
+        except Exception as e:
+            raise self._wrap(e) from e
+
+    async def list_objects(
+        self, bucket: str, prefix: str = "", limit: int | None = None
+    ) -> list[ObjectMetadata]:
+        try:
+            res = await self._client.list_objects(bucket, prefix=prefix, limit=limit)
+        except Exception as e:
+            raise self._wrap(e) from e
+        return [ObjectMetadata(key=o.key, content_length=o.size, etag=o.etag) for o in res]
+
+    def presign_get(self, bucket: str, key: str) -> str:
+        return self._client.presign_get(bucket, key)
+
+    async def close(self) -> None:
+        await self._client.close()
+
+
+class OSSBackend(_OssObsBackend):
+    name = "oss"
+
+
+class OBSBackend(_OssObsBackend):
+    name = "obs"
+
+
+_BACKENDS = {"fs": LocalFSBackend, "s3": S3Backend, "oss": OSSBackend, "obs": OBSBackend}
 
 
 def new_backend(name: str, **kwargs) -> ObjectStorageBackend:

@@ -1,0 +1,197 @@
+"""In-memory OSS/OBS fixture server with legacy HMAC-SHA1 verification.
+
+Stands in for Aliyun OSS / Huawei OBS in tests (zero egress): implements the
+bucket/object subset the framework's dialect client uses and REJECTS requests
+whose ``Authorization: OSS|OBS ak:sig`` header (or presigned-URL Signature)
+does not verify against the expected string-to-sign — so the client's
+canonicalization (provider-header sorting, resource path, Expires presign)
+is actually exercised, per dialect.
+"""
+
+from __future__ import annotations
+
+import time
+
+from aiohttp import web
+
+from dragonfly2_tpu.objectstorage.ossobs import Dialect, sign, string_to_sign
+
+
+class FakeOssObs:
+    def __init__(
+        self,
+        dialect: Dialect,
+        *,
+        access_key: str = "testkey",
+        secret_key: str = "testsecret",
+    ):
+        self.dialect = dialect
+        self.access_key = access_key
+        self.secret_key = secret_key
+        # bucket -> key -> (body, content_type, user_metadata)
+        self.buckets: dict[str, dict[str, tuple[bytes, str, dict]]] = {}
+        self.port = 0
+        self._runner = None
+
+    async def __aenter__(self):
+        app = web.Application()
+        app.router.add_route("*", "/", self._root)
+        app.router.add_route("*", "/{bucket}", self._bucket)
+        app.router.add_route("*", "/{bucket}/{key:.+}", self._object)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return self
+
+    async def __aexit__(self, *exc):
+        await self._runner.cleanup()
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    # ---- auth ----
+
+    def _resource(self, request: web.Request) -> str:
+        bucket = request.match_info.get("bucket", "")
+        key = request.match_info.get("key", "")
+        r = "/"
+        if bucket:
+            r += bucket + "/"
+            if key:
+                r += key
+        return r
+
+    def _verify(self, request: web.Request) -> web.Response | None:
+        q = request.rel_url.query
+        if "Signature" in q:  # presigned URL
+            if q.get(self.dialect.presign_key_param) != self.access_key:
+                return self._err(403, "InvalidAccessKeyId")
+            expires = q.get("Expires", "0")
+            if int(expires) < time.time():
+                return self._err(403, "AccessDenied", "expired")
+            sts = string_to_sign(
+                request.method, self._resource(request),
+                date=expires, dialect=self.dialect,
+            )
+            if q["Signature"] != sign(self.secret_key, sts):
+                return self._err(403, "SignatureDoesNotMatch", "presign")
+            return None
+        auth = request.headers.get("Authorization", "")
+        label, _, cred = auth.partition(" ")
+        if label != self.dialect.label:
+            return self._err(403, "AccessDenied", f"scheme {label!r}")
+        ak, _, sig = cred.partition(":")
+        if ak != self.access_key:
+            return self._err(403, "InvalidAccessKeyId")
+        sts = string_to_sign(
+            request.method,
+            self._resource(request),
+            date=request.headers.get("Date", ""),
+            dialect=self.dialect,
+            content_md5=request.headers.get("Content-MD5", ""),
+            content_type=request.headers.get("Content-Type", ""),
+            headers=dict(request.headers),
+        )
+        if sig != sign(self.secret_key, sts):
+            return self._err(403, "SignatureDoesNotMatch")
+        return None
+
+    @staticmethod
+    def _err(status: int, code: str, msg: str = "") -> web.Response:
+        return web.Response(
+            status=status,
+            content_type="application/xml",
+            text=f"<Error><Code>{code}</Code><Message>{msg}</Message></Error>",
+        )
+
+    # ---- handlers ----
+
+    async def _root(self, request: web.Request) -> web.Response:
+        if (deny := self._verify(request)) is not None:
+            return deny
+        if request.method != "GET":
+            return self._err(405, "MethodNotAllowed")
+        rows = "".join(f"<Bucket><Name>{b}</Name></Bucket>" for b in sorted(self.buckets))
+        return web.Response(
+            content_type="application/xml",
+            text=f"<ListAllMyBucketsResult><Buckets>{rows}</Buckets></ListAllMyBucketsResult>",
+        )
+
+    async def _bucket(self, request: web.Request) -> web.Response:
+        if (deny := self._verify(request)) is not None:
+            return deny
+        b = request.match_info["bucket"]
+        if request.method == "PUT":
+            if b in self.buckets:
+                return self._err(409, "BucketAlreadyExists")
+            self.buckets[b] = {}
+            return web.Response(status=200)
+        if b not in self.buckets:
+            return self._err(404, "NoSuchBucket")
+        if request.method == "HEAD":
+            return web.Response(status=200)
+        if request.method == "DELETE":
+            if self.buckets[b]:
+                return self._err(409, "BucketNotEmpty")
+            del self.buckets[b]
+            return web.Response(status=204)
+        if request.method == "GET":  # list objects
+            prefix = request.rel_url.query.get("prefix", "")
+            limit = int(request.rel_url.query.get("max-keys", "1000"))
+            rows = []
+            for k in sorted(self.buckets[b]):
+                if k.startswith(prefix):
+                    body, _, _ = self.buckets[b][k]
+                    rows.append(
+                        f"<Contents><Key>{k}</Key><Size>{len(body)}</Size>"
+                        f"<ETag>&quot;{len(body):x}etag&quot;</ETag></Contents>"
+                    )
+                    if len(rows) >= limit:
+                        break
+            return web.Response(
+                content_type="application/xml",
+                text=f"<ListBucketResult>{''.join(rows)}</ListBucketResult>",
+            )
+        return self._err(405, "MethodNotAllowed")
+
+    async def _object(self, request: web.Request) -> web.Response:
+        if (deny := self._verify(request)) is not None:
+            return deny
+        b, k = request.match_info["bucket"], request.match_info["key"]
+        if b not in self.buckets:
+            return self._err(404, "NoSuchBucket")
+        meta_prefix = f"{self.dialect.header_prefix}meta-"
+        if request.method == "PUT":
+            body = await request.read()
+            um = {
+                name[len(meta_prefix):]: v
+                for name, v in request.headers.items()
+                if name.lower().startswith(meta_prefix)
+            }
+            self.buckets[b][k] = (
+                body, request.headers.get("Content-Type", ""), um,
+            )
+            return web.Response(status=200, headers={"ETag": f'"{len(body):x}etag"'})
+        if k not in self.buckets[b]:
+            if request.method == "DELETE":
+                return web.Response(status=204)  # idempotent
+            return self._err(404, "NoSuchKey")
+        body, ctype, um = self.buckets[b][k]
+        if request.method == "DELETE":
+            del self.buckets[b][k]
+            return web.Response(status=204)
+        headers = {
+            "ETag": f'"{len(body):x}etag"',
+            "Content-Type": ctype or "application/octet-stream",
+        }
+        for name, v in um.items():
+            headers[f"{meta_prefix}{name}"] = v
+        if request.method == "HEAD":
+            headers["Content-Length"] = str(len(body))
+            return web.Response(status=200, headers=headers)
+        if request.method == "GET":
+            return web.Response(status=200, body=body, headers=headers)
+        return self._err(405, "MethodNotAllowed")
