@@ -10,6 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Optional
 
+from dragonfly2_tpu.observability.tracing import TracingSection
 from dragonfly2_tpu.utils.config import cfgfield
 
 
@@ -65,6 +66,7 @@ class DaemonYaml:
     proxy: ProxySection = cfgfield(default_factory=ProxySection)
     object_storage: ObjectStorageSection = cfgfield(default_factory=ObjectStorageSection)
     rate_limit: RateLimitSection = cfgfield(default_factory=RateLimitSection)
+    tracing: TracingSection = cfgfield(default_factory=TracingSection)
 
     def validate_extra(self, path: str) -> None:
         from dragonfly2_tpu.utils.config import ConfigError

@@ -503,9 +503,14 @@ def main() -> None:
                 f"--object-storage-backend {args.object_storage_backend} "
                 f"requires {required[0]} in the environment"
             )
+    from dragonfly2_tpu.observability.tracing import configure_default_tracer
     from dragonfly2_tpu.utils.dflog import setup_logging
 
     setup_logging(args.log_dir, level=logging.DEBUG if args.verbose else logging.INFO)
+    configure_default_tracer(
+        "dragonfly-daemon",
+        otlp_file=cfg.tracing.otlp_file, otlp_endpoint=cfg.tracing.otlp_endpoint,
+    )
     asyncio.run(
         run_daemon(
             scheduler_addr=args.scheduler,

@@ -10,6 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
+from dragonfly2_tpu.observability.tracing import TracingSection
 from dragonfly2_tpu.utils.config import cfgfield
 
 
@@ -33,4 +34,8 @@ class ManagerYaml:
     object_storage_dir: Optional[str] = cfgfield(
         None, help="enable buckets CRUD backed by this fs dir"
     )
+    searcher: str = cfgfield(
+        "default", help='cluster searcher: "default" or "plugin:pkg.mod:attr"'
+    )
     security: SecuritySection = cfgfield(default_factory=SecuritySection)
+    tracing: TracingSection = cfgfield(default_factory=TracingSection)

@@ -91,3 +91,30 @@ def find_scheduler_clusters(
     if has_active_schedulers is not None:
         clusters = [c for c in clusters if has_active_schedulers.get(c["id"])]
     return sorted(clusters, key=lambda c: evaluate(ip, conditions, c), reverse=True)
+
+
+def new_searcher(spec: str = "default"):
+    """Searcher factory (ref manager/searcher/plugin.go:1-39 LoadPlugin):
+    "default" serves this module's linear blend; "plugin:pkg.mod:attr" loads
+    an external searcher by import path — the Python-native equivalent of the
+    reference's dlopen'd manager plugin — duck-checked at boot so a typo'd
+    spec fails at start, not at first peer discovery."""
+    import sys
+
+    if spec.startswith("plugin:"):
+        from dragonfly2_tpu.utils.plugins import load_object, require_methods
+
+        obj = load_object(spec[len("plugin:"):])
+        require_methods(
+            obj, ("find_scheduler_clusters",), spec=spec, kind="searcher"
+        )
+        return obj
+    if spec != "default":
+        # a typo'd spec ("plug:...", "custom") must fail AT BOOT, not
+        # silently rank every discovery with the default blend
+        from dragonfly2_tpu.utils.plugins import PluginError
+
+        raise PluginError(
+            f"unknown searcher {spec!r}: want 'default' or 'plugin:pkg.mod:attr'"
+        )
+    return sys.modules[__name__]  # the module itself is the default searcher

@@ -39,9 +39,12 @@ class ManagerServer:
         admin_password: str | None = None,
         object_storage_dir: str | None = None,
         object_storage=None,
+        searcher: str = "default",
     ):
         self.db = Database(db_path)
-        self.service = ManagerService(self.db, keepalive_ttl=keepalive_ttl)
+        self.service = ManagerService(
+            self.db, keepalive_ttl=keepalive_ttl, searcher_spec=searcher
+        )
         self.jobs = JobQueue(self.db)
         self.ca = None
         if ca_dir:
@@ -122,6 +125,7 @@ async def amain(args: argparse.Namespace) -> None:
         ca_dir=args.ca_dir, cert_token=args.cert_token,
         auth_secret=args.auth_secret, admin_password=args.admin_password,
         object_storage_dir=args.object_storage_dir,
+        searcher=args.searcher,
     )
     await server.start()
     print(f"manager ready rpc={server.address} rest={server.rest_port}", flush=True)
@@ -163,14 +167,21 @@ def main() -> None:
                    help="bootstrap the admin user on first start")
     p.add_argument("--object-storage-dir", default=cfg.object_storage_dir,
                    help="enable buckets CRUD backed by this fs dir")
+    p.add_argument("--searcher", default=cfg.searcher,
+                   help='cluster searcher: "default" or "plugin:pkg.mod:attr"')
     p.add_argument("--keepalive-ttl", type=float, default=cfg.keepalive_ttl)
     p.add_argument("--log-dir", default=cfg.log_dir,
                    help="per-component rotating log files (console only when unset)")
     p.add_argument("-v", "--verbose", action="store_true")
     args = p.parse_args()
+    from dragonfly2_tpu.observability.tracing import configure_default_tracer
     from dragonfly2_tpu.utils.dflog import setup_logging
 
     setup_logging(args.log_dir, level=logging.DEBUG if args.verbose else logging.INFO)
+    configure_default_tracer(
+        "dragonfly-manager",
+        otlp_file=cfg.tracing.otlp_file, otlp_endpoint=cfg.tracing.otlp_endpoint,
+    )
     asyncio.run(amain(args))
 
 

@@ -30,9 +30,17 @@ DEFAULT_KEEPALIVE_TTL = 60.0  # reference reaps on stream close; we reap on TTL
 
 
 class ManagerService:
-    def __init__(self, db: Database | None = None, *, keepalive_ttl: float = DEFAULT_KEEPALIVE_TTL):
+    def __init__(
+        self,
+        db: Database | None = None,
+        *,
+        keepalive_ttl: float = DEFAULT_KEEPALIVE_TTL,
+        searcher_spec: str = "default",
+    ):
         self.db = db or Database()
         self.keepalive_ttl = keepalive_ttl
+        # cluster-scoring is plugin-overridable (ref searcher/plugin.go)
+        self.searcher = searcher.new_searcher(searcher_spec)
         self._reaper_task: asyncio.Task | None = None
 
     # ---------- scheduler clusters ----------
@@ -169,7 +177,7 @@ class ManagerService:
         active: dict[int, list[dict]] = {}
         for s in self.db.find("schedulers", state=STATE_ACTIVE):
             active.setdefault(s["scheduler_cluster_id"], []).append(s)
-        ranked = searcher.find_scheduler_clusters(
+        ranked = self.searcher.find_scheduler_clusters(
             clusters, ip, conditions,
             has_active_schedulers={cid: True for cid in active},
         )

@@ -128,22 +128,96 @@ class Span:
         }
 
 
+def _otlp_value(v: Any) -> dict:
+    """Python value → OTLP AnyValue."""
+    if isinstance(v, bool):
+        return {"boolValue": v}
+    if isinstance(v, int):
+        return {"intValue": str(v)}  # int64 is a JSON string per OTLP spec
+    if isinstance(v, float):
+        return {"doubleValue": v}
+    return {"stringValue": str(v)}
+
+
+def spans_to_otlp(spans: list["Span"], service: str) -> dict:
+    """Batch of finished spans → one OTLP/JSON ExportTraceServiceRequest —
+    the body Jaeger's (and any collector's) OTLP HTTP ingest accepts on
+    POST /v1/traces (the reference bootstraps a Jaeger exporter via --jaeger,
+    cmd/dependency/dependency.go:72-95; this is its collector-compatible
+    equivalent without an SDK dependency)."""
+    status_code = {"ok": 1, "error": 2}
+    return {
+        "resourceSpans": [
+            {
+                "resource": {
+                    "attributes": [
+                        {"key": "service.name", "value": {"stringValue": service}}
+                    ]
+                },
+                "scopeSpans": [
+                    {
+                        "scope": {"name": "dragonfly2_tpu.observability"},
+                        "spans": [
+                            {
+                                "traceId": s.trace_id,
+                                "spanId": s.span_id,
+                                **(
+                                    {"parentSpanId": s.parent_id}
+                                    if s.parent_id
+                                    else {}
+                                ),
+                                "name": s.name,
+                                "kind": 1,  # SPAN_KIND_INTERNAL
+                                "startTimeUnixNano": str(int(s.start * 1e9)),
+                                "endTimeUnixNano": str(int(s.end * 1e9)),
+                                "attributes": [
+                                    {"key": k, "value": _otlp_value(v)}
+                                    for k, v in s.attrs.items()
+                                ],
+                                "status": (
+                                    {"code": status_code.get(s.status, 0)}
+                                    | ({"message": s.error} if s.error else {})
+                                ),
+                            }
+                            for s in spans
+                        ],
+                    }
+                ],
+            }
+        ]
+    }
+
+
 @dataclass
 class Tracer:
     """Per-process tracer. `service` tags every span; spans export to an
-    in-memory ring always, and to a JSON-lines file when `path` is set
-    (DRAGONFLY_TRACE_FILE env overrides)."""
+    in-memory ring always, to a JSON-lines file when `path` is set
+    (DRAGONFLY_TRACE_FILE env overrides), and — when `otlp_path` or
+    `otlp_endpoint` is set — as OTLP/JSON ExportTraceServiceRequest batches
+    (one request per line in the file; HTTP POST to <endpoint>/v1/traces for
+    the endpoint, e.g. a Jaeger collector's OTLP port)."""
 
     service: str = "dragonfly"
     path: str = ""
+    otlp_path: str = ""
+    otlp_endpoint: str = ""
+    otlp_batch: int = 64
     ring_size: int = 2048
     _ring: deque = field(default_factory=lambda: deque(maxlen=2048), repr=False)
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
     _fh: Any = field(default=None, repr=False)
+    _otlp_fh: Any = field(default=None, repr=False)
+    _otlp_buf: list = field(default_factory=list, repr=False)
+    _otlp_queue: Any = field(default=None, repr=False)
+    _otlp_worker: Any = field(default=None, repr=False)
 
     def __post_init__(self) -> None:
         self._ring = deque(maxlen=self.ring_size)
         self.path = self.path or os.environ.get("DRAGONFLY_TRACE_FILE", "")
+        self.otlp_path = self.otlp_path or os.environ.get("DRAGONFLY_OTLP_FILE", "")
+        self.otlp_endpoint = self.otlp_endpoint or os.environ.get(
+            "DRAGONFLY_OTLP_ENDPOINT", ""
+        )
 
     def span(self, name: str, parent: SpanContext | None = None, **attrs: Any) -> Span:
         """Open a span. Parent resolution: explicit remote context > current
@@ -177,6 +251,74 @@ class Tracer:
                     # event loop on a contended disk
                     self._fh = open(self.path, "a", encoding="utf-8", buffering=1 << 16)
                 self._fh.write(json.dumps(span.to_dict()) + "\n")
+            if self.otlp_path or self.otlp_endpoint:
+                self._otlp_buf.append(span)
+                if len(self._otlp_buf) >= self.otlp_batch:
+                    self._flush_otlp_locked()
+
+    def _flush_otlp_locked(self, *, sync: bool = False) -> None:
+        if not self._otlp_buf:
+            return
+        batch, self._otlp_buf = self._otlp_buf, []
+        req = spans_to_otlp(batch, self.service)
+        if self.otlp_path:
+            if self._otlp_fh is None:
+                self._otlp_fh = open(
+                    self.otlp_path, "a", encoding="utf-8", buffering=1 << 16
+                )
+            self._otlp_fh.write(json.dumps(req) + "\n")
+        if self.otlp_endpoint:
+            if sync:
+                # shutdown path: POST in the caller's thread so the final
+                # batch lands before the interpreter exits
+                self._post_otlp(req)
+            else:
+                # ONE long-lived exporter thread drains a bounded queue: a
+                # slow/unreachable collector must cost a constant (dropped
+                # batches), never an unbounded thread pile-up
+                self._ensure_otlp_worker()
+                try:
+                    self._otlp_queue.put_nowait(req)
+                except Exception:  # queue full — drop, don't block the loop
+                    pass
+
+    def _ensure_otlp_worker(self) -> None:
+        if self._otlp_worker is None or not self._otlp_worker.is_alive():
+            import queue
+
+            if self._otlp_queue is None:
+                self._otlp_queue = queue.Queue(maxsize=64)
+            self._otlp_worker = threading.Thread(
+                target=self._otlp_worker_loop, daemon=True
+            )
+            self._otlp_worker.start()
+
+    def _otlp_worker_loop(self) -> None:
+        while True:
+            req = self._otlp_queue.get()
+            if req is None:
+                return
+            self._post_otlp(req)
+
+    def _post_otlp(self, req: dict) -> None:
+        import urllib.request
+
+        try:
+            r = urllib.request.Request(
+                self.otlp_endpoint.rstrip("/") + "/v1/traces",
+                data=json.dumps(req).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            urllib.request.urlopen(r, timeout=10).close()
+        except Exception:  # noqa: BLE001 — tracing must never take a service down
+            pass
+
+    def flush_otlp(self, *, sync: bool = False) -> None:
+        """Force out any buffered OTLP batch (shutdown / tests)."""
+        with self._lock:
+            self._flush_otlp_locked(sync=sync)
+            if self._otlp_fh is not None:
+                self._otlp_fh.flush()
 
     def finished(self) -> list[Span]:
         with self._lock:
@@ -184,10 +326,19 @@ class Tracer:
 
     def close(self) -> None:
         with self._lock:
+            self._flush_otlp_locked(sync=True)
+            if self._otlp_worker is not None and self._otlp_queue is not None:
+                self._otlp_queue.put(None)  # drain-then-exit sentinel
+                self._otlp_worker.join(timeout=10)
+                self._otlp_worker = None
             if self._fh is not None:
                 self._fh.flush()
                 self._fh.close()
                 self._fh = None
+            if self._otlp_fh is not None:
+                self._otlp_fh.flush()
+                self._otlp_fh.close()
+                self._otlp_fh = None
 
 
 _default = Tracer()
@@ -195,3 +346,45 @@ _default = Tracer()
 
 def default_tracer() -> Tracer:
     return _default
+
+
+from dragonfly2_tpu.utils.config import cfgfield  # noqa: E402 — section schema below
+
+
+@dataclass
+class TracingSection:
+    """YAML `tracing:` section shared by scheduler/daemon/manager configs —
+    the validated-config equivalent of the reference's --jaeger flag
+    (cmd/dependency/dependency.go:72-95)."""
+
+    otlp_file: Optional[str] = cfgfield(
+        None, help="append OTLP/JSON trace batches to this file"
+    )
+    otlp_endpoint: Optional[str] = cfgfield(
+        None,
+        help="POST OTLP/JSON batches to this collector base URL "
+             "(e.g. http://jaeger:4318)",
+    )
+
+
+def configure_default_tracer(
+    service: str = "",
+    *,
+    otlp_file: str | None = None,
+    otlp_endpoint: str | None = None,
+) -> Tracer:
+    """Apply config-surface tracing options to the process tracer at boot.
+    Registers an atexit close so partially-filled OTLP batches flush on
+    shutdown — a low-traffic process must not export nothing."""
+    import atexit
+
+    t = default_tracer()
+    if service:
+        t.service = service
+    if otlp_file:
+        t.otlp_path = otlp_file
+    if otlp_endpoint:
+        t.otlp_endpoint = otlp_endpoint
+    if otlp_file or otlp_endpoint:
+        atexit.register(t.close)
+    return t

@@ -10,6 +10,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
+from dragonfly2_tpu.observability.tracing import TracingSection
 from dragonfly2_tpu.utils.config import cfgfield
 
 
@@ -51,6 +52,7 @@ class SchedulerYaml:
     trainer_interval: Optional[float] = cfgfield(None, minimum=1.0)
     scheduling: SchedulingSection = cfgfield(default_factory=SchedulingSection)
     gc: GCSection = cfgfield(default_factory=GCSection)
+    tracing: TracingSection = cfgfield(default_factory=TracingSection)
 
     def validate_extra(self, path: str) -> None:
         from dragonfly2_tpu.utils.config import ConfigError

@@ -125,6 +125,35 @@ class MLEvaluator(Evaluator):
         self._node_index = node_index or {}
         self._microbatch = None
         self.refreshed_at: float | None = None
+        self._set_serving_mode(self._mode_of(scorer) if scorer is not None else "base")
+
+    @staticmethod
+    def _mode_of(scorer) -> str:
+        # the native scorer is the only one with the multi-round FFI entry
+        return "native" if hasattr(scorer, "score_rounds") else "jax"
+
+    @staticmethod
+    def _set_serving_mode(mode: str) -> None:
+        """Expose the active scoring implementation: a missing g++ or failed
+        artifact load drops serving from the 10k-calls/s native SLO to the
+        ~1.5k jax fallback, which must be visible before the SLO is."""
+        from dragonfly2_tpu.scheduler import metrics
+
+        for m in ("native", "jax", "base"):
+            metrics.ML_SERVING_MODE.set(1.0 if m == mode else 0.0, mode=m)
+        log = logger.warning if mode != "native" else logger.info
+        log(
+            "ml evaluator serving mode: %s%s", mode,
+            "" if mode == "native"
+            else " (native 10k-calls/s scorer NOT active — jax fallback serves"
+                 " ~1.5k calls/s, base is numpy)",
+        )
+
+    @staticmethod
+    def _count_fallback(reason: str) -> None:
+        from dragonfly2_tpu.scheduler import metrics
+
+        metrics.ML_BASE_FALLBACK_TOTAL.inc(reason=reason)
 
     def attach_scorer(self, scorer, node_index: dict[str, int], *, microbatch=None) -> None:
         """Hot-swap the model (called when the trainer publishes a version);
@@ -144,6 +173,7 @@ class MLEvaluator(Evaluator):
         self._microbatch = microbatch
         self.refreshed_at = time.time()
         metrics.ML_EMBEDDINGS_REFRESH_TIMESTAMP.set(self.refreshed_at)
+        self._set_serving_mode(self._mode_of(scorer))
 
     def embeddings_age_s(self) -> float | None:
         """Seconds since the serving embeddings were refreshed (staleness);
@@ -170,15 +200,20 @@ class MLEvaluator(Evaluator):
         return base, feats, c, p, known
 
     def evaluate(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:
-        if not parents or not getattr(self._scorer, "ready", False):
+        if not parents:
+            return super().evaluate(child, parents)
+        if not getattr(self._scorer, "ready", False):
+            self._count_fallback("no_scorer")
             return super().evaluate(child, parents)
         base, feats, c, p, known = self._prepare(child, parents)
         if feats is None:
+            self._count_fallback("unknown_hosts")
             return base
         try:
             ml = self._scorer.score(feats, child=c, parent=p)
         except Exception:
             logger.exception("ml scorer failed; using base evaluator")
+            self._count_fallback("scorer_error")
             return base
         return np.where(known, ml, base).astype(np.float32)
 
@@ -193,11 +228,13 @@ class MLEvaluator(Evaluator):
             return np.zeros(0, dtype=np.float32)
         base, feats, c, p, known = self._prepare(child, parents)
         if feats is None:
+            self._count_fallback("unknown_hosts")
             return base
         try:
             ml = await mb.score(feats, child=c, parent=p)
         except Exception:
             logger.exception("micro-batched ml scorer failed; using base evaluator")
+            self._count_fallback("scorer_error")
             return base
         return np.where(known, ml, base).astype(np.float32)
 

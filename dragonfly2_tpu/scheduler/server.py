@@ -159,9 +159,14 @@ def main() -> None:
     args = ap.parse_args()
     if args.evaluator not in ("base", "ml") and not args.evaluator.startswith("plugin:"):
         ap.error(f"--evaluator {args.evaluator!r}: want 'base', 'ml', or 'plugin:pkg.mod:attr'")
+    from dragonfly2_tpu.observability.tracing import configure_default_tracer
     from dragonfly2_tpu.utils.dflog import setup_logging
 
     setup_logging(args.log_dir, level=logging.DEBUG if args.verbose else logging.INFO)
+    configure_default_tracer(
+        "dragonfly-scheduler",
+        otlp_file=cfg.tracing.otlp_file, otlp_endpoint=cfg.tracing.otlp_endpoint,
+    )
     asyncio.run(
         run_scheduler(
             host=args.host,

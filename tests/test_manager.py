@@ -72,6 +72,48 @@ def test_searcher_ranking_prefers_matching_scopes():
     assert searcher.find_scheduler_clusters(clusters, "", {}, has_active_schedulers={1: True}) == [clusters[0]]
 
 
+class _ReverseSearcher:
+    """Test plugin: ranks clusters in reverse id order (observable ordering)."""
+
+    def find_scheduler_clusters(self, clusters, ip, conditions=None, *,
+                                has_active_schedulers=None):
+        if has_active_schedulers is not None:
+            clusters = [c for c in clusters if has_active_schedulers.get(c["id"])]
+        return sorted(clusters, key=lambda c: c["id"], reverse=True)
+
+
+def make_reverse_searcher():
+    return _ReverseSearcher()
+
+
+def test_searcher_plugin_slot():
+    """The cluster searcher is plugin-overridable (ref searcher/plugin.go
+    LoadPlugin): selected by spec, duck-checked at boot — VERDICT r4 Next #8."""
+    import pytest
+
+    from dragonfly2_tpu.utils.plugins import PluginError
+
+    svc = ManagerService(searcher_spec="plugin:tests.test_manager:make_reverse_searcher")
+    default = svc.get_or_create_default_cluster()
+    other = svc.create_scheduler_cluster("other")  # no scopes, not default
+    svc.update_scheduler("sch-default", "1.1.1.1", 9000, scheduler_cluster_id=default["id"])
+    svc.update_scheduler("sch-other", "2.2.2.2", 9000, scheduler_cluster_id=other["id"])
+    # the default blend ranks the is_default cluster first (cluster-type
+    # bonus); the plugin's reverse-id order puts "other" (higher id) first —
+    # observable proof the plugin, not the blend, ranked this discovery
+    out = svc.list_schedulers(ip="172.16.0.1")
+    assert [s["hostname"] for s in out] == ["sch-other", "sch-default"]
+    # (type-name check: pytest and the plugin loader import this module under
+    # different names, so the class object is not identical)
+    assert type(svc.searcher).__name__ == "_ReverseSearcher"
+    # an object lacking the interface fails AT BOOT, not at first discovery
+    with pytest.raises(PluginError):
+        ManagerService(searcher_spec="plugin:tests.test_manager:ManagerService")
+    # so does a typo'd spec — no silent fall-through to the default blend
+    with pytest.raises(PluginError):
+        ManagerService(searcher_spec="plug:tests.test_manager:make_reverse_searcher")
+
+
 # ---------- service ----------
 
 def test_instance_registry_and_keepalive_reap():

@@ -211,6 +211,53 @@ class TestMicroBatchedScheduling:
         got = run(s.find_candidate_parents_async(child))
         assert [p.id for p in got] == [p.id for p in s.find_candidate_parents(child)]
 
+    @staticmethod
+    def _metric_value(metric, **labels) -> float:
+        child = metric.labels(**labels)
+        return float(child.value)
+
+    def test_serving_mode_metric_and_fallback_counter(self, run):
+        """VERDICT r4 Next #7: the active scoring implementation is a metric
+        (native|jax|base), and rounds served by the base evaluator while ml
+        is selected increment a reasoned counter."""
+        from dragonfly2_tpu.scheduler import metrics
+
+        pool, task, hosts = make_pool_with_task(4)
+        child = add_running_peer(pool, task, hosts[0])
+        parents = [add_running_peer(pool, task, h, pieces=2) for h in hosts[1:]]
+
+        ev = new_evaluator("ml")  # boot: no model yet -> base mode
+        assert self._metric_value(metrics.ML_SERVING_MODE, mode="base") == 1.0
+        assert self._metric_value(metrics.ML_SERVING_MODE, mode="native") == 0.0
+        before = self._metric_value(metrics.ML_BASE_FALLBACK_TOTAL, reason="no_scorer")
+        ev.evaluate(child, parents)
+        assert (
+            self._metric_value(metrics.ML_BASE_FALLBACK_TOTAL, reason="no_scorer")
+            == before + 1
+        )
+
+        # a score_rounds-shaped scorer is the native serving mode
+        node_index = {h.id: i for i, h in enumerate(hosts)}
+        ev.attach_scorer(_FakeNativeScorer(), node_index)
+        assert self._metric_value(metrics.ML_SERVING_MODE, mode="native") == 1.0
+        assert self._metric_value(metrics.ML_SERVING_MODE, mode="base") == 0.0
+
+        # a scorer raising mid-round serves base and counts the error
+        class _Boom:
+            ready = True
+
+            def score(self, feats, *, child, parent):
+                raise RuntimeError("kaboom")
+
+        ev.attach_scorer(_Boom(), node_index)
+        assert self._metric_value(metrics.ML_SERVING_MODE, mode="jax") == 1.0
+        before = self._metric_value(metrics.ML_BASE_FALLBACK_TOTAL, reason="scorer_error")
+        ev.evaluate(child, parents)
+        assert (
+            self._metric_value(metrics.ML_BASE_FALLBACK_TOTAL, reason="scorer_error")
+            == before + 1
+        )
+
 
 class TestScheduling:
     def test_filters_exclude_invalid(self, run):
