@@ -202,6 +202,7 @@ class Tracer:
     otlp_path: str = ""
     otlp_endpoint: str = ""
     otlp_batch: int = 64
+    otlp_max_age_s: float = 10.0  # flush a partial batch once its oldest span ages past this
     ring_size: int = 2048
     _ring: deque = field(default_factory=lambda: deque(maxlen=2048), repr=False)
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
@@ -253,7 +254,11 @@ class Tracer:
                 self._fh.write(json.dumps(span.to_dict()) + "\n")
             if self.otlp_path or self.otlp_endpoint:
                 self._otlp_buf.append(span)
-                if len(self._otlp_buf) >= self.otlp_batch:
+                # size OR age flush: a low-traffic service must still export
+                # live, not only when 64 spans accumulate or at exit
+                if len(self._otlp_buf) >= self.otlp_batch or (
+                    time.time() - self._otlp_buf[0].end >= self.otlp_max_age_s
+                ):
                     self._flush_otlp_locked()
 
     def _flush_otlp_locked(self, *, sync: bool = False) -> None:

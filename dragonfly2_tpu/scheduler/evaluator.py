@@ -15,6 +15,7 @@ as a day-one design decision.
 
 from __future__ import annotations
 
+import functools
 import logging
 import statistics
 from typing import Optional, Sequence
@@ -36,6 +37,51 @@ _SIGMA_FACTOR = 3.0
 _SMALL_SAMPLE_MEAN_FACTOR = 20.0
 
 
+_LOG_1TIB = float(np.log1p(1 << 40))
+
+# pair location strings are drawn from a small set of datacenter paths;
+# memoizing the prefix-match keeps it off the per-candidate hot path
+_location_affinity_cached = functools.lru_cache(maxsize=4096)(location_affinity)
+
+
+def _parent_static_row(p: Peer, h) -> np.ndarray:
+    """The child-independent feature columns of one candidate parent
+    (indices 0,1,2,3,7,9,12), cached ON THE PEER keyed by the peer's and
+    host's feature versions — every mutation of an attribute read here bumps
+    a version (resource.Host.feat_version / Peer.feat_version), so a cached
+    row is exact except for ancestor-depth staleness (documented there).
+    Child-dependent and round-constant columns are left zero; the caller
+    fills them into the stacked matrix."""
+    ver = (p.feat_version, h.feat_version)
+    if p._feat_row_ver == ver:
+        return p._feat_row
+    costs = p.piece_costs_ms
+    row = np.array(
+        (
+            p.finished_piece_ratio(),
+            h.upload_success_rate,
+            h.free_upload_slots / max(1, h.upload_limit),
+            1.0 if h.type == HostType.SEED else 0.0,
+            0.0,  # f4 idc affinity (child-dependent)
+            0.0,  # f5 location affinity (child-dependent)
+            0.0,  # f6 rtt (child-dependent)
+            (sum(costs) / len(costs) / 30_000.0) if costs else 0.0,
+            0.0,  # f8 bandwidth history (child-dependent)
+            min(p.depth(), 10) / 10.0,
+            0.0,  # f10 child ratio (round constant)
+            0.0,  # f11 size norm (round constant)
+            len(p.task.children_of(p.id)) / 40.0,
+            0.0,  # f13 schedule rounds (round constant)
+            1.0,  # f14 bias
+            0.0,  # f15 reserved
+        ),
+        dtype=np.float32,
+    )
+    p._feat_row = row
+    p._feat_row_ver = ver
+    return row
+
+
 def build_pair_features(
     child: Peer, parents: Sequence[Peer], topology=None, bandwidth=None
 ) -> np.ndarray:
@@ -43,35 +89,49 @@ def build_pair_features(
 
     topology: scheduler.networktopology.NetworkTopology (or None) — fills
     rtt_norm from live probe data. bandwidth: telemetry.BandwidthHistory (or
-    None) — fills bandwidth_norm from observed transfer history."""
+    None) — fills bandwidth_norm from observed transfer history.
+
+    Hot path: runs once per scheduling round, 40 candidates each, against a
+    10k-rounds/s serving budget. Child-independent columns come from
+    version-cached per-parent rows (see _parent_static_row); only the four
+    child-dependent columns and five round constants are computed here, so a
+    steady-state round costs one np.stack plus ~6 lookups per candidate
+    instead of ~30 attribute reads and two DAG walks."""
     n = len(parents)
-    f = np.zeros((n, FEATURE_DIM), dtype=np.float32)
+    if n == 0:
+        return np.zeros((0, FEATURE_DIM), dtype=np.float32)
     task = child.task
     child_host = child.host
-    for i, p in enumerate(parents):
+    child_host_id = child_host.id
+    child_idc = child_host.idc
+    child_loc = child_host.location
+    avg_rtt = topology.avg_rtt_ms if topology is not None else None
+    bw_norm = bandwidth.normalized if bandwidth is not None else None
+
+    rows = []
+    idc_col = []
+    loc_col = []
+    rtt_col = []
+    bw_col = []
+    for p in parents:
         h = p.host
-        f[i, 0] = p.finished_piece_ratio()
-        f[i, 1] = h.upload_success_rate
-        f[i, 2] = h.free_upload_slots / max(1, h.upload_limit)
-        f[i, 3] = 1.0 if h.type == HostType.SEED else 0.0
-        f[i, 4] = 1.0 if h.idc and h.idc == child_host.idc else 0.0
-        f[i, 5] = location_affinity(h.location, child_host.location)
-        rtt = topology.avg_rtt_ms(child_host.id, h.id) if topology is not None else None
-        f[i, 6] = min(rtt, 1000.0) / 1000.0 if rtt is not None else 0.0
-        costs = p.piece_costs_ms
-        f[i, 7] = (sum(costs) / len(costs) / 30_000.0) if costs else 0.0
-        f[i, 8] = bandwidth.normalized(h.id, child_host.id) if bandwidth is not None else 0.0
-        f[i, 9] = min(p.depth(), 10) / 10.0
-        f[i, 10] = child.finished_piece_ratio()
-        f[i, 11] = (
-            float(np.log1p(task.content_length)) / float(np.log1p(1 << 40))
-            if task.content_length
-            else 0.0
-        )
-        f[i, 12] = len(task.children_of(p.id)) / 40.0
-        f[i, 13] = min(child.schedule_rounds, 10) / 10.0
-        f[i, 14] = 1.0
-        f[i, 15] = 0.0
+        rows.append(_parent_static_row(p, h))
+        idc_col.append(1.0 if h.idc and h.idc == child_idc else 0.0)
+        loc_col.append(_location_affinity_cached(h.location, child_loc))
+        rtt = avg_rtt(child_host_id, h.id) if avg_rtt is not None else None
+        rtt_col.append(min(rtt, 1000.0) / 1000.0 if rtt is not None else 0.0)
+        bw_col.append(bw_norm(h.id, child_host_id) if bw_norm is not None else 0.0)
+
+    f = np.stack(rows)  # copies: cached rows stay pristine
+    f[:, 4] = idc_col
+    f[:, 5] = loc_col
+    f[:, 6] = rtt_col
+    f[:, 8] = bw_col
+    f[:, 10] = child.finished_piece_ratio()
+    f[:, 11] = (
+        float(np.log1p(task.content_length)) / _LOG_1TIB if task.content_length else 0.0
+    )
+    f[:, 13] = min(child.schedule_rounds, 10) / 10.0
     return f
 
 

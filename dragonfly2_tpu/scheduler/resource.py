@@ -129,8 +129,17 @@ class Host:
         self.upload_count = 0
         self.upload_failed_count = 0
         self.peer_ids: set[str] = set()
+        # Feature-row cache invalidation: every mutation of a host attribute
+        # the evaluator features read (upload slots/counters, idc/location)
+        # must bump this — the evaluator caches per-parent feature rows keyed
+        # by (peer.feat_version, host.feat_version) to hit its 10k-rounds/s
+        # serving budget (see evaluator.build_pair_features).
+        self.feat_version = 0
         self.created_at = time.monotonic()
         self.updated_at = time.monotonic()
+
+    def bump_feat(self) -> None:
+        self.feat_version += 1
 
     @property
     def free_upload_slots(self) -> int:
@@ -158,8 +167,19 @@ class Peer:
         self.block_parents: set[str] = set()
         self.range = None
         self.schedule_rounds = 0
+        # see Host.feat_version: bumped on piece progress, cost samples, and
+        # DAG edge changes touching this peer; ancestor edge changes are NOT
+        # propagated, so the cached depth feature can lag by a round — depth
+        # is a soft scoring signal, and the cache is what keeps feature
+        # assembly inside the serving budget
+        self.feat_version = 0
+        self._feat_row = None  # evaluator-owned cached row (np.ndarray)
+        self._feat_row_ver = (-1, -1)
         self.created_at = time.monotonic()
         self.updated_at = time.monotonic()
+
+    def bump_feat(self) -> None:
+        self.feat_version += 1
 
     @property
     def state(self) -> str:
@@ -177,6 +197,7 @@ class Peer:
 
     def add_piece_cost(self, ms: float) -> None:
         self.piece_costs_ms.append(ms)
+        self.bump_feat()
         self.touch()
 
     def depth(self) -> int:
@@ -235,9 +256,18 @@ class Task:
         return SizeScope.of(self.content_length, self.piece_size or compute_piece_size(self.content_length or 0))
 
     def set_metadata(self, content_length: int, piece_size: int | None = None) -> None:
+        new_piece_size = piece_size or compute_piece_size(content_length)
+        new_total = piece_count(content_length, new_piece_size)
+        if new_total != self.total_pieces:
+            # piece ratios are relative to total_pieces — but only a REAL
+            # change invalidates (announce_task re-sets identical metadata on
+            # every announce; bumping then would defeat the feature-row cache
+            # and cost an O(peers) walk per announce)
+            for p in self.dag.values():
+                p.bump_feat()
         self.content_length = content_length
-        self.piece_size = piece_size or compute_piece_size(content_length)
-        self.total_pieces = piece_count(content_length, self.piece_size)
+        self.piece_size = new_piece_size
+        self.total_pieces = new_total
         self.touch()
 
     # ---- peer DAG (ref task.go AddPeerEdge/DeletePeerInEdges) ----
@@ -271,6 +301,11 @@ class Task:
         parent = self.peer(parent_id)
         if parent:
             parent.host.concurrent_uploads += 1
+            parent.host.bump_feat()
+            parent.bump_feat()  # children count changed
+        child = self.peer(child_id)
+        if child:
+            child.bump_feat()  # depth changed
 
     def can_add_edge(self, parent_id: str, child_id: str) -> bool:
         return self.dag.can_add_edge(parent_id, child_id)
@@ -281,7 +316,12 @@ class Task:
                 parent = self.peer(pid)
                 if parent:
                     parent.host.concurrent_uploads = max(0, parent.host.concurrent_uploads - 1)
+                    parent.host.bump_feat()
+                    parent.bump_feat()  # children count changed
             self.dag.delete_in_edges(child_id)
+            child = self.peer(child_id)
+            if child:
+                child.bump_feat()  # depth changed
         except VertexNotFound:
             pass
 
@@ -376,6 +416,8 @@ class ResourcePool:
             # release upload slots this peer held as a parent
             for child in peer.task.children_of(peer_id):
                 peer.host.concurrent_uploads = max(0, peer.host.concurrent_uploads - 1)
+                child.bump_feat()  # its depth chain changed
+            peer.host.bump_feat()
             peer.task.delete_peer(peer_id)
 
     def gc(self) -> dict[str, int]:

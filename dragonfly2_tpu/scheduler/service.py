@@ -288,17 +288,19 @@ class SchedulerService:
             if peer.fsm.can("download"):
                 peer.fsm.fire("download")
             peer.finished_pieces.set(piece_index)
-            peer.add_piece_cost(cost_ms)
+            peer.add_piece_cost(cost_ms)  # bumps the peer's feature version
             if parent_id:
                 parent = self.pool.peer(parent_id)
                 if parent is not None:
                     parent.host.upload_count += 1
+                    parent.host.bump_feat()
                     parent.touch()
         else:
             if parent_id:
                 parent = self.pool.peer(parent_id)
                 if parent is not None:
                     parent.host.upload_failed_count += 1
+                    parent.host.bump_feat()
                 peer.block_parents.add(parent_id)
 
     def announce_task(
@@ -338,6 +340,7 @@ class SchedulerService:
                 peer.fsm.fire(ev)
         for idx in piece_indices:
             peer.finished_pieces.set(idx)
+        peer.bump_feat()
         if peer.fsm.can("succeed"):
             peer.fsm.fire("succeed")
         if task.fsm.can("succeed"):
@@ -354,6 +357,7 @@ class SchedulerService:
             peer.fsm.fire("download")
         for idx in piece_indices:
             peer.finished_pieces.set(idx)
+        peer.bump_feat()
         if cost_ms:
             peer.add_piece_cost(cost_ms)
 
@@ -477,6 +481,7 @@ class SchedulerService:
         if info.download_port:
             host.download_port = info.download_port
         host.type = HostType(info.type)
+        host.bump_feat()  # type/idc/location feed evaluator features
         if stats:
             for k, v in stats.items():
                 if hasattr(host.stats, k):

@@ -51,3 +51,28 @@ def test_stress_fixed_count(run, tmp_path):
             await runner.cleanup()
 
     run(body())
+
+
+def test_scoring_stress_mode(run):
+    """--scoring drives rounds through MLEvaluator + MicroBatchScorer + the
+    native FFI on a live service pool and reports rps + p50/p99 (VERDICT r4
+    Next #6). Small round count: this asserts the mode works end-to-end, not
+    a throughput target (the CLI at full rounds is the measurement)."""
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no C++ toolchain for the native scorer")
+    ns = type("NS", (), {})()
+    ns.rounds = 200
+    ns.concurrency = 4
+    ns.candidates = 40
+    ns.hosts = 64
+    result = run(dfstress.run_scoring_stress(ns))
+    assert result["metric"] == "evaluator_scoring_rounds_per_sec"
+    assert result["value"] > 0
+    ex = result["extra"]
+    assert ex["candidates_per_round"] == 40
+    assert ex["eval_p50_ms"] > 0 and ex["eval_p99_ms"] >= ex["eval_p50_ms"]
+    assert ex["full_round_rps"] > 0
+    # the micro-batcher actually coalesced (fewer flushes than rounds)
+    assert ex["native_flushes"] < ex["native_rounds"]
