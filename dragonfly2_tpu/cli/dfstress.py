@@ -156,7 +156,8 @@ async def run_scoring_stress(args: argparse.Namespace) -> dict:
                 p.bump_feat()
                 parents.append(p)
         node_index = {h.id: i % n_nodes for i, h in enumerate(hosts)}
-        ev.attach_scorer(scorer, node_index, microbatch=MicroBatchScorer(scorer))
+        mb = MicroBatchScorer(scorer)
+        ev.attach_scorer(scorer, node_index, microbatch=mb)
 
         cand = parents[: args.candidates]
         # warm both paths (first calls build caches / start the flusher)
@@ -179,7 +180,11 @@ async def run_scoring_stress(args: argparse.Namespace) -> dict:
             await asyncio.gather(*(driver(c) for c in children))
             return args.rounds / (time.monotonic() - t0), np.asarray(lat) * 1000
 
+        flushes0, rounds0 = mb.flushes, mb.rounds
         eval_rps, eval_lat = await measure(lambda c: ev.evaluate_async(c, cand))
+        # snapshot the coalescing stats for the EVAL phase alone (warmup and
+        # the full-round phase below would otherwise pollute the ratio)
+        eval_flushes, eval_rounds = mb.flushes - flushes0, mb.rounds - rounds0
         full_rps, full_lat = await measure(
             lambda c: svc.scheduling.find_candidate_parents_async(c)
         )
@@ -201,8 +206,8 @@ async def run_scoring_stress(args: argparse.Namespace) -> dict:
             "full_round_rps": round(full_rps, 1),
             "full_round_p50_ms": pct(full_lat, 50),
             "full_round_p99_ms": pct(full_lat, 99),
-            "native_flushes": ev._microbatch.flushes,
-            "native_rounds": ev._microbatch.rounds,
+            "native_flushes": eval_flushes,
+            "native_rounds": eval_rounds,
         },
     }
 

@@ -253,13 +253,18 @@ class Tracer:
                     self._fh = open(self.path, "a", encoding="utf-8", buffering=1 << 16)
                 self._fh.write(json.dumps(span.to_dict()) + "\n")
             if self.otlp_path or self.otlp_endpoint:
+                first = not self._otlp_buf
                 self._otlp_buf.append(span)
-                # size OR age flush: a low-traffic service must still export
-                # live, not only when 64 spans accumulate or at exit
-                if len(self._otlp_buf) >= self.otlp_batch or (
-                    time.time() - self._otlp_buf[0].end >= self.otlp_max_age_s
-                ):
+                if len(self._otlp_buf) >= self.otlp_batch:
                     self._flush_otlp_locked()
+                elif first:
+                    # age flush: a low-traffic service must still export live
+                    # within otlp_max_age_s, not wait for 64 spans or exit —
+                    # one daemon timer per batch start covers the case where
+                    # no further span ever arrives to trigger the size check
+                    t = threading.Timer(self.otlp_max_age_s, self.flush_otlp)
+                    t.daemon = True
+                    t.start()
 
     def _flush_otlp_locked(self, *, sync: bool = False) -> None:
         if not self._otlp_buf:

@@ -31,6 +31,7 @@ async def run_scheduler(
     manager_addr: str | None = None,
     trainer_addr: str | None = None,
     trainer_interval: float | None = None,
+    model_watch_interval: float | None = None,
     hostname: str = "",
     idc: str = "",
     location: str = "",
@@ -62,10 +63,13 @@ async def run_scheduler(
     if manager_addr:
         from dragonfly2_tpu.scheduler.manager_link import ManagerLink
 
+        link_kw = {}
+        if model_watch_interval is not None:
+            link_kw["model_watch_interval"] = model_watch_interval
         link = ManagerLink(
             service, manager_addr,
             hostname=hostname, ip=host, port=server.port,
-            idc=idc, location=location,
+            idc=idc, location=location, **link_kw,
         )
         try:
             await link.start()
@@ -148,6 +152,8 @@ def main() -> None:
                     help='"base", "ml", or "plugin:pkg.mod:attr"')
     ap.add_argument("--manager", default=cfg.manager, help="manager address host:port")
     ap.add_argument("--trainer", default=cfg.trainer, help="trainer address host:port")
+    ap.add_argument("--model-watch-interval", type=float, default=None,
+                    help="seconds between active-model registry polls (default 60)")
     ap.add_argument("--trainer-interval", type=float, default=cfg.trainer_interval,
                     help="telemetry upload cadence in seconds (default 7 days)")
     ap.add_argument("--hostname", default=cfg.hostname)
@@ -178,6 +184,7 @@ def main() -> None:
             manager_addr=args.manager,
             trainer_addr=args.trainer,
             trainer_interval=args.trainer_interval,
+            model_watch_interval=args.model_watch_interval,
             hostname=args.hostname,
             idc=args.idc,
             location=args.location,

@@ -25,16 +25,34 @@ async def run_trainer(
     model_dir: str = "/tmp/dragonfly2_tpu_models",
     manager_addr: str | None = None,
     gnn_steps: int = 300,
+    gnn_hidden: int | None = None,
+    mlp_steps: int | None = None,
+    min_pairs: int | None = None,
+    min_probe_rows: int | None = None,
     ready_event: asyncio.Event | None = None,
 ) -> None:
+    import dataclasses
+
     manager = None
     if manager_addr:
         from dragonfly2_tpu.rpc.manager import RemoteManagerClient
 
         manager = RemoteManagerClient(manager_addr)
-    service = TrainerService(
-        TrainerConfig(model_dir=model_dir, gnn_steps=gnn_steps), manager=manager
-    )
+    cfg = TrainerConfig(model_dir=model_dir, gnn_steps=gnn_steps)
+    # overrides replace ONLY the named hyperparameter — every other field
+    # keeps its production default
+    if gnn_hidden is not None:
+        cfg.gnn = dataclasses.replace(
+            cfg.gnn, hidden=gnn_hidden, embed_dim=max(16, gnn_hidden // 2),
+            batch_size=min(cfg.gnn.batch_size, gnn_hidden * 4),
+        )
+    if mlp_steps is not None:
+        cfg.mlp = dataclasses.replace(cfg.mlp, steps=mlp_steps)
+    if min_pairs is not None:
+        cfg.min_pairs = min_pairs
+    if min_probe_rows is not None:
+        cfg.min_probe_rows = min_probe_rows
+    service = TrainerService(cfg, manager=manager)
     server = RpcServer(host=host, port=port)
     register_trainer(server, service)
     await server.start()
@@ -55,6 +73,14 @@ def main() -> None:
     ap.add_argument("--model-dir", default="/tmp/dragonfly2_tpu_models")
     ap.add_argument("--manager", default=None)
     ap.add_argument("--gnn-steps", type=int, default=300)
+    ap.add_argument("--gnn-hidden", type=int, default=None,
+                    help="override GNN width (small clusters / tests)")
+    ap.add_argument("--mlp-steps", type=int, default=None,
+                    help="override MLP training steps")
+    ap.add_argument("--min-pairs", type=int, default=None,
+                    help="minimum (parent,child) rows before training")
+    ap.add_argument("--min-probe-rows", type=int, default=None,
+                    help="minimum probe rows before GNN training")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
     logging.basicConfig(
@@ -65,6 +91,8 @@ def main() -> None:
         run_trainer(
             host=args.host, port=args.port, model_dir=args.model_dir,
             manager_addr=args.manager, gnn_steps=args.gnn_steps,
+            gnn_hidden=args.gnn_hidden, mlp_steps=args.mlp_steps,
+            min_pairs=args.min_pairs, min_probe_rows=args.min_probe_rows,
         )
     )
 

@@ -195,6 +195,25 @@ class TestOtlpExport:
         assert by_name["broken"]["status"]["code"] == 2
         assert "boom" in by_name["broken"]["status"]["message"]
 
+    def test_otlp_age_flush_without_further_spans(self, tmp_path):
+        """A lone span must export within otlp_max_age_s even if no further
+        span ever arrives to trigger the size-based flush."""
+        import time as _time
+
+        path = tmp_path / "t.jsonl"
+        tracer = Tracer(service="svc-z", otlp_path=str(path), otlp_max_age_s=0.2)
+        with tracer.span("lonely"):
+            pass
+        assert not path.exists() or not path.read_text().strip()  # still buffered
+        deadline = _time.time() + 5
+        while _time.time() < deadline:
+            if path.exists() and path.read_text().strip():
+                break
+            _time.sleep(0.05)
+        spans = json.loads(path.read_text().strip())["resourceSpans"][0][
+            "scopeSpans"][0]["spans"]
+        assert spans[0]["name"] == "lonely"
+
     def test_otlp_http_post(self, run, tmp_path):
         """The endpoint exporter POSTs the same body to <base>/v1/traces."""
         from aiohttp import web

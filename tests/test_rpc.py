@@ -388,3 +388,258 @@ class TestDfmodelCluster:
             assert r.returncode == 0, r.stderr
             for name, data in shards.items():
                 assert (out_dir / name).read_bytes() == data, name
+
+
+class TestClusterMLLoop:
+    def test_ml_loop_across_real_cluster(self, tmp_path):
+        """VERDICT r4 Next #5 — the FULL ml loop through real processes:
+        daemon downloads + probes feed the scheduler's telemetry; the
+        announcer uploads to the trainer; the trainer trains and activates a
+        model in the manager registry; the scheduler's model watch hot-swaps
+        the ml evaluator; a later scheduling round is scored by the ACTIVATED
+        model (serving-mode metric native, no base-fallback growth), and the
+        embeddings-staleness gauge is exported."""
+        import shutil
+        import socket
+        import urllib.request
+
+        if shutil.which("g++") is None:
+            pytest.skip("no C++ toolchain for the native scorer")
+
+        env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            metrics_port = s.getsockname()[1]
+
+        procs = []
+
+        def spawn(args, ready_prefix):
+            p = subprocess.Popen(
+                [sys.executable, "-m", *args],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True, env=env,
+            )
+            procs.append(p)
+            line = p.stdout.readline()
+            assert line.startswith(ready_prefix), (args, line)
+            return line
+
+        def metrics_text() -> str:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics_port}/metrics", timeout=5
+            ) as r:
+                return r.read().decode()
+
+        def metric_value(text: str, needle: str) -> float:
+            for ln in text.splitlines():
+                if ln.startswith(needle):
+                    return float(ln.rsplit(" ", 1)[1])
+            return float("nan")
+
+        try:
+            line = spawn(
+                ["dragonfly2_tpu.manager.server", "--port", "0", "--rest-port", "0",
+                 "--db", str(tmp_path / "m.db")],
+                "manager ready",
+            )
+            manager_addr = line.split("rpc=")[1].split()[0]
+            line = spawn(
+                ["dragonfly2_tpu.trainer.server", "--port", "0",
+                 "--manager", manager_addr,
+                 "--model-dir", str(tmp_path / "models"),
+                 "--gnn-steps", "12", "--gnn-hidden", "32", "--mlp-steps", "40",
+                 "--min-pairs", "4", "--min-probe-rows", "2"],
+                "TRAINER_READY",
+            )
+            trainer_addr = line.split()[1]
+            line = spawn(
+                ["dragonfly2_tpu.scheduler.server", "--port", "0",
+                 "--evaluator", "ml",
+                 "--manager", manager_addr,
+                 "--trainer", trainer_addr, "--trainer-interval", "2",
+                 "--model-watch-interval", "1",
+                 "--telemetry-dir", str(tmp_path / "tel"),
+                 "--metrics-port", str(metrics_port),
+                 "--hostname", "sch1"],
+                "SCHEDULER_READY",
+            )
+            sched_addr = line.split()[1]
+            socks = []
+            for name in ("md1", "md2"):
+                sock = str(tmp_path / f"{name}.sock")
+                socks.append(sock)
+                spawn(
+                    ["dragonfly2_tpu.daemon.server", "--scheduler", sched_addr,
+                     "--sock", sock, "--storage", str(tmp_path / f"store_{name}"),
+                     "--hostname", name, "--probe-interval", "0.5"],
+                    "DAEMON_READY",
+                )
+
+            def dfget(sock, url, out):
+                return subprocess.run(
+                    [sys.executable, "-m", "dragonfly2_tpu.cli.dfget", url,
+                     "-O", str(out), "--sock", sock, "--no-spawn",
+                     "--scheduler", sched_addr],
+                    capture_output=True, text=True, env=env, timeout=120,
+                )
+
+            # base fallback is the expected mode BEFORE any telemetry exists
+            # (checked before the downloads: a fast machine can train and
+            # activate while the download loop is still running)
+            assert metric_value(
+                metrics_text(), 'dragonfly_scheduler_ml_serving_mode{mode="base"}'
+            ) == 1.0
+
+            # downloads on d1 (seed) then d2 (p2p) produce (parent,child)
+            # telemetry rows; 6 files > the trainer's min_pairs=4
+            for i in range(6):
+                f = tmp_path / f"f{i}.bin"
+                f.write_bytes(os.urandom(200_000))
+                r = dfget(socks[0], f"file://{f}", tmp_path / f"o1_{i}.bin")
+                assert r.returncode == 0, r.stderr
+                r = dfget(socks[1], f"file://{f}", tmp_path / f"o2_{i}.bin")
+                assert r.returncode == 0, r.stderr
+
+            # announcer (2s) -> trainer -> registry -> model watch (1s):
+            # within the deadline the serving mode must flip to native
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                text = metrics_text()
+                if metric_value(
+                    text, 'dragonfly_scheduler_ml_serving_mode{mode="native"}'
+                ) == 1.0:
+                    break
+                time.sleep(1.0)
+            else:
+                pytest.fail(f"model never activated; metrics:\n{text}")
+            assert metric_value(
+                text, "dragonfly_scheduler_ml_embeddings_refresh_timestamp_seconds"
+            ) > 0
+
+            fallback_before = metric_value(
+                text, 'dragonfly_scheduler_ml_base_fallback_total{reason="no_scorer"}'
+            )
+            unknown_before = metric_value(
+                text, 'dragonfly_scheduler_ml_base_fallback_total{reason="unknown_hosts"}'
+            )
+            rounds_before = metric_value(
+                text, "dragonfly_scheduler_schedule_duration_seconds_count"
+            )
+
+            # post-activation downloads: the p2p rounds these trigger must be
+            # scored by the activated model, not the base fallback
+            for i in range(6, 8):
+                f = tmp_path / f"f{i}.bin"
+                f.write_bytes(os.urandom(200_000))
+                assert dfget(socks[0], f"file://{f}", tmp_path / f"o1_{i}.bin").returncode == 0
+                assert dfget(socks[1], f"file://{f}", tmp_path / f"o2_{i}.bin").returncode == 0
+
+            text = metrics_text()
+            rounds_after = metric_value(
+                text, "dragonfly_scheduler_schedule_duration_seconds_count"
+            )
+            assert rounds_after > rounds_before  # scheduling rounds did run
+            for reason, before in (
+                ("no_scorer", fallback_before), ("unknown_hosts", unknown_before),
+            ):
+                after = metric_value(
+                    text,
+                    f'dragonfly_scheduler_ml_base_fallback_total{{reason="{reason}"}}',
+                )
+                # NaN == never incremented at all, which also passes
+                assert not (after > before), (reason, before, after, text)
+        finally:
+            for p in procs:
+                p.send_signal(signal.SIGTERM)
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    p.kill()
+
+
+class TestSwarmScale:
+    def test_four_daemon_swarm_origin_egress_stays_1x(self, tmp_path):
+        """VERDICT r4 Next #10 — fan-out efficiency at scale, the system's
+        core promise: 4 daemons, one 100 MiB task, first peer back-to-source
+        and three more downloading concurrently. Aggregate peer ingress is
+        4x the payload (four verified outputs) while ORIGIN egress stays ~1x:
+        everything past the first copy rode the swarm."""
+        import http.server
+        import threading
+
+        payload = os.urandom(1 << 20) * 100  # 100 MiB, incompressible head
+        want = hashlib.sha256(payload).hexdigest()
+        counters = {"bytes": 0, "requests": 0}
+        lock = threading.Lock()
+
+        class RangeOrigin(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_HEAD(self):
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(payload)))
+                self.send_header("Accept-Ranges", "bytes")
+                self.end_headers()
+
+            def do_GET(self):
+                rng = self.headers.get("Range")
+                if rng:
+                    spec = rng.split("=", 1)[1]
+                    start_s, _, end_s = spec.partition("-")
+                    start, end = int(start_s), int(end_s)
+                    body = payload[start : end + 1]
+                    self.send_response(206)
+                    self.send_header(
+                        "Content-Range", f"bytes {start}-{end}/{len(payload)}"
+                    )
+                else:
+                    body = payload
+                    self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.send_header("Accept-Ranges", "bytes")
+                self.end_headers()
+                self.wfile.write(body)
+                with lock:
+                    counters["bytes"] += len(body)
+                    counters["requests"] += 1
+
+        srv = http.server.ThreadingHTTPServer(("127.0.0.1", 0), RangeOrigin)
+        port = srv.server_address[1]
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        url = f"http://127.0.0.1:{port}/model.bin"
+
+        try:
+            names = ["s1", "s2", "s3", "s4"]
+            with spawn_cluster(tmp_path, names) as (sched_addr, socks, env):
+                def dfget_proc(sock, out):
+                    return subprocess.Popen(
+                        [sys.executable, "-m", "dragonfly2_tpu.cli.dfget", url,
+                         "-O", str(out), "--sock", sock, "--no-spawn",
+                         "--scheduler", sched_addr],
+                        stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+                        text=True, env=env,
+                    )
+
+                # first peer seeds from origin
+                p1 = dfget_proc(socks[0], tmp_path / "out0.bin")
+                assert p1.wait(timeout=300) == 0, p1.stderr.read()
+                # three more peers CONCURRENTLY: they share pieces among
+                # themselves and the seed, not the origin
+                rest = [
+                    dfget_proc(socks[i], tmp_path / f"out{i}.bin")
+                    for i in (1, 2, 3)
+                ]
+                for p in rest:
+                    assert p.wait(timeout=300) == 0, p.stderr.read()
+
+            for i in range(4):
+                got = hashlib.sha256((tmp_path / f"out{i}.bin").read_bytes()).hexdigest()
+                assert got == want, f"out{i} corrupt"
+            # origin egress ~1x: the payload once (+ tiny probe slack)
+            assert counters["bytes"] <= len(payload) * 1.05, counters
+        finally:
+            srv.shutdown()
