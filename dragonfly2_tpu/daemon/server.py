@@ -194,6 +194,7 @@ async def run_daemon(
     location: str = "",
     upload_port: int = 0,
     rpc_port: int | None = None,
+    vsock_port: int | None = None,
     metrics_port: int | None = None,
     proxy_port: int | None = None,
     proxy_rules: list | None = None,
@@ -268,6 +269,14 @@ async def run_daemon(
         tcp_server.register_service(DaemonRpcAdapter(engine), DAEMON_METHODS)
         await tcp_server.start()
         engine.rpc_port = tcp_server.port
+    # AF_VSOCK listener for VM-isolated clients — e.g. dfget inside a Kata
+    # container reaching the host daemon (ref pkg/rpc/vsock.go transport)
+    vsock_server = None
+    if vsock_port is not None:
+        vsock_server = RpcServer(vsock_port=vsock_port)
+        vsock_server.register_service(DaemonRpcAdapter(engine), DAEMON_METHODS)
+        await vsock_server.start()
+        logger.info("daemon vsock rpc on %s", vsock_server.address)
     proxy = None
     sni_proxy = None
     if proxy_port is not None or sni_proxy_port is not None:
@@ -397,6 +406,8 @@ async def run_daemon(
         await server.stop()
         if tcp_server is not None:
             await tcp_server.stop()
+        if vsock_server is not None:
+            await vsock_server.stop()
         await engine.stop()
         await scheduler.close()
         if resolver_manager is not None:
@@ -476,6 +487,8 @@ def main() -> None:
                          "vars, oss reads OSS_*, obs reads OBS_*")
     ap.add_argument("--rpc-port", type=int, default=cfg.rpc_port,
                     help="TCP RPC port (seed peers always listen; 0 = ephemeral)")
+    ap.add_argument("--vsock-port", type=int, default=None,
+                    help="AF_VSOCK RPC port for VM-isolated clients (Kata)")
     ap.add_argument("--manager", default=cfg.manager, help="manager address host:port")
     ap.add_argument("--probe-interval", type=float, default=cfg.probe_interval,
                     help="RTT probe cadence in seconds (default 20 min)")
@@ -523,6 +536,7 @@ def main() -> None:
             location=args.location,
             upload_port=args.upload_port,
             rpc_port=args.rpc_port,
+            vsock_port=args.vsock_port,
             metrics_port=args.metrics_port,
             proxy_port=args.proxy_port,
             proxy_rules=args.proxy_rule if args.proxy_rule is not None else list(cfg.proxy.rules),

@@ -57,6 +57,29 @@ def _write_frame(writer: asyncio.StreamWriter, msg: dict) -> None:
 
 Handler = Callable[[Any], Awaitable[Any]]
 
+VSOCK_SCHEME = "vsock://"
+
+
+def parse_vsock(address: str) -> tuple[int, int]:
+    """``vsock://<cid>:<port>`` → (cid, port). Parity with the reference's
+    vsock transport (pkg/rpc/vsock.go:1-59) for VM-isolated clients (e.g.
+    Kata containers) talking to a host daemon over AF_VSOCK."""
+    rest = address[len(VSOCK_SCHEME):]
+    cid_s, sep, port_s = rest.partition(":")
+    if not sep or not cid_s.isdigit() or not port_s.isdigit():
+        raise ValueError(f"bad vsock address {address!r}: want vsock://<cid>:<port>")
+    return int(cid_s), int(port_s)
+
+
+def vsock_socket():
+    """A fresh AF_VSOCK stream socket; raises OSError where the kernel (or
+    platform) lacks vsock support."""
+    import socket
+
+    if not hasattr(socket, "AF_VSOCK"):
+        raise OSError("AF_VSOCK unsupported on this platform")
+    return socket.socket(socket.AF_VSOCK, socket.SOCK_STREAM)
+
 
 class RpcServer:
     def __init__(
@@ -65,6 +88,7 @@ class RpcServer:
         host: str = "127.0.0.1",
         port: int = 0,
         unix_path: str | None = None,
+        vsock_port: int | None = None,
         qps_limit: float = 10_000,
         qps_burst: float = 20_000,
         ssl: Any = None,
@@ -73,6 +97,7 @@ class RpcServer:
         self.host = host
         self.port = port
         self.unix_path = unix_path
+        self.vsock_port = vsock_port  # listen on AF_VSOCK (any CID) when set
         self.ssl = ssl  # ssl.SSLContext for TLS/mTLS (security.ca helpers)
         self._server: asyncio.AbstractServer | None = None
         self._conns: set[asyncio.StreamWriter] = set()
@@ -91,7 +116,13 @@ class RpcServer:
             self.register(name, getattr(obj, name))
 
     async def start(self) -> None:
-        if self.unix_path:
+        if self.vsock_port is not None:
+            import socket
+
+            s = vsock_socket()
+            s.bind((socket.VMADDR_CID_ANY, self.vsock_port))
+            self._server = await asyncio.start_server(self._on_conn, sock=s)
+        elif self.unix_path:
             self._server = await asyncio.start_unix_server(self._on_conn, path=self.unix_path)
         else:
             self._server = await asyncio.start_server(
@@ -111,6 +142,10 @@ class RpcServer:
 
     @property
     def address(self) -> str:
+        if self.vsock_port is not None:
+            import socket
+
+            return f"{VSOCK_SCHEME}{socket.VMADDR_CID_HOST}:{self.vsock_port}"
         return self.unix_path or f"{self.host}:{self.port}"
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
@@ -192,10 +227,17 @@ class RpcClient:
         async with self._conn_lock:
             if self._writer is not None and not self._writer.is_closing():
                 return
-            # tcp only when the address ends in ":<digits>"; anything else
-            # (absolute, relative, or colon-containing paths) is a unix socket
+            # vsock:// is explicit; tcp only when the address ends in
+            # ":<digits>"; anything else (absolute, relative, or
+            # colon-containing paths) is a unix socket
             host, _, port_s = self.address.rpartition(":")
-            if not port_s.isdigit():
+            if self.address.startswith(VSOCK_SCHEME):
+                cid, vport = parse_vsock(self.address)
+                s = vsock_socket()
+                s.setblocking(False)
+                await asyncio.get_running_loop().sock_connect(s, (cid, vport))
+                self._reader, self._writer = await asyncio.open_connection(sock=s)
+            elif not port_s.isdigit():
                 self._reader, self._writer = await asyncio.open_unix_connection(self.address)
             else:
                 host, port = self.address.rsplit(":", 1)
