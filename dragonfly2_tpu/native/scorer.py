@@ -108,6 +108,8 @@ class NativeScorer:
     always True once constructed (embeddings ship inside the artifact).
     """
 
+    engine = "native"  # serving-mode metric label
+
     def __init__(self, artifact_path: str | Path, *, lib_path: Path | None = None):
         lib = build_native_lib(lib_path=lib_path)
         self._dll = ctypes.CDLL(str(lib))

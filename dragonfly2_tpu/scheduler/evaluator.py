@@ -189,8 +189,11 @@ class MLEvaluator(Evaluator):
 
     @staticmethod
     def _mode_of(scorer) -> str:
-        # the native scorer is the only one with the multi-round FFI entry
-        return "native" if hasattr(scorer, "score_rounds") else "jax"
+        # scorers self-label via `engine` ("native" C++ / "jax"); both now
+        # carry score_rounds, so the multi-round entry no longer implies C++
+        return getattr(scorer, "engine", None) or (
+            "native" if hasattr(scorer, "score_rounds") else "jax"
+        )
 
     @staticmethod
     def _set_serving_mode(mode: str) -> None:

@@ -104,3 +104,18 @@ class TestScorers:
             state.params, g, jnp.asarray(child), jnp.asarray(parent), jnp.asarray(tiny_cluster.pairs.feats[:40])
         )
         np.testing.assert_allclose(scores, np.asarray(full), rtol=2e-2, atol=2e-2)
+        # multi-round entry (micro-batcher shape) == stacked single rounds
+        m_child = np.stack([child[:8], parent[:8]])
+        m_parent = np.stack([parent[:8], child[:8]])
+        m_feats = np.stack(
+            [tiny_cluster.pairs.feats[:8], tiny_cluster.pairs.feats[8:16]]
+        )
+        multi = scorer.score_rounds(m_feats, child=m_child, parent=m_parent)
+        assert multi.shape == (2, 8)
+        for m in range(2):
+            single = scorer.score(m_feats[m], child=m_child[m], parent=m_parent[m])
+            np.testing.assert_allclose(multi[m], single, rtol=1e-5, atol=1e-6)
+        # micro-batcher duck interface
+        assert scorer.num_nodes == tiny_cluster.graph.node_feats.shape[0]
+        assert scorer.feature_dim == FEATURE_DIM
+        assert scorer.engine == "jax"
