@@ -59,6 +59,10 @@ class DaemonYaml:
     location: str = cfgfield("")
     upload_port: int = cfgfield(0, minimum=0, maximum=65535)
     rpc_port: Optional[int] = cfgfield(None, minimum=0, maximum=65535)
+    vsock_port: Optional[int] = cfgfield(
+        None, minimum=0, maximum=4294967295,
+        help="AF_VSOCK RPC port for VM-isolated clients",
+    )
     metrics_port: Optional[int] = cfgfield(None, minimum=0, maximum=65535)
     probe_interval: Optional[float] = cfgfield(None, minimum=0.1)
     log_dir: Optional[str] = cfgfield(None, help="rotating per-component log dir")

@@ -487,7 +487,7 @@ def main() -> None:
                          "vars, oss reads OSS_*, obs reads OBS_*")
     ap.add_argument("--rpc-port", type=int, default=cfg.rpc_port,
                     help="TCP RPC port (seed peers always listen; 0 = ephemeral)")
-    ap.add_argument("--vsock-port", type=int, default=None,
+    ap.add_argument("--vsock-port", type=int, default=cfg.vsock_port,
                     help="AF_VSOCK RPC port for VM-isolated clients (Kata)")
     ap.add_argument("--manager", default=cfg.manager, help="manager address host:port")
     ap.add_argument("--probe-interval", type=float, default=cfg.probe_interval,

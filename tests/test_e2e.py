@@ -4,6 +4,7 @@ sha256 comparison — without k8s, per SURVEY.md §4 takeaway)."""
 
 import asyncio
 import hashlib
+import time
 
 import pytest
 from aiohttp import web
@@ -32,14 +33,18 @@ class Origin:
         support_range: bool = True,
         send_content_length: bool = True,
         corrupt_range_shift: int = 0,
+        response_delay_s: float = 0.0,
     ):
         self.files = files
         self.support_range = support_range
         self.send_content_length = send_content_length
         self.corrupt_range_shift = corrupt_range_shift
+        self.response_delay_s = response_delay_s  # per-GET latency fixture
         self.requests = 0
         self.bytes_sent = 0
         self.port = 0
+        self.inflight = 0
+        self.max_inflight = 0
         self._runner = None
 
     async def __aenter__(self):
@@ -82,6 +87,13 @@ class Origin:
                 }
             )
         self.requests += 1
+        if self.response_delay_s:
+            self.inflight += 1
+            self.max_inflight = max(self.max_inflight, self.inflight)
+            try:
+                await asyncio.sleep(self.response_delay_s)
+            finally:
+                self.inflight -= 1
         rng = request.headers.get("Range")
         if rng and self.support_range:
             r = parse_http_range(rng, len(data))
@@ -194,6 +206,30 @@ class TestE2E:
                 finally:
                     for e in engines:
                         await e.stop()
+
+        run(body())
+
+    def test_back_to_source_pieces_fetch_concurrently(self, run, tmp_path, payload):
+        """Ranged back-to-source pulls pieces over CONCURRENT origin
+        connections (ref ConcurrentOption multi-connection source download):
+        a slow origin must see overlapping piece requests, and a 3-piece
+        download must take ~one delay, not three."""
+
+        async def body():
+            svc = SchedulerService(telemetry=TelemetryStorage(tmp_path / "telemetry"))
+            client = InProcessSchedulerClient(svc)
+            async with Origin({"model.bin": payload}, response_delay_s=0.3) as origin:
+                e1 = make_engine(tmp_path, client, "peer1")
+                await e1.start()
+                try:
+                    t0 = time.monotonic()
+                    ts = await e1.download_task(origin.url("model.bin"))
+                    elapsed = time.monotonic() - t0
+                    assert ts.is_complete()
+                    assert origin.max_inflight >= 2  # requests overlapped
+                    assert elapsed < 3 * 0.3  # not serialized piece-by-piece
+                finally:
+                    await e1.stop()
 
         run(body())
 
