@@ -2,9 +2,14 @@
 
 The reference gives every service a dedicated metrics port plus pprof/statsview
 (cmd/dependency/dependency.go:95-102). Equivalent here: a tiny aiohttp app with
-  GET /metrics      Prometheus text exposition
-  GET /healthz      liveness
-  GET /debug/spans  last finished tracing spans as JSON
+  GET /metrics            Prometheus text exposition
+  GET /healthz            liveness
+  GET /debug/spans        last finished tracing spans as JSON
+  GET /debug/stacks       every thread's stack + every asyncio task's frame
+                          (the /debug/pprof/goroutine analogue)
+  GET /debug/profile?seconds=N   cProfile the event-loop thread for N seconds,
+                          pstats text by cumulative time (the pprof CPU
+                          profile analogue)
 started via `start_debug_server(port=...)` from any service composition root.
 """
 
@@ -16,6 +21,31 @@ from dragonfly2_tpu.observability.metrics import MetricsRegistry, default_regist
 from dragonfly2_tpu.observability.tracing import Tracer, default_tracer
 
 
+def _dump_stacks() -> str:
+    """All thread stacks + live asyncio tasks with their awaiting frames."""
+    import asyncio
+    import sys
+    import traceback
+
+    out: list[str] = []
+    for tid, frame in sys._current_frames().items():
+        out.append(f"--- thread {tid} ---")
+        out.extend(ln.rstrip() for ln in traceback.format_stack(frame))
+    try:
+        tasks = asyncio.all_tasks()
+    except RuntimeError:
+        tasks = set()
+    out.append(f"--- asyncio tasks ({len(tasks)}) ---")
+    for t in tasks:
+        out.append(repr(t))
+        stack = t.get_stack(limit=8)
+        for frame in stack:
+            out.extend(
+                ln.rstrip() for ln in traceback.format_stack(frame, limit=1)
+            )
+    return "\n".join(out) + "\n"
+
+
 def make_debug_app(
     registry: MetricsRegistry | None = None, tracer: Tracer | None = None
 ) -> web.Application:
@@ -25,6 +55,7 @@ def make_debug_app(
     tr = tracer or default_tracer()
     app = web.Application()
     metrics = metrics_http_handler(reg)
+    profiling = {"active": False}
 
     async def healthz(_req: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
@@ -32,9 +63,38 @@ def make_debug_app(
     async def spans(_req: web.Request) -> web.Response:
         return web.json_response([s.to_dict() for s in tr.finished()])
 
+    async def stacks(_req: web.Request) -> web.Response:
+        return web.Response(text=_dump_stacks(), content_type="text/plain")
+
+    async def profile(req: web.Request) -> web.Response:
+        import asyncio
+        import cProfile
+        import io
+        import pstats
+
+        try:
+            seconds = min(60.0, max(0.1, float(req.query.get("seconds", "5"))))
+        except ValueError:
+            raise web.HTTPBadRequest(text="seconds must be a number")
+        if profiling["active"]:
+            raise web.HTTPConflict(text="a profile is already running")
+        profiling["active"] = True
+        pr = cProfile.Profile()
+        try:
+            pr.enable()
+            await asyncio.sleep(seconds)
+            pr.disable()
+        finally:
+            profiling["active"] = False
+        buf = io.StringIO()
+        pstats.Stats(pr, stream=buf).sort_stats("cumulative").print_stats(60)
+        return web.Response(text=buf.getvalue(), content_type="text/plain")
+
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/debug/spans", spans)
+    app.router.add_get("/debug/stacks", stacks)
+    app.router.add_get("/debug/profile", profile)
     return app
 
 

@@ -124,6 +124,18 @@ def test_debug_server_endpoints():
                 async with sess.get(f"http://127.0.0.1:{srv.port}/debug/spans") as r:
                     spans = await r.json()
                     assert spans[-1]["name"] == "something"
+                # pprof analogues (ref cmd/dependency pprof/statsview)
+                async with sess.get(f"http://127.0.0.1:{srv.port}/debug/stacks") as r:
+                    text = await r.text()
+                    assert "asyncio tasks" in text and "thread" in text
+                async with sess.get(
+                    f"http://127.0.0.1:{srv.port}/debug/profile?seconds=0.2"
+                ) as r:
+                    assert "cumulative" in await r.text()
+                async with sess.get(
+                    f"http://127.0.0.1:{srv.port}/debug/profile?seconds=nope"
+                ) as r:
+                    assert r.status == 400
         finally:
             await srv.stop()
 
