@@ -272,6 +272,49 @@ class TestE2E:
 
         run(body())
 
+    def test_streaming_parent_digests_do_not_skip_verify(self, run, tmp_path, payload, monkeypatch):
+        """The full-verify skip requires digests from parents that had
+        COMPLETED (and so verified) the task. A child that raced a still-
+        downloading parent learned self-computed digests over unverified
+        bytes — its end-of-task full verify must still run."""
+        from dragonfly2_tpu.daemon.storage import TaskStorage
+
+        verified_tasks = []
+        orig = TaskStorage.verify
+
+        def counting_verify(self):
+            verified_tasks.append(self.meta.task_id)
+            return orig(self)
+
+        monkeypatch.setattr(TaskStorage, "verify", counting_verify)
+
+        async def body():
+            svc = SchedulerService(telemetry=TelemetryStorage(tmp_path / "telemetry"))
+            client = InProcessSchedulerClient(svc)
+            # slow origin: e1's back-to-source is still streaming while e2
+            # downloads p2p from it (pieces fetch concurrently, so the whole
+            # back-source takes ~one response delay)
+            async with Origin({"model.bin": payload}, response_delay_s=0.8) as origin:
+                e1 = make_engine(tmp_path, client, "peer1")
+                e2 = make_engine(tmp_path, client, "peer2")
+                await e1.start()
+                await e2.start()
+                try:
+                    url = origin.url("model.bin")
+                    t1 = asyncio.create_task(e1.download_task(url))
+                    await asyncio.sleep(0.2)  # e1 mid-download
+                    ts2 = await e2.download_task(url)
+                    await t1
+                    assert ts2.is_complete()
+                    # e2 must have full-verified: its piece digests came from
+                    # a parent that was not done at sync time
+                    assert verified_tasks.count(ts2.meta.task_id) >= 2  # e1 + e2
+                finally:
+                    await e1.stop()
+                    await e2.stop()
+
+        run(body())
+
     def test_seed_peer_trigger(self, run, tmp_path, payload):
         async def body():
             svc = SchedulerService()
