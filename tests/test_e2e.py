@@ -87,14 +87,23 @@ class Origin:
                 }
             )
         self.requests += 1
-        if self.response_delay_s:
+        rng_header = request.headers.get("Range")
+        delay = self.response_delay_s
+        if callable(delay):
+            r = (
+                parse_http_range(rng_header, len(data))
+                if rng_header and self.support_range
+                else None
+            )
+            delay = delay(r)
+        if delay:
             self.inflight += 1
             self.max_inflight = max(self.max_inflight, self.inflight)
             try:
-                await asyncio.sleep(self.response_delay_s)
+                await asyncio.sleep(delay)
             finally:
                 self.inflight -= 1
-        rng = request.headers.get("Range")
+        rng = rng_header
         if rng and self.support_range:
             r = parse_http_range(rng, len(data))
             shift = self.corrupt_range_shift
@@ -222,12 +231,11 @@ class TestE2E:
                 e1 = make_engine(tmp_path, client, "peer1")
                 await e1.start()
                 try:
-                    t0 = time.monotonic()
                     ts = await e1.download_task(origin.url("model.bin"))
-                    elapsed = time.monotonic() - t0
                     assert ts.is_complete()
-                    assert origin.max_inflight >= 2  # requests overlapped
-                    assert elapsed < 3 * 0.3  # not serialized piece-by-piece
+                    # the load-bearing claim: origin saw OVERLAPPING piece
+                    # requests (wall-clock bounds would flake on a loaded box)
+                    assert origin.max_inflight >= 2
                 finally:
                     await e1.stop()
 
@@ -291,10 +299,12 @@ class TestE2E:
         async def body():
             svc = SchedulerService(telemetry=TelemetryStorage(tmp_path / "telemetry"))
             client = InProcessSchedulerClient(svc)
-            # slow origin: e1's back-to-source is still streaming while e2
-            # downloads p2p from it (pieces fetch concurrently, so the whole
-            # back-source takes ~one response delay)
-            async with Origin({"model.bin": payload}, response_delay_s=0.8) as origin:
+            # origin stalls ONLY the last piece's range for seconds: e1 holds
+            # pieces 0-1 quickly but stays mid-download, a deterministic
+            # window in which e2 syncs digests from the not-yet-done parent
+            last_start = 8 << 20  # piece 2 of the 10 MiB payload
+            delays = lambda r: 3.0 if (r is None or r.start >= last_start) else 0.05
+            async with Origin({"model.bin": payload}, response_delay_s=delays) as origin:
                 e1 = make_engine(tmp_path, client, "peer1")
                 e2 = make_engine(tmp_path, client, "peer2")
                 await e1.start()
@@ -302,7 +312,15 @@ class TestE2E:
                 try:
                     url = origin.url("model.bin")
                     t1 = asyncio.create_task(e1.download_task(url))
-                    await asyncio.sleep(0.2)  # e1 mid-download
+                    # wait until e1 verifiably holds SOME pieces but not all
+                    deadline = time.monotonic() + 10
+                    while time.monotonic() < deadline:
+                        held = e1.storage.tasks()
+                        if held and 0 < held[0].finished_count() < 3:
+                            break
+                        await asyncio.sleep(0.02)
+                    else:
+                        pytest.fail("e1 never reached a partial state")
                     ts2 = await e2.download_task(url)
                     await t1
                     assert ts2.is_complete()
