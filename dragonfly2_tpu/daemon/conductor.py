@@ -537,9 +537,18 @@ class PeerTaskConductor:
         url = f"http://{state.info.ip}:{state.info.download_port}/metadata/{self.meta.task_id}"
         while not state.blocked:
             try:
+                # `have` makes piece_digests a delta (digests we already hold
+                # are never re-sent — O(pieces) total instead of O(pieces²))
+                have = 0
+                for k in self._piece_digests:
+                    have |= 1 << int(k)
                 async with session.get(
                     url,
-                    params={"since": str(version), "wait": str(self.cfg.longpoll_wait)},
+                    params={
+                        "since": str(version),
+                        "wait": str(self.cfg.longpoll_wait),
+                        "have": format(have, "x"),
+                    },
                     timeout=aiohttp.ClientTimeout(total=self.cfg.longpoll_wait + 10),
                 ) as resp:
                     if resp.status != 200:

@@ -551,3 +551,52 @@ class TestSourceListing:
                 await reg.list_entries(f"file://{tmp_path}/d/x.bin")
 
         run(body())
+
+
+class TestMetadataDigestDelta:
+    def test_have_bitset_filters_piece_digests(self, run, tmp_path):
+        """`?have=<hex>` turns piece_digests into a delta: digests the caller
+        already holds are never re-sent (O(pieces) total metadata per child
+        instead of O(pieces^2) over a many-piece checkpoint shard)."""
+
+        async def body():
+            import aiohttp
+
+            sm = StorageManager(tmp_path)
+            tid = "delta1"
+            ts = sm.register_task(tid, url="x")
+            ts.set_task_info(content_length=12, piece_size=4, total_pieces=3)
+            for i, chunk in enumerate((b"aaaa", b"bbbb", b"cccc")):
+                await ts.write_piece(i, chunk)
+            srv = UploadServer(sm, port=0)
+            await srv.start()
+            try:
+                async with aiohttp.ClientSession() as s:
+                    base = f"http://127.0.0.1:{srv.port}"
+                    # no have -> full digest map
+                    async with s.get(f"{base}/metadata/{tid}") as r:
+                        full = (await r.json())["piece_digests"]
+                    assert set(full) == {"0", "1", "2"}
+                    # have pieces 0 and 2 -> only piece 1's digest returns
+                    have = format((1 << 0) | (1 << 2), "x")
+                    async with s.get(
+                        f"{base}/metadata/{tid}", params={"have": have}
+                    ) as r:
+                        delta = (await r.json())["piece_digests"]
+                    assert delta == {"1": full["1"]}
+                    # everything held -> empty delta, finished list intact
+                    async with s.get(
+                        f"{base}/metadata/{tid}", params={"have": "7"}
+                    ) as r:
+                        body = await r.json()
+                    assert body["piece_digests"] == {}
+                    assert body["finished_pieces"] == [0, 1, 2]
+                    # malformed hex -> 400
+                    async with s.get(
+                        f"{base}/metadata/{tid}", params={"have": "zz"}
+                    ) as r:
+                        assert r.status == 400
+            finally:
+                await srv.stop()
+
+        run(body())
