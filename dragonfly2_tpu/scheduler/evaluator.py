@@ -156,19 +156,25 @@ class Evaluator:
         return self.evaluate(child, parents)
 
     def is_bad_node(self, peer: Peer) -> bool:
-        """Piece-cost outlier ejection (ref evaluator_base.go:193-229)."""
+        """Piece-cost outlier ejection (ref evaluator_base.go:193-229),
+        memoized per feature version: the cost statistics only change when a
+        new piece-cost sample lands, which bumps the version — without the
+        memo this recomputes mean/stdev per candidate per round (40x the
+        work on the serving hot path)."""
         if peer.fsm.current == "failed":
             return True
+        ver, cached = peer._bad_memo
+        if ver == peer.feat_version:
+            return cached
         costs = list(peer.piece_costs_ms)
         if len(costs) < 2:
-            return False
-        last = costs[-1]
-        if len(costs) < _MIN_SAMPLES_FOR_SIGMA:
-            mean = statistics.fmean(costs[:-1])
-            return last > mean * _SMALL_SAMPLE_MEAN_FACTOR
-        mean = statistics.fmean(costs)
-        stdev = statistics.pstdev(costs)
-        return last > mean + _SIGMA_FACTOR * stdev
+            bad = False
+        elif len(costs) < _MIN_SAMPLES_FOR_SIGMA:
+            bad = costs[-1] > statistics.fmean(costs[:-1]) * _SMALL_SAMPLE_MEAN_FACTOR
+        else:
+            bad = costs[-1] > statistics.fmean(costs) + _SIGMA_FACTOR * statistics.pstdev(costs)
+        peer._bad_memo = (peer.feat_version, bad)
+        return bad
 
 
 class MLEvaluator(Evaluator):

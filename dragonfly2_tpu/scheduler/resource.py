@@ -175,6 +175,10 @@ class Peer:
         self.feat_version = 0
         self._feat_row = None  # evaluator-owned cached row (np.ndarray)
         self._feat_row_ver = (-1, -1)
+        # per-version memos for the per-round hot checks (depth walk /
+        # bad-node statistics) — invalidated by the same bump_feat sweep
+        self._depth_memo = (-1, 0)
+        self._bad_memo = (-1, False)
         self.created_at = time.monotonic()
         self.updated_at = time.monotonic()
 
@@ -201,19 +205,26 @@ class Peer:
         self.touch()
 
     def depth(self) -> int:
-        """Distance to a DAG root (seed/back-to-source peer)."""
+        """Distance to a DAG root (seed/back-to-source peer), memoized per
+        feature version (edge changes on this peer bump it; ancestor-only
+        changes can lag a round — depth is a soft scoring signal)."""
+        ver, cached = self._depth_memo
+        if ver == self.feat_version:
+            return cached
         depth, cur = 1, self
         seen = {self.id}
         while True:
             parents = self.task.parents_of(cur.id)
             if not parents:
-                return depth
+                break
             nxt = parents[0]
             if nxt.id in seen or depth > 10:
-                return depth
+                break
             seen.add(nxt.id)
             cur = nxt
             depth += 1
+        self._depth_memo = (self.feat_version, depth)
+        return depth
 
     def touch(self) -> None:
         self.updated_at = time.monotonic()

@@ -129,7 +129,6 @@ class Scheduling:
         ok_states = (PEER_RUNNING, PEER_BACK_TO_SOURCE, PEER_SUCCEEDED)
         max_depth = self.config.max_tree_depth
         is_bad = self.evaluator.is_bad_node
-        can_add = task.can_add_edge
         out = []
         for v in sample:
             p = v.value
@@ -143,9 +142,13 @@ class Scheduling:
                 or p.host.free_upload_slots <= 0
                 or p.depth() >= max_depth
                 or is_bad(p)
-                or not can_add(pid, child_id)  # reachability check last
             ):
                 continue
+            # NOTE: no per-candidate can_add_edge reachability walk here — a
+            # p->child cycle requires p reachable FROM child, and every such
+            # p is in `lineage` (descendants), as is an existing parent
+            # (ancestors); the commit path still re-validates via add_edge's
+            # CycleError for anything that changed during the scoring await
             out.append(p)
         return out
 

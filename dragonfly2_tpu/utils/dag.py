@@ -51,6 +51,9 @@ class DAG(Generic[V]):
     def __init__(self) -> None:
         self._v: dict[str, Vertex[V]] = {}
         self._lock = threading.RLock()
+        # vertex-list snapshot for the per-round random sample: rebuilt only
+        # when vertices change, not O(N) per scheduling round
+        self._vlist: list[Vertex[V]] | None = None
 
     def __len__(self) -> int:
         return len(self._v)
@@ -63,12 +66,14 @@ class DAG(Generic[V]):
             if vid in self._v:
                 raise VertexExists(vid)
             self._v[vid] = Vertex(vid, value)
+            self._vlist = None
 
     def delete_vertex(self, vid: str) -> None:
         with self._lock:
             vertex = self._v.pop(vid, None)
             if vertex is None:
                 return
+            self._vlist = None
             for p in vertex.parents:
                 self._v[p].children.discard(vid)
             for c in vertex.children:
@@ -153,9 +158,11 @@ class DAG(Generic[V]):
     def random_vertices(self, n: int, rng: random.Random | None = None) -> list[Vertex[V]]:
         """Sample up to n distinct vertices uniformly (scheduler candidate draw)."""
         with self._lock:
-            vs = list(self._v.values())
+            if self._vlist is None:
+                self._vlist = list(self._v.values())
+            vs = self._vlist
         if n >= len(vs):
-            return vs
+            return list(vs)
         return (rng or random).sample(vs, n)
 
     def source_vertices(self) -> list[Vertex[V]]:
