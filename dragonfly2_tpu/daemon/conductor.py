@@ -561,6 +561,12 @@ class PeerTaskConductor:
                 state.pieces = set(data.get("finished_pieces", ()))
                 parent_done = bool(data.get("done"))
                 for k, v in data.get("piece_digests", {}).items():
+                    # validate BEFORE storing: keys feed the have-bitset
+                    # (1 << int(k)) on every later sync — one non-numeric or
+                    # out-of-range key from a bad parent must not poison
+                    # metadata sync with every OTHER parent forever
+                    if not (isinstance(k, str) and k.isdigit()):
+                        continue
                     if k not in self._piece_digests:
                         self._piece_digests[k] = v
                         if not parent_done:

@@ -176,8 +176,9 @@ class Peer:
         self._feat_row = None  # evaluator-owned cached row (np.ndarray)
         self._feat_row_ver = (-1, -1)
         # per-version memos for the per-round hot checks (depth walk /
-        # bad-node statistics) — invalidated by the same bump_feat sweep
-        self._depth_memo = (-1, 0)
+        # bad-node statistics) — invalidated by the same bump_feat sweep;
+        # the depth memo also carries its timestamp (TTL, see depth())
+        self._depth_memo = (-1, 0, 0.0)
         self._bad_memo = (-1, False)
         self.created_at = time.monotonic()
         self.updated_at = time.monotonic()
@@ -204,12 +205,16 @@ class Peer:
         self.bump_feat()
         self.touch()
 
+    _DEPTH_MEMO_TTL_S = 1.0
+
     def depth(self) -> int:
         """Distance to a DAG root (seed/back-to-source peer), memoized per
-        feature version (edge changes on this peer bump it; ancestor-only
-        changes can lag a round — depth is a soft scoring signal)."""
-        ver, cached = self._depth_memo
-        if ver == self.feat_version:
+        feature version WITH a 1 s TTL: edge changes bump only the direct
+        child's version, so an idle grandchild's ancestry can change without
+        a bump — and depth gates the hard max_tree_depth filter, so its
+        staleness must be time-bounded, not unbounded."""
+        ver, cached, at = self._depth_memo
+        if ver == self.feat_version and time.monotonic() - at < self._DEPTH_MEMO_TTL_S:
             return cached
         depth, cur = 1, self
         seen = {self.id}
@@ -223,7 +228,7 @@ class Peer:
             seen.add(nxt.id)
             cur = nxt
             depth += 1
-        self._depth_memo = (self.feat_version, depth)
+        self._depth_memo = (self.feat_version, depth, time.monotonic())
         return depth
 
     def touch(self) -> None:

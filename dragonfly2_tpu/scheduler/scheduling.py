@@ -116,7 +116,10 @@ class Scheduling:
         in filters)`) spent more time in generator/call machinery than in the
         checks themselves (measured ~60% of round cost at 40 candidates).
         `_filters` remains the reference-shaped form for the SMALL-scope path
-        and tests; the conditions here must mirror it exactly."""
+        and tests. ONE permitted divergence: `_filters.no_cycle` also runs a
+        per-candidate can_add_edge reachability walk, omitted here because
+        lineage already covers cycle-formers and the commit path re-validates
+        (see the NOTE in the loop)."""
         task = child.task
         sample = task.dag.random_vertices(self.config.filter_parent_limit, self._rng)
         try:

@@ -324,6 +324,9 @@ class TestE2E:
                     ts2 = await e2.download_task(url)
                     await t1
                     assert ts2.is_complete()
+                    # e2 really pulled from e1 (the test is vacuous if e2
+                    # escalated back-to-source, which always full-verifies)
+                    assert e1.upload.bytes_served > 0
                     # e2 must have full-verified: its piece digests came from
                     # a parent that was not done at sync time
                     assert verified_tasks.count(ts2.meta.task_id) >= 2  # e1 + e2
