@@ -401,6 +401,9 @@ async def run_daemon(
             await proxy.stop()
         if objgw is not None:
             await objgw.stop()
+            close = getattr(objgw.backend, "close", None)
+            if close is not None:  # s3/oss/obs hold an aiohttp session
+                await close()
         if debug is not None:
             await debug.stop()
         await server.stop()
