@@ -152,6 +152,47 @@ def test_microbatch_scorer_coalesces(tmp_path, trained):
     ns.close()
 
 
+def test_microbatch_offload_path_matches_inline(tmp_path, trained):
+    """offload=True runs multi-round flushes in a worker thread (the
+    multicore serving pipeline); results, error isolation, and counters must
+    match the inline path — this is the path the multicore bench host takes,
+    which single-core CI never selects on its own."""
+    import asyncio
+
+    from dragonfly2_tpu.native import MicroBatchScorer
+
+    cluster, params, z, _ = trained
+    ns = NativeScorer(export_scorer_artifact(params, z, tmp_path / "s.dfsc"))
+    mb = MicroBatchScorer(ns, offload=True)
+    rng = np.random.default_rng(11)
+    rounds = [
+        (
+            cluster.pairs.feats[:40].astype(np.float32),
+            rng.integers(0, 128, size=40).astype(np.int32),
+            rng.integers(0, 128, size=40).astype(np.int32),
+        )
+        for _ in range(6)
+    ]
+
+    async def go():
+        good = [mb.score(f, child=c, parent=p) for f, c, p in rounds]
+        # one round with an out-of-range index fails ALONE, off-thread or not
+        bad = mb.score(
+            rounds[0][0],
+            child=np.full(40, 10_000, np.int32),
+            parent=rounds[0][2],
+        )
+        results = await asyncio.gather(*good, bad, return_exceptions=True)
+        return results[:-1], results[-1]
+
+    outs, bad_out = asyncio.run(go())
+    assert isinstance(bad_out, ValueError)
+    for (f, c, p), out in zip(rounds, outs):
+        np.testing.assert_array_equal(out, ns.score(f, child=c, parent=p))
+    assert mb.rounds == len(rounds)
+    ns.close()
+
+
 def test_native_throughput_sanity(tmp_path, trained):
     """North-star config 5 shape: batched rounds of 40 candidates. On any
     hardware the native path must beat 1k rounds/s by a wide margin; the real
