@@ -34,6 +34,7 @@ from dragonfly2_tpu.daemon.source import SourceRegistry
 from dragonfly2_tpu.daemon.storage import StorageManager, TaskStorage
 from dragonfly2_tpu.scheduler.service import HostInfo, ParentInfo, RegisterResult, TaskMeta
 from dragonfly2_tpu.utils import digest as digestlib
+from dragonfly2_tpu.utils.bitset import Bitset
 from dragonfly2_tpu.utils.pieces import Range, compute_piece_size, piece_count, piece_range
 from dragonfly2_tpu.utils.ratelimit import TokenBucket
 
@@ -558,7 +559,11 @@ class PeerTaskConductor:
                         continue
                     data = await resp.json()
                 version = data.get("version", version)
-                state.pieces = set(data.get("finished_pieces", ()))
+                finished_hex = data.get("finished_hex")
+                if finished_hex is not None:
+                    state.pieces = set(Bitset(int(finished_hex, 16)).indices())
+                else:  # older peers announce an index list
+                    state.pieces = set(data.get("finished_pieces", ()))
                 parent_done = bool(data.get("done"))
                 for k, v in data.get("piece_digests", {}).items():
                     # validate BEFORE storing: keys feed the have-bitset

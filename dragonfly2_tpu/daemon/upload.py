@@ -139,7 +139,9 @@ class UploadServer:
                 "piece_size": m.piece_size,
                 "total_pieces": m.total_pieces,
                 "digest": m.digest,
-                "finished_pieces": sorted(ts.finished.indices()),
+                # hex bitset, not an index list: a 1024-piece task announces
+                # in 256 chars instead of ~6 KB per long-poll wake
+                "finished_hex": format(ts.finished.to_int(), "x"),
                 "piece_digests": digests,
                 "done": m.done,
                 "version": ts.version,

@@ -415,7 +415,7 @@ class TestUploadServer:
                     base = f"http://127.0.0.1:{srv.port}"
                     async with s.get(f"{base}/metadata/{tid}") as r:
                         meta = await r.json()
-                    assert meta["finished_pieces"] == [0, 1]
+                    assert int(meta["finished_hex"], 16) == 0b11
                     async with s.get(
                         f"{base}/download/{tid[:3]}/{tid}?peerId=x",
                         headers={"Range": "bytes=0-3"},
@@ -470,7 +470,7 @@ class TestUploadServer:
                     async with s.get(f"{base}/metadata/{tid}", params={"since": "-1"}) as r:
                         meta = await r.json()
                     v = meta["version"]
-                    assert meta["finished_pieces"] == [0]
+                    assert int(meta["finished_hex"], 16) == 0b1
 
                     async def longpoll():
                         async with s.get(
@@ -485,7 +485,7 @@ class TestUploadServer:
                     t_write = _time.monotonic()
                     await ts.write_piece(1, b"bbbb")
                     meta2, t_resp = await waiter
-                    assert meta2["finished_pieces"] == [0, 1]
+                    assert int(meta2["finished_hex"], 16) == 0b11
                     assert meta2["version"] > v
                     # the push must arrive promptly (loose bound for CI noise;
                     # a poll-period wait would be >= the old 200 ms interval)
@@ -590,7 +590,7 @@ class TestMetadataDigestDelta:
                     ) as r:
                         body = await r.json()
                     assert body["piece_digests"] == {}
-                    assert body["finished_pieces"] == [0, 1, 2]
+                    assert int(body["finished_hex"], 16) == 0b111
                     # malformed hex -> 400
                     async with s.get(
                         f"{base}/metadata/{tid}", params={"have": "zz"}
