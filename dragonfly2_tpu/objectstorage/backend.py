@@ -484,6 +484,10 @@ class _OssObsBackend(ObjectStorageBackend):
         except Exception as e:
             raise self._wrap(e) from e
 
+    # streamed puts buffer at most one part in RAM; objects at or under this
+    # go up as one simple PUT
+    MULTIPART_PART_BYTES = 8 << 20
+
     async def put_object(
         self,
         bucket: str,
@@ -494,29 +498,83 @@ class _OssObsBackend(ObjectStorageBackend):
         user_metadata: dict | None = None,
     ) -> ObjectMetadata:
         _safe_key(key)
-        if not isinstance(data, (bytes, bytearray)):
-            # the dialect's legacy signing has no UNSIGNED-PAYLOAD mode;
-            # buffer the stream (multipart upload is the real fix at scale)
-            buf = bytearray()
-            async for chunk in data:
-                buf.extend(chunk)
-            data = bytes(buf)
         try:
-            etag = await self._client.put_object(
-                bucket, key, bytes(data),
-                content_type=content_type, user_metadata=user_metadata,
-            )
+            if isinstance(data, (bytes, bytearray)):
+                data = bytes(data)
+                digest = hashlib.sha256(data).hexdigest()
+                length = len(data)
+                etag = await self._client.put_object(
+                    bucket, key, data,
+                    content_type=content_type, user_metadata=user_metadata,
+                )
+            else:
+                # streamed: multipart upload — one part (not the whole
+                # object) in RAM, incremental hashing (multi-GB artifacts
+                # through the gateway stay out of memory)
+                etag, length, digest = await self._put_stream_multipart(
+                    bucket, key, data, content_type=content_type
+                )
         except Exception as e:
             raise self._wrap(e) from e
         return ObjectMetadata(
             key=key,
-            content_length=len(data),
-            digest=f"sha256:{hashlib.sha256(bytes(data)).hexdigest()}",
+            content_length=length,
+            digest=f"sha256:{digest}",
             etag=etag,
             content_type=content_type,
             last_modified=time.time(),
             user_metadata=dict(user_metadata or {}),
         )
+
+    async def _put_stream_multipart(
+        self, bucket: str, key: str, data: AsyncIterator[bytes], *, content_type: str
+    ) -> tuple[str, int, str]:
+        part_size = self.MULTIPART_PART_BYTES
+        h = hashlib.sha256()
+        buf = bytearray()
+        length = 0
+        upload_id: str | None = None
+        parts: list[tuple[int, str]] = []
+
+        async def flush_part() -> None:
+            nonlocal upload_id
+            if upload_id is None:
+                upload_id = await self._client.initiate_multipart(
+                    bucket, key, content_type=content_type
+                )
+            etag = await self._client.upload_part(
+                bucket, key, upload_id=upload_id,
+                part_number=len(parts) + 1, data=bytes(buf),
+            )
+            parts.append((len(parts) + 1, etag))
+            buf.clear()
+
+        try:
+            async for chunk in data:
+                h.update(chunk)
+                length += len(chunk)
+                buf.extend(chunk)
+                if len(buf) >= part_size:
+                    await flush_part()
+            if upload_id is None:
+                # small object after all: one simple PUT, no multipart
+                etag = await self._client.put_object(
+                    bucket, key, bytes(buf), content_type=content_type
+                )
+                return etag, length, h.hexdigest()
+            if buf:
+                await flush_part()
+            await self._client.complete_multipart(
+                bucket, key, upload_id=upload_id, parts=parts
+            )
+        except BaseException:
+            if upload_id is not None:
+                try:
+                    await self._client.abort_multipart(bucket, key, upload_id=upload_id)
+                except Exception:
+                    pass  # best-effort: the store reaps stale uploads
+            raise
+        return parts[-1][1] if parts else "", length, h.hexdigest()
 
     async def get_object(self, bucket: str, key: str) -> bytes:
         try:

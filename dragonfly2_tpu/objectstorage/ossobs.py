@@ -143,19 +143,30 @@ class OssObsClient:
         key: str = "",
         *,
         params: dict[str, str] | None = None,
+        subresource: str = "",
         data: bytes | None = None,
         content_type: str = "",
         extra_headers: dict[str, str] | None = None,
         ok: tuple[int, ...] = (200, 204),
     ) -> tuple[int, bytes, dict]:
+        """subresource: signed query params ("uploads",
+        "partNumber=N&uploadId=X", ...) — part of the canonicalized resource
+        per the dialect's rules, appended to both sts and URL."""
         date = formatdate(usegmt=True)
         headers = dict(extra_headers or {})
         headers["Date"] = date
         if content_type:
             headers["Content-Type"] = content_type
+        resource = self._resource(bucket, key)
+        if subresource:
+            resource += "?" + subresource
+            params = dict(params or {})
+            for kv in subresource.split("&"):
+                k, sep, v = kv.partition("=")
+                params[k] = v if sep else ""
         sts = string_to_sign(
             verb,
-            self._resource(bucket, key),
+            resource,
             date=date,
             dialect=self.dialect,
             content_type=content_type,
@@ -279,6 +290,46 @@ class OssObsClient:
                     )
                 )
         return out
+
+    # ---- multipart upload (the dialect's large-object path) ----
+
+    async def initiate_multipart(self, bucket: str, key: str, *, content_type: str = "") -> str:
+        _, body, _ = await self._request(
+            "POST", bucket, key, subresource="uploads", content_type=content_type
+        )
+        upload_id = ET.fromstring(body.decode()).findtext("UploadId") or ""
+        if not upload_id:
+            raise DialectError("initiate multipart: no UploadId in response")
+        return upload_id
+
+    async def upload_part(
+        self, bucket: str, key: str, *, upload_id: str, part_number: int, data: bytes
+    ) -> str:
+        _, _, headers = await self._request(
+            "PUT", bucket, key,
+            subresource=f"partNumber={part_number}&uploadId={quote(upload_id, safe='')}",
+            data=data,
+        )
+        return headers.get("ETag", "").strip('"')
+
+    async def complete_multipart(
+        self, bucket: str, key: str, *, upload_id: str, parts: list[tuple[int, str]]
+    ) -> None:
+        body = "<CompleteMultipartUpload>" + "".join(
+            f"<Part><PartNumber>{n}</PartNumber><ETag>&quot;{etag}&quot;</ETag></Part>"
+            for n, etag in parts
+        ) + "</CompleteMultipartUpload>"
+        await self._request(
+            "POST", bucket, key,
+            subresource=f"uploadId={quote(upload_id, safe='')}",
+            data=body.encode(), content_type="application/xml",
+        )
+
+    async def abort_multipart(self, bucket: str, key: str, *, upload_id: str) -> None:
+        await self._request(
+            "DELETE", bucket, key,
+            subresource=f"uploadId={quote(upload_id, safe='')}",
+        )
 
     def presign_get(self, bucket: str, key: str, *, expires: int = 3600) -> str:
         """Query-signed GET URL (the dialect's legacy presign shape): the

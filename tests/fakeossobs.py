@@ -30,6 +30,10 @@ class FakeOssObs:
         self.secret_key = secret_key
         # bucket -> key -> (body, content_type, user_metadata)
         self.buckets: dict[str, dict[str, tuple[bytes, str, dict]]] = {}
+        # upload_id -> (bucket, key, content_type, {part_number: bytes})
+        self.multipart: dict[str, tuple[str, str, str, dict[int, bytes]]] = {}
+        self.max_part_bytes_seen = 0
+        self._next_upload = 0
         self.port = 0
         self._runner = None
 
@@ -64,6 +68,19 @@ class FakeOssObs:
                 r += key
         return r
 
+    @staticmethod
+    def _signed_subresource(request: web.Request) -> str:
+        """Reconstruct the signed subresource string in the client's
+        canonical form (uploads | partNumber=N&uploadId=X | uploadId=X)."""
+        q = request.rel_url.query
+        if "uploads" in q:
+            return "uploads"
+        if "partNumber" in q and "uploadId" in q:
+            return f"partNumber={q['partNumber']}&uploadId={q['uploadId']}"
+        if "uploadId" in q:
+            return f"uploadId={q['uploadId']}"
+        return ""
+
     def _verify(self, request: web.Request) -> web.Response | None:
         q = request.rel_url.query
         if "Signature" in q:  # presigned URL
@@ -86,9 +103,13 @@ class FakeOssObs:
         ak, _, sig = cred.partition(":")
         if ak != self.access_key:
             return self._err(403, "InvalidAccessKeyId")
+        resource = self._resource(request)
+        sub = self._signed_subresource(request)
+        if sub:
+            resource += "?" + sub
         sts = string_to_sign(
             request.method,
-            self._resource(request),
+            resource,
             date=request.headers.get("Date", ""),
             dialect=self.dialect,
             content_md5=request.headers.get("Content-MD5", ""),
@@ -164,6 +185,39 @@ class FakeOssObs:
         if b not in self.buckets:
             return self._err(404, "NoSuchBucket")
         meta_prefix = f"{self.dialect.header_prefix}meta-"
+        q = request.rel_url.query
+        # ---- multipart lifecycle ----
+        if request.method == "POST" and "uploads" in q:
+            self._next_upload += 1
+            uid = f"u{self._next_upload}"
+            self.multipart[uid] = (b, k, request.headers.get("Content-Type", ""), {})
+            return web.Response(
+                content_type="application/xml",
+                text=f"<InitiateMultipartUploadResult><UploadId>{uid}"
+                     f"</UploadId></InitiateMultipartUploadResult>",
+            )
+        if request.method == "PUT" and "partNumber" in q and "uploadId" in q:
+            mp = self.multipart.get(q["uploadId"])
+            if mp is None:
+                return self._err(404, "NoSuchUpload")
+            body = await request.read()
+            self.max_part_bytes_seen = max(self.max_part_bytes_seen, len(body))
+            mp[3][int(q["partNumber"])] = body
+            return web.Response(status=200, headers={"ETag": f'"part{q["partNumber"]}"'})
+        if request.method == "POST" and "uploadId" in q:
+            mp = self.multipart.pop(q["uploadId"], None)
+            if mp is None:
+                return self._err(404, "NoSuchUpload")
+            _b, _k, ctype, parts = mp
+            body = b"".join(parts[n] for n in sorted(parts))
+            self.buckets[_b][_k] = (body, ctype, {})
+            return web.Response(
+                content_type="application/xml",
+                text="<CompleteMultipartUploadResult/>",
+            )
+        if request.method == "DELETE" and "uploadId" in q:
+            self.multipart.pop(q["uploadId"], None)
+            return web.Response(status=204)
         if request.method == "PUT":
             body = await request.read()
             um = {

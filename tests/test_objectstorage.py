@@ -569,6 +569,51 @@ class TestOssObsBackends:
 
         run(body())
 
+    def test_streamed_put_uses_multipart(self, run):
+        """A streamed put larger than one part goes up as a multipart upload
+        (one part in RAM at a time), smaller ones as a single PUT; bytes and
+        metadata survive either way."""
+
+        async def body():
+            from dragonfly2_tpu.objectstorage.backend import OSSBackend
+            from dragonfly2_tpu.objectstorage.ossobs import OSS_DIALECT
+            from tests.fakeossobs import FakeOssObs
+
+            async with FakeOssObs(OSS_DIALECT) as srv:
+                b = new_backend(
+                    "oss", endpoint=srv.endpoint,
+                    access_key="testkey", secret_key="testsecret",
+                )
+                b.MULTIPART_PART_BYTES = 64 * 1024  # small parts for the test
+                try:
+                    await b.create_bucket("big")
+                    payload = bytes(range(256)) * 1024  # 256 KiB -> 4 parts
+
+                    async def chunks():
+                        for i in range(0, len(payload), 24_000):
+                            yield payload[i : i + 24_000]
+
+                    meta = await b.put_object("big", "model.bin", chunks())
+                    assert meta.content_length == len(payload)
+                    assert (await b.get_object("big", "model.bin")) == payload
+                    # really went multipart: no single request carried the
+                    # whole object
+                    assert 0 < srv.max_part_bytes_seen < len(payload)
+                    assert not srv.multipart  # completed, not leaked
+
+                    # a small stream stays a simple PUT (no multipart state)
+                    async def small():
+                        yield b"tiny"
+
+                    meta = await b.put_object("big", "s.bin", small())
+                    assert meta.content_length == 4
+                    assert (await b.get_object("big", "s.bin")) == b"tiny"
+                    assert not srv.multipart
+                finally:
+                    await b.close()
+
+        run(body())
+
     def test_gateway_put_get_on_oss_backend(self, run, tmp_path):
         """dfstore SDK through the daemon gateway with the oss backend as the
         store — the dfstore-gateway E2E half of VERDICT r4 Next #4."""
