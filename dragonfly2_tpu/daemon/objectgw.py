@@ -74,6 +74,7 @@ class ObjectGateway:
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
+        await self.backend.close()  # s3/oss/obs hold an aiohttp session
 
     # ---- handlers ----
 

@@ -400,10 +400,7 @@ async def run_daemon(
         if proxy is not None:
             await proxy.stop()
         if objgw is not None:
-            await objgw.stop()
-            close = getattr(objgw.backend, "close", None)
-            if close is not None:  # s3/oss/obs hold an aiohttp session
-                await close()
+            await objgw.stop()  # also closes the backend's HTTP session
         if debug is not None:
             await debug.stop()
         await server.stop()

@@ -139,9 +139,11 @@ class UploadServer:
                 "piece_size": m.piece_size,
                 "total_pieces": m.total_pieces,
                 "digest": m.digest,
-                # hex bitset, not an index list: a 1024-piece task announces
-                # in 256 chars instead of ~6 KB per long-poll wake
+                # hex bitset: a 1024-piece task announces in 256 chars
+                # instead of ~6 KB; the index list stays alongside so
+                # pre-upgrade peers in a mixed cluster still see pieces
                 "finished_hex": format(ts.finished.to_int(), "x"),
+                "finished_pieces": sorted(ts.finished.indices()),
                 "piece_digests": digests,
                 "done": m.done,
                 "version": ts.version,

@@ -109,6 +109,10 @@ class ObjectStorageBackend:
         origin for P2P object distribution)."""
         raise NotImplementedError
 
+    async def close(self) -> None:
+        """Release network resources (no-op for local backends); every
+        gateway/embedder calls this on shutdown via ObjectGateway.stop()."""
+
 
 def _safe_key(key: str) -> str:
     # forbid traversal and degenerate segments; keys may contain slashes
@@ -512,7 +516,8 @@ class _OssObsBackend(ObjectStorageBackend):
                 # object) in RAM, incremental hashing (multi-GB artifacts
                 # through the gateway stay out of memory)
                 etag, length, digest = await self._put_stream_multipart(
-                    bucket, key, data, content_type=content_type
+                    bucket, key, data,
+                    content_type=content_type, user_metadata=user_metadata,
                 )
         except Exception as e:
             raise self._wrap(e) from e
@@ -527,7 +532,13 @@ class _OssObsBackend(ObjectStorageBackend):
         )
 
     async def _put_stream_multipart(
-        self, bucket: str, key: str, data: AsyncIterator[bytes], *, content_type: str
+        self,
+        bucket: str,
+        key: str,
+        data: AsyncIterator[bytes],
+        *,
+        content_type: str,
+        user_metadata: dict | None = None,
     ) -> tuple[str, int, str]:
         part_size = self.MULTIPART_PART_BYTES
         h = hashlib.sha256()
@@ -540,7 +551,8 @@ class _OssObsBackend(ObjectStorageBackend):
             nonlocal upload_id
             if upload_id is None:
                 upload_id = await self._client.initiate_multipart(
-                    bucket, key, content_type=content_type
+                    bucket, key,
+                    content_type=content_type, user_metadata=user_metadata,
                 )
             etag = await self._client.upload_part(
                 bucket, key, upload_id=upload_id,
@@ -559,12 +571,15 @@ class _OssObsBackend(ObjectStorageBackend):
             if upload_id is None:
                 # small object after all: one simple PUT, no multipart
                 etag = await self._client.put_object(
-                    bucket, key, bytes(buf), content_type=content_type
+                    bucket, key, bytes(buf),
+                    content_type=content_type, user_metadata=user_metadata,
                 )
                 return etag, length, h.hexdigest()
             if buf:
                 await flush_part()
-            await self._client.complete_multipart(
+            # the object's real ETag is the completed-upload one ('<hash>-N'),
+            # not any part's
+            etag = await self._client.complete_multipart(
                 bucket, key, upload_id=upload_id, parts=parts
             )
         except BaseException:
@@ -574,7 +589,7 @@ class _OssObsBackend(ObjectStorageBackend):
                 except Exception:
                     pass  # best-effort: the store reaps stale uploads
             raise
-        return parts[-1][1] if parts else "", length, h.hexdigest()
+        return etag, length, h.hexdigest()
 
     async def get_object(self, bucket: str, key: str) -> bytes:
         try:

@@ -143,15 +143,18 @@ class OssObsClient:
         key: str = "",
         *,
         params: dict[str, str] | None = None,
-        subresource: str = "",
+        subresource: list[tuple[str, str | None]] | None = None,
         data: bytes | None = None,
         content_type: str = "",
         extra_headers: dict[str, str] | None = None,
         ok: tuple[int, ...] = (200, 204),
     ) -> tuple[int, bytes, dict]:
-        """subresource: signed query params ("uploads",
-        "partNumber=N&uploadId=X", ...) — part of the canonicalized resource
-        per the dialect's rules, appended to both sts and URL."""
+        """subresource: ordered signed query params — [("uploads", None)],
+        [("partNumber", "5"), ("uploadId", id)], ... Values are RAW: the
+        canonicalized resource signs them unencoded per the dialect's rules,
+        and aiohttp URL-encodes them exactly once on the wire (quoting them
+        here would double-encode and break both lookup and signature for ids
+        containing '+', '/', '=')."""
         date = formatdate(usegmt=True)
         headers = dict(extra_headers or {})
         headers["Date"] = date
@@ -159,11 +162,12 @@ class OssObsClient:
             headers["Content-Type"] = content_type
         resource = self._resource(bucket, key)
         if subresource:
-            resource += "?" + subresource
+            resource += "?" + "&".join(
+                k if v is None else f"{k}={v}" for k, v in subresource
+            )
             params = dict(params or {})
-            for kv in subresource.split("&"):
-                k, sep, v = kv.partition("=")
-                params[k] = v if sep else ""
+            for k, v in subresource:
+                params[k] = "" if v is None else v
         sts = string_to_sign(
             verb,
             resource,
@@ -293,9 +297,20 @@ class OssObsClient:
 
     # ---- multipart upload (the dialect's large-object path) ----
 
-    async def initiate_multipart(self, bucket: str, key: str, *, content_type: str = "") -> str:
+    async def initiate_multipart(
+        self,
+        bucket: str,
+        key: str,
+        *,
+        content_type: str = "",
+        user_metadata: dict | None = None,
+    ) -> str:
+        """x-*-meta- headers on the initiate apply to the completed object
+        (both dialects), so streamed puts keep their user metadata."""
         _, body, _ = await self._request(
-            "POST", bucket, key, subresource="uploads", content_type=content_type
+            "POST", bucket, key, subresource=[("uploads", None)],
+            content_type=content_type,
+            extra_headers=self._meta_headers(user_metadata),
         )
         upload_id = ET.fromstring(body.decode()).findtext("UploadId") or ""
         if not upload_id:
@@ -307,28 +322,33 @@ class OssObsClient:
     ) -> str:
         _, _, headers = await self._request(
             "PUT", bucket, key,
-            subresource=f"partNumber={part_number}&uploadId={quote(upload_id, safe='')}",
+            subresource=[("partNumber", str(part_number)), ("uploadId", upload_id)],
             data=data,
         )
         return headers.get("ETag", "").strip('"')
 
     async def complete_multipart(
         self, bucket: str, key: str, *, upload_id: str, parts: list[tuple[int, str]]
-    ) -> None:
+    ) -> str:
+        """Returns the COMPLETED object's ETag (the '<hash>-N' form) from the
+        CompleteMultipartUploadResult body."""
         body = "<CompleteMultipartUpload>" + "".join(
             f"<Part><PartNumber>{n}</PartNumber><ETag>&quot;{etag}&quot;</ETag></Part>"
             for n, etag in parts
         ) + "</CompleteMultipartUpload>"
-        await self._request(
+        _, resp_body, _ = await self._request(
             "POST", bucket, key,
-            subresource=f"uploadId={quote(upload_id, safe='')}",
+            subresource=[("uploadId", upload_id)],
             data=body.encode(), content_type="application/xml",
         )
+        try:
+            return (ET.fromstring(resp_body.decode()).findtext("ETag") or "").strip('"')
+        except ET.ParseError:
+            return ""
 
     async def abort_multipart(self, bucket: str, key: str, *, upload_id: str) -> None:
         await self._request(
-            "DELETE", bucket, key,
-            subresource=f"uploadId={quote(upload_id, safe='')}",
+            "DELETE", bucket, key, subresource=[("uploadId", upload_id)]
         )
 
     def presign_get(self, bucket: str, key: str, *, expires: int = 3600) -> str:

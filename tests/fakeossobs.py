@@ -30,8 +30,8 @@ class FakeOssObs:
         self.secret_key = secret_key
         # bucket -> key -> (body, content_type, user_metadata)
         self.buckets: dict[str, dict[str, tuple[bytes, str, dict]]] = {}
-        # upload_id -> (bucket, key, content_type, {part_number: bytes})
-        self.multipart: dict[str, tuple[str, str, str, dict[int, bytes]]] = {}
+        # upload_id -> (bucket, key, content_type, {part_number: bytes}, meta)
+        self.multipart: dict[str, tuple[str, str, str, dict[int, bytes], dict]] = {}
         self.max_part_bytes_seen = 0
         self._next_upload = 0
         self.port = 0
@@ -189,8 +189,15 @@ class FakeOssObs:
         # ---- multipart lifecycle ----
         if request.method == "POST" and "uploads" in q:
             self._next_upload += 1
-            uid = f"u{self._next_upload}"
-            self.multipart[uid] = (b, k, request.headers.get("Content-Type", ""), {})
+            # non-alphanumeric chars exercise the raw-value signing path
+            # (a quote()-ing client would double-encode and fail lookup)
+            uid = f"u{self._next_upload}+x/y="
+            um = {
+                name[len(meta_prefix):]: v
+                for name, v in request.headers.items()
+                if name.lower().startswith(meta_prefix)
+            }
+            self.multipart[uid] = (b, k, request.headers.get("Content-Type", ""), {}, um)
             return web.Response(
                 content_type="application/xml",
                 text=f"<InitiateMultipartUploadResult><UploadId>{uid}"
@@ -208,12 +215,14 @@ class FakeOssObs:
             mp = self.multipart.pop(q["uploadId"], None)
             if mp is None:
                 return self._err(404, "NoSuchUpload")
-            _b, _k, ctype, parts = mp
+            _b, _k, ctype, parts, um = mp
             body = b"".join(parts[n] for n in sorted(parts))
-            self.buckets[_b][_k] = (body, ctype, {})
+            self.buckets[_b][_k] = (body, ctype, um)
+            etag = f"mphash-{len(parts)}"  # the '<hash>-N' completed form
             return web.Response(
                 content_type="application/xml",
-                text="<CompleteMultipartUploadResult/>",
+                text=f"<CompleteMultipartUploadResult><ETag>&quot;{etag}&quot;"
+                     f"</ETag></CompleteMultipartUploadResult>",
             )
         if request.method == "DELETE" and "uploadId" in q:
             self.multipart.pop(q["uploadId"], None)

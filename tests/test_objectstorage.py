@@ -593,13 +593,20 @@ class TestOssObsBackends:
                         for i in range(0, len(payload), 24_000):
                             yield payload[i : i + 24_000]
 
-                    meta = await b.put_object("big", "model.bin", chunks())
+                    meta = await b.put_object(
+                        "big", "model.bin", chunks(), user_metadata={"step": "9"}
+                    )
                     assert meta.content_length == len(payload)
+                    # the COMPLETED object's ETag, not any part's
+                    assert meta.etag == f"mphash-{-(-len(payload) // (64 * 1024))}"
                     assert (await b.get_object("big", "model.bin")) == payload
                     # really went multipart: no single request carried the
                     # whole object
                     assert 0 < srv.max_part_bytes_seen < len(payload)
                     assert not srv.multipart  # completed, not leaked
+                    # user metadata rode the initiate and survives a stat
+                    st = await b.stat_object("big", "model.bin")
+                    assert st.user_metadata.get("step") == "9"
 
                     # a small stream stays a simple PUT (no multipart state)
                     async def small():
