@@ -51,11 +51,17 @@ class Bucket:
     created_at: float = 0.0
 
 
+# streamed puts on remote backends buffer at most one part of this size;
+# objects at or under one part go up as a single simple PUT
+MULTIPART_PART_BYTES = 8 << 20
+
+
 class ObjectStorageBackend:
     """Async object-store interface; all methods raise ObjectStorageError
     with code in {not_found, already_exists, invalid} on expected failures."""
 
     name = ""
+    MULTIPART_PART_BYTES = MULTIPART_PART_BYTES  # instance-overridable
 
     # buckets
     async def create_bucket(self, bucket: str) -> None:
@@ -112,6 +118,70 @@ class ObjectStorageBackend:
     async def close(self) -> None:
         """Release network resources (no-op for local backends); every
         gateway/embedder calls this on shutdown via ObjectGateway.stop()."""
+
+
+async def stream_multipart_put(
+    client,
+    bucket: str,
+    key: str,
+    data: AsyncIterator[bytes],
+    *,
+    part_size: int = MULTIPART_PART_BYTES,
+    content_type: str = "application/octet-stream",
+    user_metadata: dict | None = None,
+) -> tuple[str, int, str]:
+    """Stream an object of unknown size through a multipart upload: one part
+    (never the whole object) in RAM, incremental sha256, abort on failure.
+    `client` is any of the dialect clients exposing put_object /
+    initiate_multipart / upload_part / complete_multipart / abort_multipart
+    (s3client.S3Client and ossobs.OssObsClient both do). Returns
+    (etag, total_bytes, sha256_hex); the etag is the COMPLETED object's."""
+    h = hashlib.sha256()
+    buf = bytearray()
+    length = 0
+    upload_id: str | None = None
+    parts: list[tuple[int, str]] = []
+
+    async def flush_part() -> None:
+        nonlocal upload_id
+        if upload_id is None:
+            upload_id = await client.initiate_multipart(
+                bucket, key, content_type=content_type, user_metadata=user_metadata
+            )
+        etag = await client.upload_part(
+            bucket, key, upload_id=upload_id,
+            part_number=len(parts) + 1, data=bytes(buf),
+        )
+        parts.append((len(parts) + 1, etag))
+        buf.clear()
+
+    try:
+        async for chunk in data:
+            h.update(chunk)
+            length += len(chunk)
+            buf.extend(chunk)
+            if len(buf) >= part_size:
+                await flush_part()
+        if upload_id is None:
+            # small object after all: one simple PUT, no multipart
+            etag = await client.put_object(
+                bucket, key, bytes(buf),
+                content_type=content_type, user_metadata=user_metadata,
+            )
+            return etag, length, h.hexdigest()
+        if buf:
+            await flush_part()
+        etag = await client.complete_multipart(
+            bucket, key, upload_id=upload_id, parts=parts
+        )
+    except BaseException:
+        if upload_id is not None:
+            try:
+                await client.abort_multipart(bucket, key, upload_id=upload_id)
+            except Exception:
+                pass  # best-effort: the store reaps stale uploads
+        raise
+    return etag, length, h.hexdigest()
 
 
 def _safe_key(key: str) -> str:
@@ -367,11 +437,13 @@ class S3Backend(ObjectStorageBackend):
                     content_type=content_type, user_metadata=user_metadata,
                 )
             else:
-                # streamed: UNSIGNED-PAYLOAD signing, one incremental-hash
-                # pass, never buffered (multi-GB artifacts through the
-                # gateway stay out of RAM)
-                etag, length, digest = await self._client.put_object_stream(
-                    bucket, key, data,
+                # streamed: multipart upload (required for >5 GB on real S3);
+                # one part in RAM, incremental hashing. (put_object_stream —
+                # the single UNSIGNED-PAYLOAD PUT — remains on the client for
+                # callers that know the object is small.)
+                etag, length, digest = await stream_multipart_put(
+                    self._client, bucket, key, data,
+                    part_size=self.MULTIPART_PART_BYTES,
                     content_type=content_type, user_metadata=user_metadata,
                 )
         except Exception as e:
@@ -488,10 +560,6 @@ class _OssObsBackend(ObjectStorageBackend):
         except Exception as e:
             raise self._wrap(e) from e
 
-    # streamed puts buffer at most one part in RAM; objects at or under this
-    # go up as one simple PUT
-    MULTIPART_PART_BYTES = 8 << 20
-
     async def put_object(
         self,
         bucket: str,
@@ -515,8 +583,9 @@ class _OssObsBackend(ObjectStorageBackend):
                 # streamed: multipart upload — one part (not the whole
                 # object) in RAM, incremental hashing (multi-GB artifacts
                 # through the gateway stay out of memory)
-                etag, length, digest = await self._put_stream_multipart(
-                    bucket, key, data,
+                etag, length, digest = await stream_multipart_put(
+                    self._client, bucket, key, data,
+                    part_size=self.MULTIPART_PART_BYTES,
                     content_type=content_type, user_metadata=user_metadata,
                 )
         except Exception as e:
@@ -530,66 +599,6 @@ class _OssObsBackend(ObjectStorageBackend):
             last_modified=time.time(),
             user_metadata=dict(user_metadata or {}),
         )
-
-    async def _put_stream_multipart(
-        self,
-        bucket: str,
-        key: str,
-        data: AsyncIterator[bytes],
-        *,
-        content_type: str,
-        user_metadata: dict | None = None,
-    ) -> tuple[str, int, str]:
-        part_size = self.MULTIPART_PART_BYTES
-        h = hashlib.sha256()
-        buf = bytearray()
-        length = 0
-        upload_id: str | None = None
-        parts: list[tuple[int, str]] = []
-
-        async def flush_part() -> None:
-            nonlocal upload_id
-            if upload_id is None:
-                upload_id = await self._client.initiate_multipart(
-                    bucket, key,
-                    content_type=content_type, user_metadata=user_metadata,
-                )
-            etag = await self._client.upload_part(
-                bucket, key, upload_id=upload_id,
-                part_number=len(parts) + 1, data=bytes(buf),
-            )
-            parts.append((len(parts) + 1, etag))
-            buf.clear()
-
-        try:
-            async for chunk in data:
-                h.update(chunk)
-                length += len(chunk)
-                buf.extend(chunk)
-                if len(buf) >= part_size:
-                    await flush_part()
-            if upload_id is None:
-                # small object after all: one simple PUT, no multipart
-                etag = await self._client.put_object(
-                    bucket, key, bytes(buf),
-                    content_type=content_type, user_metadata=user_metadata,
-                )
-                return etag, length, h.hexdigest()
-            if buf:
-                await flush_part()
-            # the object's real ETag is the completed-upload one ('<hash>-N'),
-            # not any part's
-            etag = await self._client.complete_multipart(
-                bucket, key, upload_id=upload_id, parts=parts
-            )
-        except BaseException:
-            if upload_id is not None:
-                try:
-                    await self._client.abort_multipart(bucket, key, upload_id=upload_id)
-                except Exception:
-                    pass  # best-effort: the store reaps stale uploads
-            raise
-        return etag, length, h.hexdigest()
 
     async def get_object(self, bucket: str, key: str) -> bytes:
         try:

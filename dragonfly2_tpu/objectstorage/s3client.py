@@ -327,6 +327,65 @@ class S3Client:
         resp.release()
         return etag, total, h.hexdigest()
 
+    # ---- multipart upload (required for >5 GB objects; parts are signed) ----
+
+    async def initiate_multipart(
+        self,
+        bucket: str,
+        key: str,
+        *,
+        content_type: str = "application/octet-stream",
+        user_metadata: dict | None = None,
+    ) -> str:
+        resp = await self._request(
+            "POST", f"/{bucket}/{key}", query=[("uploads", "")],
+            extra_headers={"content-type": content_type, **self._meta_headers(user_metadata)},
+            ok=(200,),
+        )
+        text = await resp.text()
+        root = ET.fromstring(text)
+        upload_id = root.findtext(f"{_ns(root)}UploadId") or ""
+        if not upload_id:
+            raise S3Error("initiate multipart: no UploadId in response")
+        return upload_id
+
+    async def upload_part(
+        self, bucket: str, key: str, *, upload_id: str, part_number: int, data: bytes
+    ) -> str:
+        resp = await self._request(
+            "PUT", f"/{bucket}/{key}",
+            query=[("partNumber", str(part_number)), ("uploadId", upload_id)],
+            data=data, ok=(200,),
+        )
+        etag = resp.headers.get("ETag", "").strip('"')
+        resp.release()
+        return etag
+
+    async def complete_multipart(
+        self, bucket: str, key: str, *, upload_id: str, parts: list[tuple[int, str]]
+    ) -> str:
+        """Returns the completed object's ETag (the '<hash>-N' form)."""
+        body = "<CompleteMultipartUpload>" + "".join(
+            f"<Part><PartNumber>{n}</PartNumber><ETag>&quot;{etag}&quot;</ETag></Part>"
+            for n, etag in parts
+        ) + "</CompleteMultipartUpload>"
+        resp = await self._request(
+            "POST", f"/{bucket}/{key}", query=[("uploadId", upload_id)],
+            data=body.encode(), ok=(200,),
+        )
+        text = await resp.text()
+        try:
+            root = ET.fromstring(text)
+            return (root.findtext(f"{_ns(root)}ETag") or "").strip('"')
+        except ET.ParseError:
+            return ""
+
+    async def abort_multipart(self, bucket: str, key: str, *, upload_id: str) -> None:
+        resp = await self._request(
+            "DELETE", f"/{bucket}/{key}", query=[("uploadId", upload_id)], ok=(204,)
+        )
+        resp.release()
+
     async def get_object(
         self, bucket: str, key: str, *, range_header: str = ""
     ) -> AsyncIterator[bytes]:

@@ -29,6 +29,10 @@ class FakeS3:
         self.region = region
         # key -> (body, content_type, user_metadata)
         self.buckets: dict[str, dict[str, tuple[bytes, str, dict]]] = {}
+        # upload_id -> (bucket, key, content_type, {part_number: bytes}, meta)
+        self.multipart: dict[str, tuple[str, str, str, dict[int, bytes], dict]] = {}
+        self.max_part_bytes_seen = 0
+        self._next_upload = 0
         self.port = 0
         self._runner = None
 
@@ -229,12 +233,50 @@ class FakeS3:
         if bucket not in self.buckets:
             return self._err(404, "NoSuchBucket", bucket)
         objs = self.buckets[bucket]
+        q = request.rel_url.query
+        meta = {
+            k.lower()[len("x-amz-meta-"):]: v
+            for k, v in request.headers.items()
+            if k.lower().startswith("x-amz-meta-")
+        }
+        # ---- multipart lifecycle ----
+        if request.method == "POST" and "uploads" in q:
+            self._next_upload += 1
+            uid = f"mpu{self._next_upload}+s3/id="  # hostile chars on purpose
+            self.multipart[uid] = (
+                bucket, key,
+                request.headers.get("Content-Type", "application/octet-stream"),
+                {}, meta,
+            )
+            return web.Response(
+                content_type="application/xml",
+                text=f"<InitiateMultipartUploadResult><UploadId>{uid}"
+                     f"</UploadId></InitiateMultipartUploadResult>",
+            )
+        if request.method == "PUT" and "partNumber" in q and "uploadId" in q:
+            mp = self.multipart.get(q["uploadId"])
+            if mp is None:
+                return self._err(404, "NoSuchUpload", q["uploadId"])
+            self.max_part_bytes_seen = max(self.max_part_bytes_seen, len(body))
+            mp[3][int(q["partNumber"])] = body
+            return web.Response(headers={"ETag": f'"part{q["partNumber"]}"'})
+        if request.method == "POST" and "uploadId" in q:
+            mp = self.multipart.pop(q["uploadId"], None)
+            if mp is None:
+                return self._err(404, "NoSuchUpload", q["uploadId"])
+            _b, _k, ctype, parts, um = mp
+            data = b"".join(parts[n] for n in sorted(parts))
+            self.buckets[_b][_k] = (data, ctype, um)
+            etag = f"{hashlib.md5(data).hexdigest()}-{len(parts)}"
+            return web.Response(
+                content_type="application/xml",
+                text=f"<CompleteMultipartUploadResult><ETag>&quot;{etag}&quot;"
+                     f"</ETag></CompleteMultipartUploadResult>",
+            )
+        if request.method == "DELETE" and "uploadId" in q:
+            self.multipart.pop(q["uploadId"], None)
+            return web.Response(status=204)
         if request.method == "PUT":
-            meta = {
-                k.lower()[len("x-amz-meta-"):]: v
-                for k, v in request.headers.items()
-                if k.lower().startswith("x-amz-meta-")
-            }
             objs[key] = (
                 body,
                 request.headers.get("Content-Type", "application/octet-stream"),

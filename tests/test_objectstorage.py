@@ -451,6 +451,50 @@ class TestS3Streaming:
                     st = await b.stat_object("stream", "big.bin")
                     assert st.content_type == "application/x-ckpt"
                     assert st.user_metadata.get("step") == "42"
+                    # the raw UNSIGNED-PAYLOAD single-stream client entry
+                    # (for callers that KNOW the object is small) still signs
+                    etag, total, digest = await b._client.put_object_stream(
+                        "stream", "raw.bin", chunks(), user_metadata={"u": "1"}
+                    )
+                    assert total == 16 * 4096
+                    assert s3.buckets["stream"]["raw.bin"][0][:4] == b"\x00" * 4
+                finally:
+                    await b.close()
+
+        run(body())
+
+    def test_streamed_put_uses_multipart_over_part_size(self, run):
+        """A streamed put larger than one part rides SigV4-signed multipart
+        (initiate / parts / complete with the completed-object ETag); the
+        whole object never travels in one request."""
+
+        async def body():
+            from tests.fakes3 import FakeS3
+
+            async with FakeS3() as s3:
+                b = new_backend(
+                    "s3", endpoint=s3.endpoint,
+                    access_key="testkey", secret_key="testsecret",
+                )
+                b.MULTIPART_PART_BYTES = 64 * 1024
+                try:
+                    await b.create_bucket("big")
+                    payload = bytes(range(256)) * 1024  # 256 KiB -> 4 parts
+
+                    async def chunks():
+                        for i in range(0, len(payload), 24_000):
+                            yield payload[i : i + 24_000]
+
+                    meta = await b.put_object(
+                        "big", "model.bin", chunks(), user_metadata={"step": "7"}
+                    )
+                    assert meta.content_length == len(payload)
+                    assert meta.etag.endswith("-4")  # completed-object form
+                    assert s3.buckets["big"]["model.bin"][0] == payload
+                    assert 0 < s3.max_part_bytes_seen < len(payload)
+                    assert not s3.multipart  # completed, not leaked
+                    st = await b.stat_object("big", "model.bin")
+                    assert st.user_metadata.get("step") == "7"
                 finally:
                     await b.close()
 
