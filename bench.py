@@ -455,7 +455,7 @@ def bench_evaluator_serving() -> dict:
 
 
 def bench_checkpoint_fanout(
-    total_mb: int = 64, files: int = 4, repeats: int = 3
+    total_mb: int = 128, files: int = 4, repeats: int = 3
 ) -> tuple[float, float]:
     """North-star config 4 shape at bench scale: a multi-file checkpoint
     published by one peer and fetched by fresh peers THROUGH the P2P piece
