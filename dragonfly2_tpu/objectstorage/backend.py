@@ -162,6 +162,11 @@ async def stream_multipart_put(
             buf.extend(chunk)
             if len(buf) >= part_size:
                 await flush_part()
+                if len(parts) % 1000 == 0 and part_size < (1 << 32):
+                    # the stores cap uploads at 10k parts: double the part
+                    # size each 1000 parts so unknown-size streams never run
+                    # into the cap (8 MiB start reaches the multi-TB range)
+                    part_size *= 2
         if upload_id is None:
             # small object after all: one simple PUT, no multipart
             etag = await client.put_object(

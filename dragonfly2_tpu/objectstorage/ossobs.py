@@ -341,10 +341,22 @@ class OssObsClient:
             subresource=[("uploadId", upload_id)],
             data=body.encode(), content_type="application/xml",
         )
+        # a 200 carrying an <Error> document (or garbage) is a FAILURE
         try:
-            return (ET.fromstring(resp_body.decode()).findtext("ETag") or "").strip('"')
+            root = ET.fromstring(resp_body.decode())
         except ET.ParseError:
-            return ""
+            raise DialectError(
+                f"complete multipart: unparseable response {resp_body[:200]!r}"
+            )
+        if root.tag.endswith("Error"):
+            code = root.findtext("Code") or ""
+            raise DialectError(f"complete multipart failed: {code}", code=code)
+        etag = (root.findtext("ETag") or "").strip('"')
+        if not etag:
+            raise DialectError(
+                f"complete multipart: no ETag in response {resp_body[:200]!r}"
+            )
+        return etag
 
     async def abort_multipart(self, bucket: str, key: str, *, upload_id: str) -> None:
         await self._request(

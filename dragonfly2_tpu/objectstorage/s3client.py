@@ -374,11 +374,23 @@ class S3Client:
             data=body.encode(), ok=(200,),
         )
         text = await resp.text()
+        # S3 can return HTTP 200 whose body is an <Error> document (e.g.
+        # InternalError mid-completion) — that is a FAILURE the caller must
+        # see, not an empty-etag success; same for an unparseable body
         try:
             root = ET.fromstring(text)
-            return (root.findtext(f"{_ns(root)}ETag") or "").strip('"')
         except ET.ParseError:
-            return ""
+            raise S3Error(f"complete multipart: unparseable response {text[:200]!r}")
+        if root.tag.endswith("Error"):
+            code = root.findtext("Code") or ""
+            raise S3Error(
+                f"complete multipart failed: {code} {root.findtext('Message') or ''}",
+                code=code,
+            )
+        etag = (root.findtext(f"{_ns(root)}ETag") or "").strip('"')
+        if not etag:
+            raise S3Error(f"complete multipart: no ETag in response {text[:200]!r}")
+        return etag
 
     async def abort_multipart(self, bucket: str, key: str, *, upload_id: str) -> None:
         resp = await self._request(

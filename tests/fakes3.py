@@ -265,6 +265,19 @@ class FakeS3:
             if mp is None:
                 return self._err(404, "NoSuchUpload", q["uploadId"])
             _b, _k, ctype, parts, um = mp
+            # validate the client's completion XML like real S3: well-formed,
+            # and part numbers matching what was actually uploaded
+            import xml.etree.ElementTree as _ET
+
+            try:
+                root = _ET.fromstring(body.decode())
+            except _ET.ParseError:
+                return self._err(400, "MalformedXML", "completion body")
+            listed = [
+                int(p.findtext("PartNumber") or -1) for p in root.iter("Part")
+            ]
+            if sorted(listed) != sorted(parts):
+                return self._err(400, "InvalidPart", f"{listed} != {sorted(parts)}")
             data = b"".join(parts[n] for n in sorted(parts))
             self.buckets[_b][_k] = (data, ctype, um)
             etag = f"{hashlib.md5(data).hexdigest()}-{len(parts)}"
