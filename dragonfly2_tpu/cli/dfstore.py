@@ -95,11 +95,47 @@ class Dfstore:
             await self._raise_for(r)
             return await r.json()
 
+    async def put_file(
+        self, bucket: str, key: str, path: str | Path, *, seed: bool = False,
+        chunk_size: int = 1 << 20,
+    ) -> dict:
+        """Stream a file up without holding it in RAM (the gateway streams
+        the body straight into the backend's multipart path)."""
+        url = self._obj_url(bucket, key) + ("?seed=1" if seed else "")
+
+        async def chunks():
+            with open(path, "rb") as f:
+                while True:
+                    b = await asyncio.to_thread(f.read, chunk_size)
+                    if not b:
+                        return
+                    yield b
+
+        async with self._sess().put(url, data=chunks()) as r:
+            await self._raise_for(r)
+            return await r.json()
+
     async def get_object(self, bucket: str, key: str, *, direct: bool = False) -> bytes:
         url = self._obj_url(bucket, key) + ("?mode=direct" if direct else "")
         async with self._sess().get(url) as r:
             await self._raise_for(r)
             return await r.read()
+
+    async def get_object_to_file(
+        self, bucket: str, key: str, dest: str | Path, *, direct: bool = False,
+        chunk_size: int = 1 << 20,
+    ) -> int:
+        """Stream an object to disk without holding it in RAM; returns bytes
+        written."""
+        url = self._obj_url(bucket, key) + ("?mode=direct" if direct else "")
+        n = 0
+        async with self._sess().get(url) as r:
+            await self._raise_for(r)
+            with open(dest, "wb") as f:
+                async for chunk in r.content.iter_chunked(chunk_size):
+                    await asyncio.to_thread(f.write, chunk)
+                    n += len(chunk)
+        return n
 
     async def stat_object(self, bucket: str, key: str) -> dict:
         async with self._sess().head(self._obj_url(bucket, key)) as r:
@@ -139,14 +175,16 @@ async def _amain(args: argparse.Namespace) -> int:
             print("created")
         elif args.cmd == "put":
             u = DfUrl.parse(args.dest)
-            data = Path(args.src).read_bytes()
-            out = await store.put_object(u.bucket, u.key or Path(args.src).name, data, seed=args.seed)
+            out = await store.put_file(
+                u.bucket, u.key or Path(args.src).name, args.src, seed=args.seed
+            )
             print(json.dumps(out))
         elif args.cmd == "get":
             u = DfUrl.parse(args.src)
-            data = await store.get_object(u.bucket, u.key, direct=args.direct)
-            Path(args.dest).write_bytes(data)
-            print(f"{len(data)} bytes -> {args.dest}")
+            n = await store.get_object_to_file(
+                u.bucket, u.key, args.dest, direct=args.direct
+            )
+            print(f"{n} bytes -> {args.dest}")
         elif args.cmd == "stat":
             u = DfUrl.parse(args.url)
             print(json.dumps(await store.stat_object(u.bucket, u.key)))

@@ -155,6 +155,17 @@ class TestGatewayAndSDK:
                 got2 = await store.get_object("models", "w.bin", direct=True)
                 assert got2 == PAYLOAD
 
+                # file-streaming SDK entries (what the CLI uses): the body
+                # never sits fully in RAM on either side
+                src = tmp_path / "src.bin"
+                src.write_bytes(PAYLOAD[::-1])
+                out = await store.put_file("models", "f.bin", src)
+                assert out["content_length"] == len(PAYLOAD)
+                dest = tmp_path / "dest.bin"
+                n = await store.get_object_to_file("models", "f.bin", dest)
+                assert n == len(PAYLOAD)
+                assert dest.read_bytes() == PAYLOAD[::-1]
+
                 await store.delete_object("models", "w.bin")
                 assert not await store.is_object_exist("models", "w.bin")
             finally:
