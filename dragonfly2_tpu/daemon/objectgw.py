@@ -149,6 +149,33 @@ class ObjectGateway:
             }
         )
 
+    async def _stream_direct(
+        self, req: web.Request, bucket: str, key: str, meta
+    ) -> web.StreamResponse:
+        """Stream straight from the backend — the direct mode and the
+        p2p-failure fallback must not hold a multi-GB object in RAM. The
+        first chunk is pulled BEFORE headers go out so backend errors still
+        map to JSON error responses."""
+        agen = self.backend.get_object_stream(bucket, key)
+        try:
+            first = await anext(agen, b"")
+        except ObjectStorageError as e:
+            return self._err(e)
+        resp = web.StreamResponse(
+            headers={
+                "Content-Length": str(meta.content_length),
+                "Content-Type": meta.content_type,
+                "ETag": meta.etag,
+            }
+        )
+        await resp.prepare(req)
+        if first:
+            await resp.write(first)
+        async for chunk in agen:
+            await resp.write(chunk)
+        await resp.write_eof()
+        return resp
+
     async def _get_object(self, req: web.Request) -> web.StreamResponse:
         bucket, key = req.match_info["bucket"], req.match_info["key"]
         try:
@@ -156,10 +183,7 @@ class ObjectGateway:
         except ObjectStorageError as e:
             return self._err(e)
         if req.query.get("mode") == "direct":
-            data = await self.backend.get_object(bucket, key)
-            return web.Response(
-                body=data, content_type=meta.content_type, headers={"ETag": meta.etag}
-            )
+            return await self._stream_direct(req, bucket, key, meta)
         # P2P path: the backend's presigned URL is the back-to-source origin,
         # so every daemon in the cluster dedupes this object as one task
         # (ref objectstorage.go GetObject → StartStreamTask with signed URL)
@@ -168,10 +192,7 @@ class ObjectGateway:
             length, body = await self.engine.stream_task(origin, digest=meta.digest)
         except Exception as e:
             logger.warning("p2p object get %s/%s failed (%s); direct read", bucket, key, e)
-            data = await self.backend.get_object(bucket, key)
-            return web.Response(
-                body=data, content_type=meta.content_type, headers={"ETag": meta.etag}
-            )
+            return await self._stream_direct(req, bucket, key, meta)
         resp = web.StreamResponse(
             headers={
                 "Content-Length": str(length),

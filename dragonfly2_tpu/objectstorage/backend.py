@@ -91,6 +91,11 @@ class ObjectStorageBackend:
     async def get_object(self, bucket: str, key: str) -> bytes:
         raise NotImplementedError
 
+    async def get_object_stream(self, bucket: str, key: str) -> AsyncIterator[bytes]:
+        """Chunked read; base fallback buffers (subclasses stream — the
+        gateway's direct path must not hold a 16 GB shard in RAM)."""
+        yield await self.get_object(bucket, key)
+
     async def stat_object(self, bucket: str, key: str) -> ObjectMetadata:
         raise NotImplementedError
 
@@ -327,6 +332,17 @@ class LocalFSBackend(ObjectStorageBackend):
             raise ObjectStorageError(f"object {bucket}/{key} not found", code="not_found")
         return await asyncio.to_thread(path.read_bytes)
 
+    async def get_object_stream(self, bucket: str, key: str) -> AsyncIterator[bytes]:
+        path = self._obj_path(bucket, key)
+        if not path.is_file():
+            raise ObjectStorageError(f"object {bucket}/{key} not found", code="not_found")
+        with open(path, "rb") as f:
+            while True:
+                chunk = await asyncio.to_thread(f.read, 1 << 20)
+                if not chunk:
+                    return
+                yield chunk
+
     async def stat_object(self, bucket: str, key: str) -> ObjectMetadata:
         path = self._obj_path(bucket, key)
         if not path.is_file():
@@ -472,6 +488,13 @@ class S3Backend(ObjectStorageBackend):
             raise self._wrap(e) from e
         return bytes(buf)
 
+    async def get_object_stream(self, bucket: str, key: str) -> AsyncIterator[bytes]:
+        try:
+            async for chunk in self._client.get_object(bucket, key):
+                yield chunk
+        except Exception as e:
+            raise self._wrap(e) from e
+
     async def stat_object(self, bucket: str, key: str) -> ObjectMetadata:
         try:
             obj = await self._client.head_object(bucket, key)
@@ -608,6 +631,13 @@ class _OssObsBackend(ObjectStorageBackend):
     async def get_object(self, bucket: str, key: str) -> bytes:
         try:
             return await self._client.get_object(bucket, key)
+        except Exception as e:
+            raise self._wrap(e) from e
+
+    async def get_object_stream(self, bucket: str, key: str) -> AsyncIterator[bytes]:
+        try:
+            async for chunk in self._client.get_object_stream(bucket, key):
+                yield chunk
         except Exception as e:
             raise self._wrap(e) from e
 

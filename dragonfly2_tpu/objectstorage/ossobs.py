@@ -257,6 +257,37 @@ class OssObsClient:
         _, body, _ = await self._request("GET", bucket, key)
         return body
 
+    async def get_object_stream(
+        self, bucket: str, key: str, *, chunk_size: int = 1 << 20
+    ) -> AsyncIterator[bytes]:
+        """Signed GET yielding chunks — large objects never buffer whole."""
+        date = formatdate(usegmt=True)
+        headers = {"Date": date}
+        sts = string_to_sign(
+            "GET", self._resource(bucket, key), date=date, dialect=self.dialect,
+            headers=headers,
+        )
+        headers["Authorization"] = (
+            f"{self.dialect.label} {self.cfg.access_key}:{sign(self.cfg.secret_key, sts)}"
+        )
+        resp = await self._sess().get(self._url(bucket, key), headers=headers)
+        try:
+            if resp.status != 200:
+                body = await resp.read()
+                code = ""
+                try:
+                    code = ET.fromstring(body.decode()).findtext("Code") or ""
+                except ET.ParseError:
+                    pass
+                raise DialectError(
+                    f"{self.dialect.label} GET {bucket}/{key}: HTTP {resp.status} {code}",
+                    status=resp.status, code=code,
+                )
+            async for chunk in resp.content.iter_chunked(chunk_size):
+                yield chunk
+        finally:
+            resp.release()
+
     async def head_object(self, bucket: str, key: str) -> ObjectInfo:
         _, _, headers = await self._request("HEAD", bucket, key)
         meta_prefix = f"{self.dialect.header_prefix}meta-"
