@@ -54,7 +54,11 @@ class Dfstore:
 
     def __init__(self, endpoint: str = DEFAULT_ENDPOINT, *, timeout: float = 300.0):
         self.endpoint = endpoint.rstrip("/")
-        self._timeout = aiohttp.ClientTimeout(total=timeout)
+        # stall-based, not total: a total cap would abort exactly the
+        # multi-GB streaming transfers put_file/get_object_to_file exist for
+        self._timeout = aiohttp.ClientTimeout(
+            total=None, connect=30.0, sock_read=timeout
+        )
         self._session: aiohttp.ClientSession | None = None
 
     def _sess(self) -> aiohttp.ClientSession:
@@ -126,15 +130,23 @@ class Dfstore:
         chunk_size: int = 1 << 20,
     ) -> int:
         """Stream an object to disk without holding it in RAM; returns bytes
-        written."""
+        written. Writes a temp file and renames on success so a mid-stream
+        failure never leaves a silently-truncated dest behind."""
         url = self._obj_url(bucket, key) + ("?mode=direct" if direct else "")
+        dest = Path(dest)
+        tmp = dest.with_name(dest.name + ".dfstore-partial")
         n = 0
-        async with self._sess().get(url) as r:
-            await self._raise_for(r)
-            with open(dest, "wb") as f:
-                async for chunk in r.content.iter_chunked(chunk_size):
-                    await asyncio.to_thread(f.write, chunk)
-                    n += len(chunk)
+        try:
+            async with self._sess().get(url) as r:
+                await self._raise_for(r)
+                with open(tmp, "wb") as f:
+                    async for chunk in r.content.iter_chunked(chunk_size):
+                        await asyncio.to_thread(f.write, chunk)
+                        n += len(chunk)
+            tmp.replace(dest)
+        except BaseException:
+            tmp.unlink(missing_ok=True)
+            raise
         return n
 
     async def stat_object(self, bucket: str, key: str) -> dict:

@@ -158,23 +158,27 @@ class ObjectGateway:
         map to JSON error responses."""
         agen = self.backend.get_object_stream(bucket, key)
         try:
-            first = await anext(agen, b"")
-        except ObjectStorageError as e:
-            return self._err(e)
-        resp = web.StreamResponse(
-            headers={
-                "Content-Length": str(meta.content_length),
-                "Content-Type": meta.content_type,
-                "ETag": meta.etag,
-            }
-        )
-        await resp.prepare(req)
-        if first:
-            await resp.write(first)
-        async for chunk in agen:
-            await resp.write(chunk)
-        await resp.write_eof()
-        return resp
+            try:
+                first = await anext(agen, b"")
+            except ObjectStorageError as e:
+                return self._err(e)
+            # chunked, no Content-Length: the length came from an earlier
+            # stat and a concurrent overwrite would desynchronize the framing
+            resp = web.StreamResponse(
+                headers={"Content-Type": meta.content_type, "ETag": meta.etag}
+            )
+            resp.enable_chunked_encoding()
+            await resp.prepare(req)
+            if first:
+                await resp.write(first)
+            async for chunk in agen:
+                await resp.write(chunk)
+            await resp.write_eof()
+            return resp
+        finally:
+            # early return, backend error, or client disconnect must not
+            # leave the backend's HTTP response open until GC
+            await agen.aclose()
 
     async def _get_object(self, req: web.Request) -> web.StreamResponse:
         bucket, key = req.match_info["bucket"], req.match_info["key"]

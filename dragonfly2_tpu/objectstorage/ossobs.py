@@ -105,7 +105,10 @@ class OssObsClient:
     def __init__(self, cfg: DialectConfig, dialect: Dialect, *, timeout: float = 300.0):
         self.cfg = cfg
         self.dialect = dialect
-        self._timeout = aiohttp.ClientTimeout(total=timeout)
+        # stall-based: a total cap would abort long streaming transfers
+        self._timeout = aiohttp.ClientTimeout(
+            total=None, connect=30.0, sock_read=timeout
+        )
         self._session: Optional[aiohttp.ClientSession] = None
 
     def _sess(self) -> aiohttp.ClientSession:
@@ -191,17 +194,24 @@ class OssObsClient:
         ) as resp:
             body = await resp.read()
             if resp.status not in ok:
-                code = ""
-                try:
-                    code = ET.fromstring(body.decode()).findtext("Code") or ""
-                except ET.ParseError:
-                    pass
-                raise DialectError(
-                    f"{self.dialect.label} {verb} {bucket}/{key}: HTTP {resp.status} {code}",
-                    status=resp.status,
-                    code=code,
-                )
+                raise self._http_error(verb, bucket, key, resp.status, body)
             return resp.status, body, dict(resp.headers)
+
+    def _http_error(
+        self, verb: str, bucket: str, key: str, status: int, body: bytes
+    ) -> DialectError:
+        code = ""
+        try:
+            # errors="replace": a non-UTF-8 error body must not mask the
+            # real HTTP failure with a UnicodeDecodeError
+            code = ET.fromstring(body.decode(errors="replace")).findtext("Code") or ""
+        except ET.ParseError:
+            pass
+        return DialectError(
+            f"{self.dialect.label} {verb} {bucket}/{key}: HTTP {status} {code}",
+            status=status,
+            code=code,
+        )
 
     # ---- buckets ----
 
@@ -260,7 +270,8 @@ class OssObsClient:
     async def get_object_stream(
         self, bucket: str, key: str, *, chunk_size: int = 1 << 20
     ) -> AsyncIterator[bytes]:
-        """Signed GET yielding chunks — large objects never buffer whole."""
+        """Signed GET yielding chunks — large objects never buffer whole.
+        Shares _request's signing plumbing; only the body read differs."""
         date = formatdate(usegmt=True)
         headers = {"Date": date}
         sts = string_to_sign(
@@ -273,16 +284,7 @@ class OssObsClient:
         resp = await self._sess().get(self._url(bucket, key), headers=headers)
         try:
             if resp.status != 200:
-                body = await resp.read()
-                code = ""
-                try:
-                    code = ET.fromstring(body.decode()).findtext("Code") or ""
-                except ET.ParseError:
-                    pass
-                raise DialectError(
-                    f"{self.dialect.label} GET {bucket}/{key}: HTTP {resp.status} {code}",
-                    status=resp.status, code=code,
-                )
+                raise self._http_error("GET", bucket, key, resp.status, await resp.read())
             async for chunk in resp.content.iter_chunked(chunk_size):
                 yield chunk
         finally:

@@ -156,7 +156,8 @@ class S3Client:
             netloc = netloc[: -len(default_port)]
         self._base = f"{parts.scheme}://{netloc}"
         self._host = netloc
-        self._timeout = aiohttp.ClientTimeout(total=timeout)
+        # stall-based: a total cap would abort long streaming gets/puts
+        self._timeout = aiohttp.ClientTimeout(total=None, connect=30.0, sock_read=timeout)
         self._session: aiohttp.ClientSession | None = None
 
     def _sess(self) -> aiohttp.ClientSession:
