@@ -70,6 +70,9 @@ class InProcessSchedulerClient:
     async def leave_peer(self, peer_id):
         self._svc.leave_peer(peer_id)
 
+    async def leave_host(self, host_id):
+        self._svc.leave_host(host_id)
+
     async def sync_probes(self, host_id, results):
         return self._svc.sync_probes(host_id, results)
 

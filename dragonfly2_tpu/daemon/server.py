@@ -408,6 +408,13 @@ async def run_daemon(
             await tcp_server.stop()
         if vsock_server is not None:
             await vsock_server.stop()
+        # graceful departure (ref scheduler v2 LeaveHost): tell the scheduler
+        # this host's peers are gone NOW so swarms re-parent immediately
+        # instead of burning retries against a dead peer until keepalive GC
+        try:
+            await scheduler.leave_host(engine.host_id)
+        except Exception:
+            logger.debug("leave_host on shutdown failed", exc_info=True)
         await engine.stop()
         await scheduler.close()
         if resolver_manager is not None:

@@ -225,6 +225,15 @@ class BalancedSchedulerClient:
         live on one scheduler; ref networktopology is per-scheduler)."""
         return await self._client(self.ring.pick(host_id)).sync_probes(host_id, results)
 
+    async def leave_host(self, host_id):
+        """Graceful departure fans out: any scheduler may hold this host's
+        peers (tasks hash to different owners)."""
+        for addr in self.ring.addresses:
+            try:
+                await self._client(addr).leave_host(host_id)
+            except Exception as e:
+                logger.warning("leave_host to %s failed: %s", addr, e)
+
     async def healthy(self) -> bool:
         for addr in self.ring.addresses:
             try:

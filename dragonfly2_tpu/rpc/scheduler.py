@@ -29,6 +29,7 @@ SCHEDULER_METHODS = [
     "report_peer_result",
     "reschedule",
     "leave_peer",
+    "leave_host",
     "announce_host",
     "stat_task",
     "sync_probes",
@@ -101,6 +102,9 @@ class SchedulerRpcAdapter:
     async def leave_peer(self, p: dict) -> None:
         self.svc.leave_peer(p["peer_id"])
 
+    async def leave_host(self, p: dict) -> None:
+        self.svc.leave_host(p["host_id"])
+
     async def announce_host(self, p: dict) -> None:
         self.svc.announce_host(HostInfo(**p["host"]), p.get("stats"))
 
@@ -169,6 +173,9 @@ class RemoteSchedulerClient:
 
     async def leave_peer(self, peer_id):
         await self._rpc.call("leave_peer", {"peer_id": peer_id})
+
+    async def leave_host(self, host_id):
+        await self._rpc.call("leave_host", {"host_id": host_id})
 
     async def announce_host(self, host: HostInfo, stats: dict | None = None):
         await self._rpc.call("announce_host", {"host": asdict(host), "stats": stats})

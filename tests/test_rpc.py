@@ -156,6 +156,9 @@ class TestSchedulerWire:
                 await client.report_peer_result("p1", success=True, bandwidth_bps=1e8)
                 await client.leave_peer("p2")
                 assert svc.pool.peer("p2") is None
+                # graceful host departure evicts all the host's peers at once
+                await client.leave_host("h1")
+                assert svc.pool.peer("p1") is None
             finally:
                 await client.close()
                 await server.stop()
@@ -196,6 +199,7 @@ def spawn_cluster(tmp_path, daemon_names, *, scheduler_args=()):
             )
             procs.append(d)
             assert d.stdout.readline().startswith("DAEMON_READY")
+        spawn_cluster.last_procs = procs  # tests that signal individual members
         yield sched_addr, socks, env
     finally:
         for p in procs:
@@ -388,6 +392,62 @@ class TestDfmodelCluster:
             assert r.returncode == 0, r.stderr
             for name, data in shards.items():
                 assert (out_dir / name).read_bytes() == data, name
+
+
+class TestGracefulDeparture:
+    def test_sigterm_daemon_leaves_scheduler(self, tmp_path):
+        """A SIGTERM'd daemon announces LeaveHost on the way out: its peers
+        vanish from the scheduler immediately (hosts gauge 2 -> 1) instead of
+        lingering as dead parents until keepalive GC."""
+        import socket
+        import urllib.request
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            metrics_port = s.getsockname()[1]
+
+        def hosts_gauge() -> float:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics_port}/metrics", timeout=5
+            ) as r:
+                for ln in r.read().decode().splitlines():
+                    if ln.startswith("dragonfly_scheduler_hosts "):
+                        return float(ln.rsplit(" ", 1)[1])
+            return float("nan")
+
+        payload = os.urandom(256 * 1024)
+        f = tmp_path / "f.bin"
+        f.write_bytes(payload)
+        with spawn_cluster(
+            tmp_path, ["gd1", "gd2"],
+            scheduler_args=("--metrics-port", str(metrics_port)),
+        ) as (sched_addr, socks, env):
+            for sock, out in ((socks[0], "o1.bin"), (socks[1], "o2.bin")):
+                r = subprocess.run(
+                    [sys.executable, "-m", "dragonfly2_tpu.cli.dfget",
+                     f"file://{f}", "-O", str(tmp_path / out), "--sock", sock,
+                     "--no-spawn", "--scheduler", sched_addr],
+                    capture_output=True, text=True, env=env, timeout=120,
+                )
+                assert r.returncode == 0, r.stderr
+            # the gauge refreshes on the scheduler's GC sweep (10 s cadence)
+            deadline = time.monotonic() + 25
+            while time.monotonic() < deadline and hosts_gauge() != 2.0:
+                time.sleep(0.5)
+            assert hosts_gauge() == 2.0
+            # SIGTERM the second daemon; its LeaveHost must land promptly
+            d2 = next(
+                p for p in spawn_cluster.last_procs
+                if "gd2" in " ".join(p.args)
+            )
+            d2.send_signal(signal.SIGTERM)
+            d2.wait(timeout=15)
+            deadline = time.monotonic() + 25  # next GC sweep reflects it
+            while time.monotonic() < deadline:
+                if hosts_gauge() == 1.0:
+                    break
+                time.sleep(0.5)
+            assert hosts_gauge() == 1.0
 
 
 class TestClusterMLLoop:
