@@ -20,6 +20,7 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import os
 import sys
 import time
 
@@ -188,6 +189,33 @@ async def run_scoring_stress(args: argparse.Namespace) -> dict:
         full_rps, full_lat = await measure(
             lambda c: svc.scheduling.find_candidate_parents_async(c)
         )
+
+        # Cost decomposition → the host's serving ceiling. Everything on this
+        # path is CPU work on the scheduler's event-loop core: feature
+        # assembly (Python/numpy) and the native GEMMs (which sit near the
+        # core's SIMD peak — see scorer.cc). 1/(prepare+ffi) is therefore the
+        # best ANY single-core deployment can serve end-to-end; the gap
+        # between achieved and ceiling is asyncio + micro-batch overhead. On
+        # multi-core hosts the micro-batcher offloads the native call (GIL
+        # released) so assembly and GEMMs pipeline, raising the ceiling
+        # toward 1/max(prepare, ffi).
+        probe_n = 512
+        t0 = time.monotonic()
+        for _ in range(probe_n):
+            ev._prepare(children[0], cand)
+        prepare_us = (time.monotonic() - t0) / probe_n * 1e6
+        feats, cc, pp, _known = ev._prepare(children[0], cand)
+        M = 8
+        mf = np.tile(feats, (M, 1, 1))
+        mc = np.tile(cc, (M, 1))
+        mp = np.tile(pp, (M, 1))
+        for _ in range(5):
+            scorer.score_rounds(mf, child=mc, parent=mp)
+        t0 = time.monotonic()
+        for _ in range(probe_n // M):
+            scorer.score_rounds(mf, child=mc, parent=mp)
+        ffi_us = (time.monotonic() - t0) / probe_n * 1e6
+        ceiling_rps = 1e6 / (prepare_us + ffi_us)
         scorer.close()
 
     def pct(lat: np.ndarray, q: float) -> float:
@@ -208,6 +236,11 @@ async def run_scoring_stress(args: argparse.Namespace) -> dict:
             "full_round_p99_ms": pct(full_lat, 99),
             "native_flushes": eval_flushes,
             "native_rounds": eval_rounds,
+            "prepare_us_per_round": round(prepare_us, 1),
+            "ffi_us_per_round_amortized": round(ffi_us, 1),
+            "single_core_ceiling_rps": round(ceiling_rps, 1),
+            "ceiling_fraction_achieved": round(eval_rps / ceiling_rps, 3),
+            "host_cpu_count": os.cpu_count(),
         },
     }
 

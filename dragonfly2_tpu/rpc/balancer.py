@@ -227,12 +227,17 @@ class BalancedSchedulerClient:
 
     async def leave_host(self, host_id):
         """Graceful departure fans out: any scheduler may hold this host's
-        peers (tasks hash to different owners)."""
-        for addr in self.ring.addresses:
+        peers (tasks hash to different owners). Concurrent, not serial — the
+        shutdown path must pay at most ONE RPC timeout even when several
+        schedulers are unreachable."""
+
+        async def _one(addr):
             try:
                 await self._client(addr).leave_host(host_id)
             except Exception as e:
                 logger.warning("leave_host to %s failed: %s", addr, e)
+
+        await asyncio.gather(*(_one(a) for a in self.ring.addresses))
 
     async def healthy(self) -> bool:
         for addr in self.ring.addresses:

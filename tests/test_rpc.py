@@ -170,10 +170,12 @@ import contextlib
 
 
 @contextlib.contextmanager
-def spawn_cluster(tmp_path, daemon_names, *, scheduler_args=()):
+def spawn_cluster(tmp_path, daemon_names, *, scheduler_args=(), procs_sink=None):
     """Boot a real scheduler + N daemons as subprocesses; yields
     (scheduler_addr, [daemon socks], env). SIGTERM/kill teardown and the
-    READY handshakes live here once instead of per test."""
+    READY handshakes live here once instead of per test. Tests that need to
+    signal individual members pass a list as `procs_sink` (scoped to this
+    cluster — a function attribute would leak across nested/parallel uses)."""
     env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
     procs = []
     try:
@@ -199,7 +201,8 @@ def spawn_cluster(tmp_path, daemon_names, *, scheduler_args=()):
             )
             procs.append(d)
             assert d.stdout.readline().startswith("DAEMON_READY")
-        spawn_cluster.last_procs = procs  # tests that signal individual members
+        if procs_sink is not None:  # tests that signal individual members
+            procs_sink.extend(procs)
         yield sched_addr, socks, env
     finally:
         for p in procs:
@@ -407,20 +410,28 @@ class TestGracefulDeparture:
             metrics_port = s.getsockname()[1]
 
         def hosts_gauge() -> float:
-            with urllib.request.urlopen(
-                f"http://127.0.0.1:{metrics_port}/metrics", timeout=5
-            ) as r:
-                for ln in r.read().decode().splitlines():
-                    if ln.startswith("dragonfly_scheduler_hosts "):
-                        return float(ln.rsplit(" ", 1)[1])
+            # nan on transient connect errors so the retry loops below keep
+            # polling instead of erroring out (the metrics listener can come
+            # up a beat after the RPC listener)
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{metrics_port}/metrics", timeout=5
+                ) as r:
+                    for ln in r.read().decode().splitlines():
+                        if ln.startswith("dragonfly_scheduler_hosts "):
+                            return float(ln.rsplit(" ", 1)[1])
+            except OSError:
+                pass
             return float("nan")
 
         payload = os.urandom(256 * 1024)
         f = tmp_path / "f.bin"
         f.write_bytes(payload)
+        procs = []
         with spawn_cluster(
             tmp_path, ["gd1", "gd2"],
             scheduler_args=("--metrics-port", str(metrics_port)),
+            procs_sink=procs,
         ) as (sched_addr, socks, env):
             for sock, out in ((socks[0], "o1.bin"), (socks[1], "o2.bin")):
                 r = subprocess.run(
@@ -436,10 +447,7 @@ class TestGracefulDeparture:
                 time.sleep(0.5)
             assert hosts_gauge() == 2.0
             # SIGTERM the second daemon; its LeaveHost must land promptly
-            d2 = next(
-                p for p in spawn_cluster.last_procs
-                if "gd2" in " ".join(p.args)
-            )
+            d2 = next(p for p in procs if "gd2" in " ".join(p.args))
             d2.send_signal(signal.SIGTERM)
             d2.wait(timeout=15)
             deadline = time.monotonic() + 25  # next GC sweep reflects it
