@@ -140,6 +140,7 @@ class _FakeNativeScorer:
         return ((child + parent) % 97).astype(np.float32) / 97.0
 
     def score(self, feats, *, child, parent):
+        # single-round entry (the sync evaluate() path)
         return self.score_rounds(feats[None], child=child[None], parent=parent[None])[0]
 
 
@@ -200,6 +201,34 @@ class TestMicroBatchedScheduling:
             assert parent.id not in [p.id for p in out.parents]
 
         run(body())
+
+    def test_mixed_known_hosts_mask_to_base_scores(self, run):
+        """Parents whose hosts the serving graph doesn't know get the BASE
+        score; known ones keep the ml score — the masking path of
+        MLEvaluator._prepare (known array), distinct from the all-known fast
+        path that returns ml scores without masking."""
+        from dragonfly2_tpu.models.features import BASE_WEIGHTS
+        from dragonfly2_tpu.scheduler.evaluator import build_pair_features
+
+        pool, task, hosts = make_pool_with_task(5)
+        child = add_running_peer(pool, task, hosts[0])
+        parents = [add_running_peer(pool, task, h, pieces=2) for h in hosts[1:]]
+        ev = new_evaluator("ml")
+        fake = _FakeNativeScorer()
+        # hosts[3] (parents[2]) is absent from the serving graph
+        node_index = {h.id: i for i, h in enumerate(hosts) if h is not hosts[3]}
+        ev.attach_scorer(fake, node_index)
+        got = ev.evaluate(child, parents)
+        base = build_pair_features(child, parents, None, None) @ BASE_WEIGHTS
+        # unknown parent carries its base score, known ones the fake ml score
+        assert got[2] == pytest.approx(float(base[2]))
+        ml_rows = [0, 1, 3]
+        assert all(got[i] != pytest.approx(float(base[i])) for i in ml_rows)
+
+        # all-known: scores come straight from the scorer (no masking)
+        ev.attach_scorer(fake, {h.id: i for i, h in enumerate(hosts)})
+        got_all = ev.evaluate(child, parents)
+        assert got_all.dtype == np.float32 and len(got_all) == 4
 
     def test_async_falls_back_to_base_without_microbatch(self, run):
         pool, task, hosts = make_pool_with_task(4)
