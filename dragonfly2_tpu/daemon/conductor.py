@@ -181,6 +181,7 @@ class PeerTaskConductor:
             self.bucket = TokenBucket(self.cfg.download_rate_bps, burst=64 << 20)
         self._session = http_session
         self._owns_session = http_session is None
+        self._raw_client = None  # lazy RawRangeClient (always conductor-owned)
         self.ts: TaskStorage | None = None
         self.bytes_from_parents = 0
         self.bytes_from_source = 0
@@ -218,6 +219,8 @@ class PeerTaskConductor:
                 close()  # release this task's slice of the host budget
             if self._owns_session and self._session is not None:
                 await self._session.close()
+            if self._raw_client is not None:
+                await self._raw_client.close()
 
     async def _run_inner(self) -> TaskStorage:
         reg = await self.scheduler.register_peer(self.peer_id, self.meta, self.host)
@@ -615,21 +618,30 @@ class PeerTaskConductor:
             return
         m = self.ts.meta
         r = piece_range(idx, m.piece_size, m.content_length)
-        url = (
-            f"http://{state.info.ip}:{state.info.download_port}"
+        path_qs = (
             f"/download/{self.meta.task_id[:3]}/{self.meta.task_id}?peerId={self.peer_id}"
         )
         t0 = time.monotonic()
         try:
             await self.bucket.acquire(r.length)
-            async with session.get(
-                url,
-                headers={"Range": r.header()},
-                timeout=aiohttp.ClientTimeout(total=self.cfg.piece_timeout),
-            ) as resp:
-                if resp.status != 206:
-                    raise IOError(f"parent returned HTTP {resp.status}")
-                data = await resp.read()
+            if r.length >= self._RAW_FETCH_BYTES:
+                # big pieces ride the raw keep-alive client: the body lands
+                # straight in a preallocated buffer (sock_recv_into), skipping
+                # aiohttp's chunk-list assembly — one full copy of every byte
+                # on the checkpoint fan-out path (see daemon/rawrange.py)
+                data = await self._raw_http().get_range(
+                    state.info.ip, state.info.download_port, path_qs,
+                    r.header(), r.length, timeout=self.cfg.piece_timeout,
+                )
+            else:
+                async with session.get(
+                    f"http://{state.info.ip}:{state.info.download_port}{path_qs}",
+                    headers={"Range": r.header()},
+                    timeout=aiohttp.ClientTimeout(total=self.cfg.piece_timeout),
+                ) as resp:
+                    if resp.status != 206:
+                        raise IOError(f"parent returned HTTP {resp.status}")
+                    data = await resp.read()
         except (aiohttp.ClientError, asyncio.TimeoutError, IOError) as e:
             cost = (time.monotonic() - t0) * 1000
             state.record(False, cost)
@@ -670,6 +682,17 @@ class PeerTaskConductor:
             # each a transport pause/resume round-trip on the event loop
             self._session = aiohttp.ClientSession(read_bufsize=1 << 20)
         return self._session
+
+    # pieces at/above this size fetch via the raw recv_into client; below it
+    # aiohttp's robustness is worth its copy (the copy is noise there)
+    _RAW_FETCH_BYTES = 256 << 10
+
+    def _raw_http(self) -> "RawRangeClient":
+        if self._raw_client is None:
+            from dragonfly2_tpu.daemon.rawrange import RawRangeClient
+
+            self._raw_client = RawRangeClient()
+        return self._raw_client
 
     async def _safe_report_peer(self, *, success: bool) -> None:
         if self._peer_reported:  # failure paths raise after reporting: once only

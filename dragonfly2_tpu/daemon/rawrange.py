@@ -1,0 +1,184 @@
+"""Zero-copy-ish HTTP/1.1 range client for peer piece fetches.
+
+The piece hot path (conductor._download_one_piece) fetched bodies through
+aiohttp: every received chunk passes the protocol's feed_data, is appended to
+a chunk list, and resp.read() joins the list — a full extra copy of every
+payload byte, plus per-chunk event-loop machinery. A cProfile of the
+checkpoint fan-out bench put that assembly (aiohttp data_received +
+bytes.join) at ~1.2 ns/byte of the ~3.7 ns/byte fetch-path total.
+
+This client receives the body DIRECTLY into a caller-visible preallocated
+buffer with ``loop.sock_recv_into`` — bytes go kernel→piece buffer with no
+intermediate chunk objects and no join pass. It speaks just enough HTTP/1.1
+for the peer upload server's download endpoint (daemon/upload.py
+_handle_download → aiohttp FileResponse): status 206, Content-Length framing
+(FileResponse never chunk-encodes a known-length range), keep-alive pooling
+per (host, port), one transparent retry when a pooled connection turns out to
+be a stale keep-alive socket.
+
+Reference context: the piece transfer protocol is the reference's HTTP
+`GET /download/{taskID[:3]}/{taskID}?peerId=` with a Range header
+(client/daemon/peer/piece_downloader.go:203-211); this is the same wire
+contract, with the client tuned for multi-hundred-MB/s single-core fan-out
+(north-star config 4).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import socket
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_MAX_HEADER_BYTES = 16 << 10
+_MAX_IDLE_PER_HOST = 4
+
+
+class RawRangeClient:
+    """Pooled keep-alive range GETs into preallocated buffers."""
+
+    def __init__(self, *, max_idle_per_host: int = _MAX_IDLE_PER_HOST):
+        self._pool: dict[tuple[str, int], list[socket.socket]] = {}
+        self._max_idle = max_idle_per_host
+        self._closed = False
+
+    async def close(self) -> None:
+        self._closed = True
+        for conns in self._pool.values():
+            for s in conns:
+                s.close()
+        self._pool.clear()
+
+    def _checkout(self, key: tuple[str, int]) -> Optional[socket.socket]:
+        conns = self._pool.get(key)
+        return conns.pop() if conns else None
+
+    def _checkin(self, key: tuple[str, int], sock: socket.socket) -> None:
+        if self._closed:
+            sock.close()
+            return
+        conns = self._pool.setdefault(key, [])
+        if len(conns) < self._max_idle:
+            conns.append(sock)
+        else:
+            sock.close()
+
+    async def get_range(
+        self,
+        ip: str,
+        port: int,
+        path_qs: str,
+        range_header: str,
+        length: int,
+        *,
+        timeout: float = 30.0,
+    ) -> bytearray:
+        """GET path_qs with the given Range header; expects a 206 whose body
+        is exactly `length` bytes and returns it as a bytearray (received in
+        place). Raises IOError on any other status or a short body."""
+        async with asyncio.timeout(timeout):
+            # One transparent retry, ONLY for a pooled socket that turns out
+            # to be a stale keep-alive connection (server closed it between
+            # uses → ConnectionError before any response). Deterministic
+            # application failures (non-206, bad framing) raise plain IOError
+            # and must NOT be replayed against an already-failing parent.
+            for attempt in (0, 1):
+                key = (ip, port)
+                sock = self._checkout(key)
+                pooled = sock is not None
+                try:
+                    if sock is None:
+                        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                        sock.setblocking(False)
+                        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                        await asyncio.get_running_loop().sock_connect(sock, (ip, port))
+                    return await self._request(
+                        sock, key, ip, port, path_qs, range_header, length
+                    )
+                except BaseException as e:
+                    # every failure path — including timeout expiry and task
+                    # cancellation mid-body — must close the socket: a piece
+                    # timeout against a stalled parent is routine, and each
+                    # one would otherwise leak an fd
+                    if sock is not None:
+                        sock.close()
+                    if pooled and attempt == 0 and isinstance(e, ConnectionError):
+                        continue
+                    raise
+            raise IOError("unreachable")  # pragma: no cover
+
+    async def _request(
+        self,
+        sock: socket.socket,
+        key: tuple[str, int],
+        ip: str,
+        port: int,
+        path_qs: str,
+        range_header: str,
+        length: int,
+    ) -> bytearray:
+        loop = asyncio.get_running_loop()
+        req = (
+            f"GET {path_qs} HTTP/1.1\r\n"
+            f"Host: {ip}:{port}\r\n"
+            f"Range: {range_header}\r\n"
+            "Connection: keep-alive\r\n"
+            "\r\n"
+        ).encode("ascii")
+        await loop.sock_sendall(sock, req)
+
+        head = bytearray()
+        while True:
+            end = head.find(b"\r\n\r\n")
+            if end >= 0:
+                break
+            if len(head) > _MAX_HEADER_BYTES:
+                raise IOError("response headers too large")
+            chunk = await loop.sock_recv(sock, 8192)
+            if not chunk:
+                raise ConnectionError("connection closed before response headers")
+            head += chunk
+        header_blob, leftover = head[:end].decode("latin-1"), head[end + 4 :]
+        lines = header_blob.split("\r\n")
+        parts = lines[0].split(" ", 2)
+        if len(parts) < 2 or not parts[1].isdigit():
+            raise IOError(f"malformed status line {lines[0]!r}")
+        status = int(parts[1])
+        headers = {}
+        for ln in lines[1:]:
+            k, _, v = ln.partition(":")
+            headers[k.strip().lower()] = v.strip()
+        if status != 206:
+            # no pooling across error responses — the error body would have
+            # to be drained to reuse the connection, and error paths are not
+            # worth a keep-alive optimization
+            sock.close()
+            raise IOError(f"parent returned HTTP {status}")
+        clen = headers.get("content-length")
+        if clen is None or not clen.isdigit() or int(clen) != length:
+            sock.close()
+            raise IOError(f"unexpected Content-Length {clen!r} (want {length})")
+        if "chunked" in headers.get("transfer-encoding", "").lower():
+            sock.close()
+            raise IOError("chunked range response unsupported")
+
+        buf = bytearray(length)
+        view = memoryview(buf)
+        off = len(leftover)
+        if off > length:
+            sock.close()
+            raise IOError("server sent more body bytes than Content-Length")
+        view[:off] = leftover
+        while off < length:
+            n = await loop.sock_recv_into(sock, view[off:])
+            if n == 0:
+                sock.close()
+                raise IOError(f"connection closed at byte {off}/{length}")
+            off += n
+        if headers.get("connection", "").lower() == "close":
+            sock.close()
+        else:
+            self._checkin(key, sock)
+        return buf

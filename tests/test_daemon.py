@@ -2,6 +2,7 @@
 
 import asyncio
 import hashlib
+import os
 import time
 
 import numpy as np
@@ -443,6 +444,57 @@ class TestUploadServer:
                         assert r.status == 404
                 assert srv.bytes_served == 4
             finally:
+                await srv.stop()
+
+        run(body())
+
+    def test_raw_range_client_against_upload_server(self, run, tmp_path):
+        """RawRangeClient (the recv_into piece fetcher large pieces ride)
+        against the real upload server: correct bytes, keep-alive socket
+        reuse across requests, and clean errors for non-206 responses."""
+
+        async def body():
+            from dragonfly2_tpu.daemon.rawrange import RawRangeClient
+
+            sm = StorageManager(tmp_path)
+            tid = "raw999"
+            payload0 = os.urandom(300_000)
+            payload1 = os.urandom(300_000)
+            tail = os.urandom(100_000)
+            ts = sm.register_task(tid, url="x")
+            ts.set_task_info(
+                content_length=700_000, piece_size=300_000, total_pieces=3
+            )
+            await ts.write_piece(0, payload0)
+            await ts.write_piece(1, payload1)
+            await ts.write_piece(2, tail)
+            srv = UploadServer(sm, port=0)
+            await srv.start()
+            raw = RawRangeClient()
+            try:
+                path = f"/download/{tid[:3]}/{tid}?peerId=t"
+                got0 = await raw.get_range(
+                    "127.0.0.1", srv.port, path, "bytes=0-299999", 300_000
+                )
+                assert bytes(got0) == payload0
+                # second fetch rides the pooled keep-alive connection
+                assert sum(len(v) for v in raw._pool.values()) == 1
+                got1 = await raw.get_range(
+                    "127.0.0.1", srv.port, path, "bytes=300000-599999", 300_000
+                )
+                assert bytes(got1) == payload1
+                got2 = await raw.get_range(
+                    "127.0.0.1", srv.port, path, "bytes=600000-699999", 100_000
+                )
+                assert bytes(got2) == tail
+                # an unknown task is a clean IOError, not a hang or garbage
+                with pytest.raises(IOError):
+                    await raw.get_range(
+                        "127.0.0.1", srv.port,
+                        "/download/nop/nope?peerId=t", "bytes=0-9", 10,
+                    )
+            finally:
+                await raw.close()
                 await srv.stop()
 
         run(body())
