@@ -71,32 +71,38 @@ class MicroBatchScorer:
         await asyncio.sleep(0)
         while self._pending:
             batch, self._pending = self._pending[: self._max_rounds], self._pending[self._max_rounds :]
+            # The NATIVE scorer rejects a flat batch containing any bad index
+            # (ValueError), so its rounds dispatch OPTIMISTICALLY — the
+            # per-round bounds checks (4 numpy reductions each) stay off the
+            # hot path and run only after a rejection, isolating the culprit
+            # round(s) and re-scoring the rest. Every other scorer must be
+            # validated UP FRONT: the JAX fallback's gather CLAMPS
+            # out-of-bounds indices under jit — a stale node id would return
+            # a wrong score instead of raising anything.
+            optimistic = getattr(self._scorer, "engine", None) == "native"
+            good = batch
             try:
-                good = self._validate(batch)
-            except Exception as e:  # a broken scorer must fail the batch's
-                for *_r, fut in batch:  # futures, not strand them forever
-                    if not fut.done():
-                        fut.set_exception(e)
-                continue
-            if not good:
-                continue
-            try:
-                if len(good) == 1 or not self._offload:
-                    # single-round (or single-core) latency path: a thread
-                    # hop costs more than it buys
-                    out, widths = self._score_assembled(good)
-                else:
-                    # Multi-round flush runs OFF the loop thread: the native
-                    # call releases the GIL (ctypes + OpenMP inside), so the
-                    # event loop keeps building the NEXT flush's features
-                    # while this one's GEMMs run — scoring and feature
-                    # assembly pipeline instead of serializing.
-                    out, widths = await asyncio.to_thread(self._score_assembled, good)
-            except Exception as e:  # pragma: no cover - defensive
-                for *_r, fut in good:
-                    if not fut.done():
-                        fut.set_exception(e)
-                continue
+                if not optimistic:
+                    good = self._validate(batch)
+                    if not good:
+                        continue
+                out, widths = await self._score(good)
+            except Exception as e:
+                if not optimistic:
+                    self._fail_all(good, e)
+                    continue
+                try:
+                    good = self._validate(good)
+                except Exception as ve:  # broken scorer: fail the flush
+                    self._fail_all(good, ve)
+                    continue
+                if not good:
+                    continue  # culprits already resolved by _validate
+                try:
+                    out, widths = await self._score(good)
+                except Exception as e2:  # pragma: no cover - defensive
+                    self._fail_all(good, e2)
+                    continue
             self.flushes += 1
             self.rounds += len(good)
             for m, (*_r, fut) in enumerate(good):
@@ -104,11 +110,30 @@ class MicroBatchScorer:
                     fut.set_result(out[m, : widths[m]])
             await asyncio.sleep(0)
 
+    async def _score(self, good) -> tuple[np.ndarray, list[int]]:
+        if len(good) == 1 or not self._offload:
+            # single-round (or single-core) latency path: a thread hop costs
+            # more than it buys
+            return self._score_assembled(good)
+        # Multi-round flush runs OFF the loop thread: the native call
+        # releases the GIL (ctypes + OpenMP inside), so the event loop keeps
+        # building the NEXT flush's features while this one's GEMMs run —
+        # scoring and feature assembly pipeline instead of serializing.
+        return await asyncio.to_thread(self._score_assembled, good)
+
+    @staticmethod
+    def _fail_all(rounds, err: BaseException) -> None:
+        for *_r, fut in rounds:
+            if not fut.done():
+                fut.set_exception(err)
+
     def _validate(self, batch) -> list:
-        """Per-round validation BEFORE assembly (loop thread — it resolves
-        futures): the native call rejects the whole flat batch on any bad
-        index, so one round carrying a stale node id (e.g. from a pre-refresh
-        graph) must fail alone, not take down 63 healthy concurrent rounds."""
+        """Per-round bounds checks, run ONLY after the native call rejected a
+        flat batch (loop thread — it resolves futures): the native call
+        rejects the whole batch on any bad index, so one round carrying a
+        stale node id (e.g. from a pre-refresh graph) must fail alone, not
+        take down 63 healthy concurrent rounds. Resolves culprit futures with
+        the error and returns the surviving rounds for re-scoring."""
         n = self._scorer.num_nodes
         good = []
         for f, c, p, fut in batch:

@@ -152,6 +152,82 @@ def test_microbatch_scorer_coalesces(tmp_path, trained):
     ns.close()
 
 
+def test_microbatch_bad_round_fails_alone(tmp_path, trained):
+    """One round carrying an out-of-range node id (a stale id from a
+    pre-refresh graph) must fail with ValueError while the concurrent healthy
+    rounds in the SAME flush still score — the optimistic-dispatch path: the
+    native call rejects the flat batch, per-round validation then isolates
+    the culprit and the survivors are re-scored."""
+    import asyncio
+
+    from dragonfly2_tpu.native import MicroBatchScorer
+
+    cluster, params, z, _ = trained
+    ns = NativeScorer(export_scorer_artifact(params, z, tmp_path / "s.dfsc"))
+    mb = MicroBatchScorer(ns)
+    rng = np.random.default_rng(9)
+    f = cluster.pairs.feats[:8].astype(np.float32)
+    good_c = rng.integers(0, 128, size=8).astype(np.int32)
+    good_p = rng.integers(0, 128, size=8).astype(np.int32)
+    bad_c = good_c.copy()
+    bad_c[3] = 10_000_000  # far past num_nodes
+
+    async def go():
+        return await asyncio.gather(
+            mb.score(f, child=good_c, parent=good_p),
+            mb.score(f, child=bad_c, parent=good_p),
+            mb.score(f, child=good_c, parent=good_p),
+            return_exceptions=True,
+        )
+
+    r0, r1, r2 = asyncio.run(go())
+    assert isinstance(r1, ValueError), r1
+    expected = ns.score(f, child=good_c, parent=good_p)
+    np.testing.assert_array_equal(r0, expected)
+    np.testing.assert_array_equal(r2, expected)
+    # the healthy rounds were still served by ONE coalesced re-score
+    assert mb.rounds == 2
+    ns.close()
+
+
+def test_microbatch_validates_up_front_for_non_native_scorer():
+    """A non-native scorer (the JAX fallback) CLAMPS out-of-bounds gather
+    indices under jit instead of raising — so the micro-batcher must bounds-
+    check its rounds BEFORE dispatch: a stale node id must surface as
+    ValueError, never as a silently wrong score from a clamped embedding."""
+    import asyncio
+
+    from dragonfly2_tpu.native import MicroBatchScorer
+
+    class _ClampingJaxLike:
+        """score_rounds never raises on bad indices — like jnp.take."""
+
+        ready = True
+        engine = "jax"
+        feature_dim = 16
+        num_nodes = 128
+
+        def score_rounds(self, feats, *, child, parent):
+            return np.zeros(child.shape, np.float32)
+
+    mb = MicroBatchScorer(_ClampingJaxLike())
+    f = np.zeros((4, 16), np.float32)
+    ok = np.arange(4, dtype=np.int32)
+    bad = ok.copy()
+    bad[1] = 999  # >= num_nodes; the fake would happily "score" it
+
+    async def go():
+        return await asyncio.gather(
+            mb.score(f, child=ok, parent=ok),
+            mb.score(f, child=bad, parent=ok),
+            return_exceptions=True,
+        )
+
+    r_ok, r_bad = asyncio.run(go())
+    assert isinstance(r_bad, ValueError), r_bad
+    np.testing.assert_array_equal(r_ok, np.zeros(4, np.float32))
+
+
 def test_microbatch_offload_path_matches_inline(tmp_path, trained):
     """offload=True runs multi-round flushes in a worker thread (the
     multicore serving pipeline); results, error isolation, and counters must
