@@ -26,7 +26,7 @@ import logging
 import random
 import time
 from dataclasses import dataclass, field
-from typing import Optional, Protocol
+from typing import Protocol
 
 import aiohttp
 

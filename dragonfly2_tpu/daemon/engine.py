@@ -13,7 +13,6 @@ import asyncio
 import logging
 import weakref
 from pathlib import Path
-from typing import Optional
 
 from dragonfly2_tpu.daemon.conductor import ConductorConfig, PeerTaskConductor, SchedulerClient
 from dragonfly2_tpu.daemon.source import SourceRegistry

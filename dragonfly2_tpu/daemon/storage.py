@@ -15,11 +15,10 @@ import os
 import time
 from dataclasses import asdict, dataclass, field
 from pathlib import Path
-from typing import Iterable, Optional
 
 from dragonfly2_tpu.utils import digest as digestlib
 from dragonfly2_tpu.utils.bitset import Bitset
-from dragonfly2_tpu.utils.pieces import Range, piece_count, piece_range
+from dragonfly2_tpu.utils.pieces import Range, piece_range
 
 
 @dataclass

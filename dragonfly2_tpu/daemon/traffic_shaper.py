@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import logging
 import time
-from typing import Optional
 
 from dragonfly2_tpu.utils.ratelimit import TokenBucket
 

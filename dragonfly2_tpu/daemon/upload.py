@@ -11,12 +11,10 @@ ref client/config/constants.go:47).
 
 from __future__ import annotations
 
-import asyncio
 import logging
 import math
 import time
 import weakref
-from typing import Optional
 
 from aiohttp import web
 

@@ -14,10 +14,9 @@ SUCCESS when every cluster item succeeds, FAILURE if any fails
 from __future__ import annotations
 
 import asyncio
-import json
 import logging
 import time
-from typing import Any, Optional
+from typing import Optional
 
 from dragonfly2_tpu.manager.db import Database
 

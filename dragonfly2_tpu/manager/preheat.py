@@ -8,11 +8,8 @@ OCI/Docker v2 manifest schema only; manifest lists recurse one level.
 
 from __future__ import annotations
 
-import json
 import logging
 import re
-from typing import Any
-from urllib.parse import urlsplit
 
 import aiohttp
 

@@ -8,7 +8,6 @@ route shape kept 1:1 so ops tooling ports directly.
 
 from __future__ import annotations
 
-import json
 import logging
 from typing import Any
 

@@ -16,7 +16,6 @@ import logging
 import os
 import struct
 import subprocess
-import tempfile
 from pathlib import Path
 from typing import Any
 

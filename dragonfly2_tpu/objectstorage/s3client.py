@@ -16,7 +16,7 @@ import hmac
 import os
 import xml.etree.ElementTree as ET
 from dataclasses import dataclass, field
-from typing import AsyncIterator, Optional
+from typing import AsyncIterator
 from urllib.parse import quote, urlsplit
 
 import aiohttp

@@ -22,7 +22,6 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 TILE_N = 128
 # A[TILE_N, N] + h[N, H] + out[TILE_N, H] must fit VMEM together; budget

@@ -27,7 +27,6 @@ def main() -> None:
     dist.initialize(cfg)
 
     import jax
-    import jax.numpy as jnp
     import numpy as np
 
     from dragonfly2_tpu.parallel import mesh as meshlib

@@ -18,7 +18,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import struct
-from typing import Any, Awaitable, Callable, Optional
+from typing import Any, Awaitable, Callable
 
 import msgpack
 

@@ -14,7 +14,6 @@ from __future__ import annotations
 import asyncio
 import logging
 
-import numpy as np
 
 from dragonfly2_tpu.rpc.trainer import RemoteTrainerClient
 from dragonfly2_tpu.telemetry import TelemetryStorage

@@ -18,7 +18,7 @@ from __future__ import annotations
 import functools
 import logging
 import statistics
-from typing import Optional, Sequence
+from typing import Sequence
 
 import numpy as np
 
@@ -27,7 +27,7 @@ from dragonfly2_tpu.models.features import (
     FEATURE_DIM,
     location_affinity,
 )
-from dragonfly2_tpu.scheduler.resource import Host, HostType, Peer
+from dragonfly2_tpu.scheduler.resource import HostType, Peer
 
 logger = logging.getLogger(__name__)
 

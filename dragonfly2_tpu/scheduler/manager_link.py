@@ -19,7 +19,6 @@ import asyncio
 import logging
 import os
 import socket
-from typing import Optional
 
 from dragonfly2_tpu.rpc.core import RpcClient
 from dragonfly2_tpu.rpc.manager import RemoteManagerClient

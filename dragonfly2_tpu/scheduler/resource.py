@@ -12,13 +12,13 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from enum import Enum
-from typing import Any, Callable, Iterable, Optional
+from typing import Any
 
 from dragonfly2_tpu.utils import idgen
 from dragonfly2_tpu.utils.bitset import Bitset
-from dragonfly2_tpu.utils.dag import DAG, CycleError, VertexNotFound
+from dragonfly2_tpu.utils.dag import DAG, VertexNotFound
 from dragonfly2_tpu.utils.fsm import FSM, Event
 from dragonfly2_tpu.utils.pieces import compute_piece_size, piece_count
 

@@ -17,7 +17,7 @@ import asyncio
 import logging
 import random
 from dataclasses import dataclass, field
-from typing import Callable, Optional, Sequence
+from typing import Callable
 
 import numpy as np
 

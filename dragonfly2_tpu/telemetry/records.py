@@ -9,7 +9,6 @@ training pair batches and edge lists without unflattening.
 
 from __future__ import annotations
 
-import os
 import time
 from pathlib import Path
 

@@ -85,7 +85,6 @@ def main() -> None:
     if args.cpu or os.environ.get("DF_BENCH_FORCE_CPU"):
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
-    import optax
 
     from dragonfly2_tpu.models.graphsage import TopoGraph
     from dragonfly2_tpu.ops.neighbor_agg import masked_mean, neighbor_gather
