@@ -64,25 +64,70 @@ class GNNScorer:
         self._model = model
         self._params = _to_device(params, device)
         self._z: jax.Array | None = None
+        self._uc: jax.Array | None = None
+        self._up: jax.Array | None = None
+        dt = model.dtype
 
-        def _embed(params: Any, g: TopoGraph) -> jax.Array:
-            return model.apply(params, g, method=model.embed)
+        def _embed_and_proj(params: Any, g: TopoGraph):
+            """Embeddings + LOAD-TIME head-layer-1 partials (the same
+            precompute scorer.cc does natively): the head's first Dense sees
+            x = [zc, zp, zc*zp, feats], so its kernel splits row-wise into
+            per-term blocks — the zc and zp blocks depend only on the node,
+            and projecting the whole table once per refresh removes ~half the
+            per-round head FLOPs (only the pairwise zc*zp block and the tiny
+            feats block remain per candidate). Partials are kept in float32
+            (f32-accumulated bf16 dots), so the per-round partial SUM loses
+            nothing vs the original single fused matmul."""
+            z = model.apply(params, g, method=model.embed)
+            w1 = params["params"]["head"]["layers_0"]["kernel"]
+            e = z.shape[1]
+            zb = z.astype(dt)
+            uc = jnp.dot(zb, w1[:e].astype(dt), preferred_element_type=jnp.float32)
+            up = jnp.dot(zb, w1[e : 2 * e].astype(dt), preferred_element_type=jnp.float32)
+            return z, uc, up
 
-        def _score(params: Any, z: jax.Array, child: jax.Array, parent: jax.Array, feats: jax.Array) -> jax.Array:
+        def _head_tail(m: TopoScorer, v: jax.Array) -> jax.Array:
+            # the rest of the head THROUGH THE MODEL (no hand-copied layer
+            # names/activations to drift when TopoScorer.head changes; only
+            # the first Dense is split for the precompute, and the shape
+            # assert below catches a changed layer-1 signature)
+            for layer in m.head.layers[1:]:
+                v = layer(v)
+            return v
+
+        def _score(params: Any, z: jax.Array, uc: jax.Array, up: jax.Array,
+                   child: jax.Array, parent: jax.Array, feats: jax.Array) -> jax.Array:
+            head = params["params"]["head"]
+            w1 = head["layers_0"]["kernel"]
+            e = z.shape[1]
+            assert w1.shape[0] == 3 * e + feats.shape[-1], (
+                f"head layer-1 kernel {w1.shape} no longer matches the "
+                f"[zc, zp, zc*zp, feats] split (e={e}, Fp={feats.shape[-1]}) — "
+                "update GNNScorer's precompute decomposition"
+            )
             zc = jnp.take(z, child, axis=0)
             zp = jnp.take(z, parent, axis=0)
-            x = jnp.concatenate([zc, zp, zc * zp, feats], axis=-1).astype(model.dtype)
-            head = lambda p, v: model.apply(p, v, method=lambda m, vv: m.head(vv))
-            out = head(params, x).astype(jnp.float32).squeeze(-1)
-            return jax.nn.sigmoid(out)
+            # f32 partial sum; bf16 rounding happens once, at the gelu input,
+            # exactly where the original fused Dense rounded its output
+            h = (
+                jnp.take(uc, child, axis=0)
+                + jnp.take(up, parent, axis=0)
+                + jnp.dot((zc * zp).astype(dt), w1[2 * e : 3 * e].astype(dt),
+                          preferred_element_type=jnp.float32)
+                + feats @ w1[3 * e :]
+                + head["layers_0"]["bias"]
+            )
+            out = model.apply(params, h.astype(dt), method=_head_tail)
+            return jax.nn.sigmoid(out.astype(jnp.float32).squeeze(-1))
 
-        self._embed = jax.jit(_embed)
+        self._embed_and_proj = jax.jit(_embed_and_proj)
         self._score_fn = jax.jit(_score)
 
     def refresh(self, graph: TopoGraph) -> None:
-        """Recompute cached node embeddings (call when telemetry updates)."""
+        """Recompute cached node embeddings + head partials (call when
+        telemetry updates)."""
         g = TopoGraph(*(jax.device_put(np.asarray(a), self._device) for a in graph))
-        self._z = self._embed(self._params, g)
+        self._z, self._uc, self._up = self._embed_and_proj(self._params, g)
         self._z.block_until_ready()
 
     @property
@@ -98,7 +143,7 @@ class GNNScorer:
 
     def update_params(self, params: Any) -> None:
         self._params = _to_device(params, self._device)
-        self._z = None
+        self._z = self._uc = self._up = None
 
     @property
     def ready(self) -> bool:
@@ -113,6 +158,8 @@ class GNNScorer:
         out = self._score_fn(
             self._params,
             self._z,
+            self._uc,
+            self._up,
             jax.device_put(np.asarray(child, np.int32), dev),
             jax.device_put(np.asarray(parent, np.int32), dev),
             jax.device_put(np.asarray(pair_feats, np.float32), dev),
