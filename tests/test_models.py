@@ -83,6 +83,31 @@ class TestScorers:
         np.testing.assert_allclose(scores[1], 0.2, rtol=1e-6)
         assert scores[2] == 0.0
 
+    def test_gnn_scorer_update_params_resets_caches(self, tiny_cluster):
+        """In-place param swap must invalidate BOTH the embedding table and
+        the precomputed head partials — serving resumes only after the next
+        refresh, and with scores from the new params."""
+        from dragonfly2_tpu.trainer import train_gnn
+
+        cfg = train_gnn.GNNTrainConfig(hidden=32, embed_dim=16, num_layers=2)
+        model = train_gnn.make_model(cfg)
+        s1 = train_gnn.init_state(cfg, tiny_cluster.graph, rng_seed=1)
+        s2 = train_gnn.init_state(cfg, tiny_cluster.graph, rng_seed=2)
+        scorer = GNNScorer(model, s1.params)
+        scorer.refresh(tiny_cluster.graph)
+        child = tiny_cluster.pairs.child[:8]
+        parent = tiny_cluster.pairs.parent[:8]
+        feats = tiny_cluster.pairs.feats[:8]
+        old = scorer.score(feats, child=child, parent=parent)
+
+        scorer.update_params(s2.params)
+        assert not scorer.ready  # caches dropped, must refresh first
+        with pytest.raises(RuntimeError):
+            scorer.score(feats, child=child, parent=parent)
+        scorer.refresh(tiny_cluster.graph)
+        new = scorer.score(feats, child=child, parent=parent)
+        assert not np.allclose(old, new)  # genuinely the new model's scores
+
     def test_gnn_scorer_roundtrip(self, tiny_cluster):
         from dragonfly2_tpu.trainer import train_gnn
 
