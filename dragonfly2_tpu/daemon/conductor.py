@@ -156,6 +156,7 @@ class PeerTaskConductor:
         http_session: aiohttp.ClientSession | None = None,
         headers: dict[str, str] | None = None,
         shaper=None,
+        raw_client=None,
     ):
         from dragonfly2_tpu.utils.dflog import with_context
 
@@ -181,7 +182,10 @@ class PeerTaskConductor:
             self.bucket = TokenBucket(self.cfg.download_rate_bps, burst=64 << 20)
         self._session = http_session
         self._owns_session = http_session is None
-        self._raw_client = None  # lazy RawRangeClient (always conductor-owned)
+        # engine-shared RawRangeClient when provided (keep-alive conns to
+        # parents survive across this host's tasks); else lazily owned
+        self._raw_client = raw_client
+        self._owns_raw = raw_client is None
         self.ts: TaskStorage | None = None
         self.bytes_from_parents = 0
         self.bytes_from_source = 0
@@ -219,7 +223,7 @@ class PeerTaskConductor:
                 close()  # release this task's slice of the host budget
             if self._owns_session and self._session is not None:
                 await self._session.close()
-            if self._raw_client is not None:
+            if self._owns_raw and self._raw_client is not None:
                 await self._raw_client.close()
 
     async def _run_inner(self) -> TaskStorage:

@@ -139,6 +139,10 @@ class PeerEngine:
                 disk_high_ratio=disk_gc_threshold,
             ),
         )
+        # close pooled piece-fetch sockets idle past their keep-alive window
+        # (parents never contacted again must not pin fds forever)
+        self.gc.add("raw-pool-prune", 120.0, self._prune_raw_pool)
+        self._raw_client = None
         self._started = False
 
     async def _run_reclaim(self, **kw) -> None:
@@ -172,11 +176,30 @@ class PeerEngine:
             self.gc.start()
             self._started = True
 
+    def _shared_raw_client(self):
+        """One raw range client for ALL conductors: keep-alive connections to
+        a parent survive across tasks, so a recursive dfget (or a multi-file
+        checkpoint fetch) reuses sockets instead of reconnecting per file."""
+        if self._raw_client is None:
+            from dragonfly2_tpu.daemon.rawrange import RawRangeClient
+
+            self._raw_client = RawRangeClient()
+        return self._raw_client
+
+    async def _prune_raw_pool(self) -> None:
+        if self._raw_client is not None:
+            closed = self._raw_client.prune()
+            if closed:
+                logger.debug("raw range pool: pruned %d idle sockets", closed)
+
     async def stop(self) -> None:
         if self._started:
             self.gc.stop()
             await self.upload.stop()
             await self.sources.close()
+            if self._raw_client is not None:
+                await self._raw_client.close()
+                self._raw_client = None
             self.storage.flush_all()  # persist debounced piece metadata
             self._started = False
 
@@ -249,6 +272,7 @@ class PeerEngine:
             config=self.conductor_config,
             headers=headers,
             shaper=self.shaper,
+            raw_client=self._shared_raw_client(),
         )
         producer = asyncio.ensure_future(conductor.run())
         # Wait until the conductor registered storage + metadata. Polling:

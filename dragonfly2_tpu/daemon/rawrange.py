@@ -34,26 +34,64 @@ logger = logging.getLogger(__name__)
 
 _MAX_HEADER_BYTES = 16 << 10
 _MAX_IDLE_PER_HOST = 4
+# pooled sockets older than this are assumed dead (peer upload servers close
+# idle keep-alive connections after ~75 s) and are discarded at checkout /
+# pruned periodically rather than tried
+_IDLE_TTL_S = 60.0
 
 
 class RawRangeClient:
     """Pooled keep-alive range GETs into preallocated buffers."""
 
-    def __init__(self, *, max_idle_per_host: int = _MAX_IDLE_PER_HOST):
-        self._pool: dict[tuple[str, int], list[socket.socket]] = {}
+    def __init__(
+        self,
+        *,
+        max_idle_per_host: int = _MAX_IDLE_PER_HOST,
+        idle_ttl_s: float = _IDLE_TTL_S,
+    ):
+        import time
+
+        self._now = time.monotonic
+        self._pool: dict[tuple[str, int], list[tuple[socket.socket, float]]] = {}
         self._max_idle = max_idle_per_host
+        self._idle_ttl = idle_ttl_s
         self._closed = False
 
     async def close(self) -> None:
         self._closed = True
         for conns in self._pool.values():
-            for s in conns:
+            for s, _t in conns:
                 s.close()
         self._pool.clear()
 
+    def prune(self) -> int:
+        """Close pooled sockets idle past the TTL (parents never contacted
+        again would otherwise pin CLOSE_WAIT fds for the process lifetime —
+        the engine runs this off its GC registry). Returns sockets closed."""
+        cutoff = self._now() - self._idle_ttl
+        closed = 0
+        for key in list(self._pool):
+            kept = []
+            for s, t in self._pool[key]:
+                if t < cutoff:
+                    s.close()
+                    closed += 1
+                else:
+                    kept.append((s, t))
+            if kept:
+                self._pool[key] = kept
+            else:
+                del self._pool[key]
+        return closed
+
     def _checkout(self, key: tuple[str, int]) -> Optional[socket.socket]:
         conns = self._pool.get(key)
-        return conns.pop() if conns else None
+        while conns:
+            s, t = conns.pop()
+            if self._now() - t <= self._idle_ttl:
+                return s
+            s.close()  # idle past the server's keep-alive window: dead
+        return None
 
     def _checkin(self, key: tuple[str, int], sock: socket.socket) -> None:
         if self._closed:
@@ -61,7 +99,7 @@ class RawRangeClient:
             return
         conns = self._pool.setdefault(key, [])
         if len(conns) < self._max_idle:
-            conns.append(sock)
+            conns.append((sock, self._now()))
         else:
             sock.close()
 
@@ -79,13 +117,16 @@ class RawRangeClient:
         is exactly `length` bytes and returns it as a bytearray (received in
         place). Raises IOError on any other status or a short body."""
         async with asyncio.timeout(timeout):
-            # One transparent retry, ONLY for a pooled socket that turns out
-            # to be a stale keep-alive connection (server closed it between
-            # uses → ConnectionError before any response). Deterministic
-            # application failures (non-206, bad framing) raise plain IOError
-            # and must NOT be replayed against an already-failing parent.
-            for attempt in (0, 1):
-                key = (ip, port)
+            # Transparent retries ONLY for pooled sockets that turn out to be
+            # stale keep-alive connections (server closed them between uses →
+            # ConnectionError before any response): the loop drains however
+            # many stale sockets the pool holds — with a cross-task shared
+            # pool, EVERY pooled socket to a host can be stale after an idle
+            # gap — and the final fresh-connection attempt is authoritative.
+            # Deterministic application failures (non-206, bad framing) raise
+            # plain IOError and are never replayed.
+            key = (ip, port)
+            while True:
                 sock = self._checkout(key)
                 pooled = sock is not None
                 try:
@@ -104,10 +145,9 @@ class RawRangeClient:
                     # one would otherwise leak an fd
                     if sock is not None:
                         sock.close()
-                    if pooled and attempt == 0 and isinstance(e, ConnectionError):
-                        continue
+                    if pooled and isinstance(e, ConnectionError):
+                        continue  # drain the next pooled socket (or go fresh)
                     raise
-            raise IOError("unreachable")  # pragma: no cover
 
     async def _request(
         self,

@@ -487,6 +487,13 @@ class TestUploadServer:
                     "127.0.0.1", srv.port, path, "bytes=600000-699999", 100_000
                 )
                 assert bytes(got2) == tail
+                # idle-TTL pruning: a parent never contacted again must not
+                # pin its pooled fds forever (engine runs prune off its GC)
+                raw._idle_ttl = 0.01
+                await asyncio.sleep(0.05)
+                assert raw.prune() >= 1
+                assert raw._pool == {}
+                raw._idle_ttl = 60.0
                 # an unknown task is a clean IOError, not a hang or garbage
                 with pytest.raises(IOError):
                     await raw.get_range(
@@ -520,19 +527,26 @@ class TestUploadServer:
             raw = RawRangeClient()
             try:
                 path = f"/download/{tid[:3]}/{tid}?peerId=t"
-                # seed the pool with a PEER-CLOSED socket posing as a stale
-                # keep-alive conn (the server hung up between uses)
-                dead, far = socketlib.socketpair()
-                far.close()
-                dead.setblocking(False)
-                raw._pool[("127.0.0.1", srv.port)] = [dead]
+                # seed the pool with TWO peer-closed sockets posing as stale
+                # keep-alive conns (the server hung up between uses) — the
+                # drain loop must consume BOTH before connecting fresh (the
+                # engine-shared pool can be entirely stale after an idle gap)
+                stale = []
+                for _ in range(2):
+                    dead, far = socketlib.socketpair()
+                    far.close()
+                    dead.setblocking(False)
+                    stale.append(dead)
+                raw._pool[("127.0.0.1", srv.port)] = [
+                    (s, time.monotonic()) for s in stale
+                ]
                 got = await raw.get_range(
                     "127.0.0.1", srv.port, path, "bytes=0-299999", 300_000
                 )
-                assert bytes(got) == payload  # retried on a fresh connection
-                # the stale socket was actually consumed and closed by the
-                # retry path (not bypassed by a checkout miss)
-                assert dead.fileno() == -1
+                assert bytes(got) == payload  # drained both, connected fresh
+                # the stale sockets were actually consumed and closed by the
+                # drain loop (not bypassed by a checkout miss)
+                assert all(s.fileno() == -1 for s in stale)
 
                 # a server that never answers: timeout must close the socket
                 stall = socketlib.socket()
