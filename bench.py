@@ -401,7 +401,8 @@ def _gnn_train_measured(
     # Each window ends by PULLING the final step's loss to the host, not just
     # block_until_ready: the loss chains through every optimizer step of
     # every call in the window, so its D2H materialization proves the whole
-    # window's compute ran. (Measured on the tunneled backend:
+    # window's compute ran. (dflint DF013 accepts exactly this np.asarray
+    # pull as the window's sync — keep it inside the timed region.) (Measured on the tunneled backend:
     # block_until_ready can return before chained scan calls actually
     # execute — a 300-step window "completed" in 1.8 ms against a ≥12 ms
     # ideal-compute floor. A number that outruns physics is a timing bug,

@@ -62,8 +62,8 @@ async def splice(
         finally:
             try:
                 dst.close()
-            except Exception:
-                pass
+            except (OSError, RuntimeError):
+                pass  # peer already gone / loop tearing down
 
     await asyncio.gather(pipe(client_r, upstream_w), pipe(upstream_r, client_w))
 
@@ -199,14 +199,14 @@ class ProxyServer:
             logger.exception("proxy connection failed")
             try:
                 await self._respond_simple(writer, 502, b"proxy error")
-            except Exception:
-                pass
+            except (OSError, RuntimeError):
+                pass  # client hung up before the error reply landed
         finally:
             try:
                 writer.close()
                 await writer.wait_closed()
-            except Exception:
-                pass
+            except (OSError, RuntimeError):
+                pass  # already closed by the peer
 
     @staticmethod
     async def _read_request(reader: asyncio.StreamReader):
@@ -614,8 +614,8 @@ class SniProxy:
             try:
                 writer.close()
                 await writer.wait_closed()
-            except Exception:
-                pass
+            except (OSError, RuntimeError):
+                pass  # already closed by the peer
 
     async def _handle_hijack(
         self, sni: str, reader: asyncio.StreamReader, writer: asyncio.StreamWriter

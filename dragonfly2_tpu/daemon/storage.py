@@ -136,7 +136,9 @@ class TaskStorage:
         tmp = self.dir / "metadata.json.tmp"
         tmp.write_text(json.dumps(asdict(self.meta)))
         tmp.replace(self.dir / "metadata.json")
-        self._meta_dirty = False
+        # sync method on the loop thread: the flag flip cannot interleave with
+        # the locked writer path (which sets it True between awaits)
+        self._meta_dirty = False  # dflint: disable=DF023 sync path, no await in this method
         self._meta_flushed_count = self._bitset.count()
         self._meta_flushed_at = time.monotonic()
 

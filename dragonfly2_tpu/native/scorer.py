@@ -227,5 +227,5 @@ class NativeScorer:
     def __del__(self):  # best-effort; close() is the real API
         try:
             self.close()
-        except Exception:
+        except Exception:  # dflint: disable=DF031 interpreter teardown can raise anything; __del__ must not
             pass

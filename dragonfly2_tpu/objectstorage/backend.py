@@ -20,12 +20,15 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import json
+import logging
 import shutil
 import time
 import uuid
 from dataclasses import asdict, dataclass, field
 from pathlib import Path
 from typing import AsyncIterator, Union
+
+logger = logging.getLogger(__name__)
 
 
 class ObjectStorageError(Exception):
@@ -188,8 +191,9 @@ async def stream_multipart_put(
         if upload_id is not None:
             try:
                 await client.abort_multipart(bucket, key, upload_id=upload_id)
-            except Exception:
-                pass  # best-effort: the store reaps stale uploads
+            except Exception as abort_err:
+                # best-effort: the store reaps stale uploads
+                logger.debug("multipart abort for %s/%s failed: %s", bucket, key, abort_err)
         raise
     return etag, length, h.hexdigest()
 

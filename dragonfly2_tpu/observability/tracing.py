@@ -18,7 +18,9 @@ from __future__ import annotations
 
 import contextvars
 import json
+import logging
 import os
+import queue as queue_mod
 import secrets
 import threading
 import time
@@ -289,15 +291,13 @@ class Tracer:
                 self._ensure_otlp_worker()
                 try:
                     self._otlp_queue.put_nowait(req)
-                except Exception:  # queue full — drop, don't block the loop
+                except queue_mod.Full:  # drop the batch, don't block the loop
                     pass
 
     def _ensure_otlp_worker(self) -> None:
         if self._otlp_worker is None or not self._otlp_worker.is_alive():
-            import queue
-
             if self._otlp_queue is None:
-                self._otlp_queue = queue.Queue(maxsize=64)
+                self._otlp_queue = queue_mod.Queue(maxsize=64)
             self._otlp_worker = threading.Thread(
                 target=self._otlp_worker_loop, daemon=True
             )
@@ -320,8 +320,8 @@ class Tracer:
                 headers={"Content-Type": "application/json"},
             )
             urllib.request.urlopen(r, timeout=10).close()
-        except Exception:  # noqa: BLE001 — tracing must never take a service down
-            pass
+        except Exception as e:  # noqa: BLE001 — tracing must never take a service down
+            logging.getLogger(__name__).debug("otlp export failed: %s", e)
 
     def flush_otlp(self, *, sync: bool = False) -> None:
         """Force out any buffered OTLP batch (shutdown / tests)."""

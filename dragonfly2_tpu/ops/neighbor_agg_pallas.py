@@ -39,7 +39,7 @@ def _agg_kernel(nbr_ref, mask_ref, h_ref, out_ref, *, k: int, eps: float):
     for kk in range(k):  # K is small and static: unrolled VPU compares
         idx = nbr_ref[:, kk][:, None]  # [tile, 1]
         m = mask_ref[:, kk][:, None].astype(jnp.float32)
-        acc = acc + jnp.where(col == idx, m, 0.0)
+        acc = acc + jnp.where(col == idx, m, 0.0)  # dflint: disable=DF012 K<=16 static unroll IS the kernel design
     sums = jnp.dot(acc, h_ref[:].astype(jnp.float32), preferred_element_type=jnp.float32)
     count = jnp.sum(mask_ref[:].astype(jnp.float32), axis=1, keepdims=True)
     out_ref[:] = (sums / (count + eps)).astype(out_ref.dtype)

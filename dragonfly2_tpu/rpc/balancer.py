@@ -244,7 +244,8 @@ class BalancedSchedulerClient:
             try:
                 if await self._client(addr).healthy():
                     return True
-            except Exception:
+            except Exception as e:
+                logger.debug("health probe of %s failed: %s", addr, e)
                 continue
         return False
 

@@ -150,6 +150,9 @@ class RpcServer:
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         tasks: set[asyncio.Task] = set()
+        # Created inside the connection coroutine, so it binds to the serving
+        # loop (dflint DF021 audit: per-connection scope is the correct place;
+        # a module/class-scope lock would bind to whichever loop imported us).
         write_lock = asyncio.Lock()
         self._conns.add(writer)
         try:
@@ -221,6 +224,8 @@ class RpcClient:
         self._pending: dict[int, asyncio.Future] = {}
         self._next_id = 0
         self._recv_task: asyncio.Task | None = None
+        # Safe outside a running loop: since 3.10 asyncio.Lock binds lazily on
+        # first await, and each client is used from a single loop (DF021 audit).
         self._conn_lock = asyncio.Lock()
 
     async def _connect(self) -> None:
@@ -270,8 +275,12 @@ class RpcClient:
             if self._reader is reader:
                 if self._writer is not None:
                     self._writer.close()
-                self._reader = self._writer = None
-                self._recv_task = None
+                # _conn_lock guards only the connect handshake; these resets
+                # are a single scheduling slice on the loop thread (no await),
+                # so they cannot interleave with a _connect() holding the lock
+                # — and the `is reader` guard above pins the incarnation.
+                self._reader = self._writer = None  # dflint: disable=DF023 loop-thread reset, no await around it
+                self._recv_task = None  # dflint: disable=DF023 loop-thread reset, no await around it
 
     async def call(self, method: str, payload: Any = None, *, timeout: float | None = None) -> Any:
         last_err: Exception | None = None
@@ -310,10 +319,12 @@ class RpcClient:
     def _drop_connection(self) -> None:
         if self._recv_task is not None:
             self._recv_task.cancel()
-            self._recv_task = None
+            # sync method: runs to completion on the loop thread, atomic
+            # w.r.t. any coroutine holding _conn_lock
+            self._recv_task = None  # dflint: disable=DF023 sync method, atomic on the loop thread
         if self._writer is not None:
             self._writer.close()
-        self._reader = self._writer = None
+        self._reader = self._writer = None  # dflint: disable=DF023 sync method, atomic on the loop thread
 
     async def close(self) -> None:
         self._drop_connection()

@@ -24,11 +24,11 @@ import numpy as np
 from dragonfly2_tpu.scheduler.evaluator import Evaluator
 from dragonfly2_tpu.scheduler.resource import (
     PEER_BACK_TO_SOURCE,
-    PEER_RECEIVED,
     PEER_RUNNING,
     PEER_SUCCEEDED,
     Peer,
 )
+from dragonfly2_tpu.utils.dag import DAGError
 
 logger = logging.getLogger(__name__)
 
@@ -67,8 +67,8 @@ class Scheduling:
         lineage: set[str] = set()
         try:
             lineage = task.dag.lineage(child.id)
-        except Exception:
-            pass
+        except DAGError:
+            pass  # child not registered yet — empty lineage filters nothing
 
         def not_blocked(p: Peer) -> bool:
             return p.id not in blocklist and p.id not in child.block_parents
@@ -226,7 +226,7 @@ class Scheduling:
                         continue
                     try:
                         task.add_edge(p.id, child.id)
-                    except Exception:
+                    except DAGError:
                         continue  # raced into a cycle/duplicate; skip
                     committed.append(p)
                 if committed:

@@ -80,8 +80,8 @@ async def run_scheduler(
             logger.exception("manager link failed to start; continuing standalone")
             try:
                 await link.stop()
-            except Exception:
-                pass
+            except Exception as stop_err:
+                logger.debug("half-started link teardown failed: %s", stop_err)
             link = None
     announcer = None
     if trainer_addr and telemetry is not None:

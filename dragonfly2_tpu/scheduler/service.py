@@ -24,12 +24,8 @@ from dragonfly2_tpu.scheduler import metrics
 from dragonfly2_tpu.scheduler.evaluator import Evaluator, build_pair_features, new_evaluator
 from dragonfly2_tpu.scheduler.resource import (
     GCPolicy,
-    Host,
     HostType,
     PEER_BACK_TO_SOURCE,
-    PEER_FAILED,
-    PEER_LEAVE,
-    PEER_RUNNING,
     PEER_SUCCEEDED,
     Peer,
     ResourcePool,

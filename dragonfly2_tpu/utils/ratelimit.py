@@ -23,15 +23,22 @@ class TokenBucket:
         self._last = time.monotonic()
         self._lock = asyncio.Lock()
 
+    # Lock discipline (dflint DF023 suppressions below): _tokens is mutated
+    # both under the asyncio lock (acquire, to serialize WAITERS across its
+    # sleeps) and without it (the sync paths: try_acquire/set_rate run on the
+    # loop thread with no await inside, so they are atomic w.r.t. coroutine
+    # interleaving). acquire() re-checks the balance after every sleep, so
+    # tokens taken by a sync caller mid-wait extend the wait instead of racing.
+
     def _refill(self) -> None:
         now = time.monotonic()
-        self._tokens = min(self.burst, self._tokens + (now - self._last) * self.rate)
+        self._tokens = min(self.burst, self._tokens + (now - self._last) * self.rate)  # dflint: disable=DF023 sync path, no await between read and write
         self._last = now
 
     def try_acquire(self, n: float = 1.0) -> bool:
         self._refill()
         if self._tokens >= n:
-            self._tokens -= n
+            self._tokens -= n  # dflint: disable=DF023 sync path, no await between read and write
             return True
         return False
 
@@ -69,7 +76,7 @@ class TokenBucket:
         self.rate = float(rate)
         if burst is not None:
             self.burst = float(burst)
-            self._tokens = min(self._tokens, self.burst)
+            self._tokens = min(self._tokens, self.burst)  # dflint: disable=DF023 sync path, no await between read and write
 
     @property
     def available(self) -> float:

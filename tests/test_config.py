@@ -9,7 +9,7 @@ import pytest
 from dragonfly2_tpu.daemon.config import DaemonYaml
 from dragonfly2_tpu.manager.config import ManagerYaml
 from dragonfly2_tpu.scheduler.config import SchedulerYaml
-from dragonfly2_tpu.utils.config import ConfigError, cfgfield, load_config, validate
+from dragonfly2_tpu.utils.config import ConfigError, load_config, validate
 
 
 def test_defaults_without_file():

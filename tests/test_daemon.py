@@ -5,7 +5,6 @@ import hashlib
 import os
 import time
 
-import numpy as np
 import pytest
 from aiohttp import web
 

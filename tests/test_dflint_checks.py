@@ -1,0 +1,600 @@
+"""Unit tests for every dflint check: each ID fires on a known-bad fixture
+and stays silent on a known-good one, plus suppression/exit-code contracts."""
+# dflint: skip-file  (fixture strings deliberately contain bad code/ids)
+
+from __future__ import annotations
+
+import importlib.util
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+DFLINT = REPO / "tools" / "dflint.py"
+
+_spec = importlib.util.spec_from_file_location("dflint", DFLINT)
+dflint = importlib.util.module_from_spec(_spec)
+sys.modules["dflint"] = dflint  # dataclasses resolves types via sys.modules
+_spec.loader.exec_module(dflint)
+
+
+def ids(src: str, path: str = "dragonfly2_tpu/daemon/mod.py") -> list[str]:
+    return sorted({v.check for v in dflint.lint_source(textwrap.dedent(src), path)})
+
+
+def lines(src: str, path: str = "dragonfly2_tpu/daemon/mod.py") -> list[int]:
+    return [v.line for v in dflint.lint_source(textwrap.dedent(src), path)]
+
+
+# ---------------------------------------------------------------------------
+# DF011 tracer coercion
+
+
+def test_df011_fires_on_decorated_jit():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return float(x) * 2
+    """
+    assert ids(src) == ["DF011"]
+
+
+def test_df011_fires_on_jit_wrapped_lambda_and_named_def():
+    src = """
+    import jax
+
+    g = jax.jit(lambda x: int(x))
+
+    def h(x):
+        return bool(x)
+
+    h_jit = jax.jit(h)
+    """
+    vs = dflint.lint_source(textwrap.dedent(src), "m.py")
+    assert [v.check for v in vs] == ["DF011", "DF011"]
+
+
+def test_df011_fires_on_partial_jit():
+    src = """
+    from functools import partial
+    import jax
+
+    @partial(jax.jit, static_argnums=(1,))
+    def f(x, k):
+        return float(x)
+    """
+    assert ids(src) == ["DF011"]
+
+
+def test_df011_silent_outside_trace_and_on_constants():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return x * float("inf")
+
+    def g(x):
+        return float(x)
+    """
+    assert ids(src) == []
+
+
+# ---------------------------------------------------------------------------
+# DF012 jnp in Python loop
+
+
+_LOOP_SRC = """
+import jax.numpy as jnp
+
+def f(xs):
+    out = []
+    for x in xs:
+        out.append(jnp.sin(x))
+    return out
+"""
+
+
+def test_df012_fires_in_ops_models_parallel():
+    for d in ("ops", "models", "parallel"):
+        assert ids(_LOOP_SRC, f"dragonfly2_tpu/{d}/mod.py") == ["DF012"]
+
+
+def test_df012_silent_outside_scoped_dirs():
+    assert ids(_LOOP_SRC, "dragonfly2_tpu/daemon/mod.py") == []
+
+
+def test_df012_silent_without_loop_or_inside_nested_def():
+    src = """
+    import jax.numpy as jnp
+
+    def f(xs):
+        return jnp.sin(xs)
+
+    def g(xs):
+        fns = []
+        for i in range(3):
+            fns.append(lambda x: jnp.cos(x))
+        return fns
+    """
+    assert ids(src, "dragonfly2_tpu/ops/mod.py") == []
+
+
+# ---------------------------------------------------------------------------
+# DF013 unsynced timing window
+
+
+def test_df013_fires_on_unsynced_window():
+    src = """
+    import time
+    import jax.numpy as jnp
+
+    def bench(x):
+        t0 = time.perf_counter()
+        y = jnp.dot(x, x)
+        return time.perf_counter() - t0
+    """
+    assert ids(src) == ["DF013"]
+
+
+def test_df013_silent_with_block_until_ready():
+    src = """
+    import time
+    import jax.numpy as jnp
+
+    def bench(x):
+        t0 = time.perf_counter()
+        y = jnp.dot(x, x)
+        y.block_until_ready()
+        return time.perf_counter() - t0
+    """
+    assert ids(src) == []
+
+
+def test_df013_silent_with_d2h_materialization():
+    # float()/np.asarray() pull the value to host — a stronger sync than
+    # block_until_ready on tunneled backends (see bench.py)
+    src = """
+    import time
+    import numpy as np
+    import jax.numpy as jnp
+
+    def bench_a(x):
+        t0 = time.perf_counter()
+        y = float(jnp.dot(x, x).sum())
+        return time.perf_counter() - t0
+
+    def bench_b(x):
+        t0 = time.perf_counter()
+        y = np.asarray(jnp.dot(x, x))
+        return time.perf_counter() - t0
+    """
+    assert ids(src) == []
+
+
+def test_df013_silent_without_jax_in_window():
+    src = """
+    import time
+
+    def bench(fn):
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+    """
+    assert ids(src) == []
+
+
+# ---------------------------------------------------------------------------
+# DF014 non-hashable static args
+
+
+def test_df014_fires_on_list_literal_for_static_argnum():
+    src = """
+    import jax
+
+    def f(x, opts):
+        return x
+
+    g = jax.jit(f, static_argnums=(1,))
+
+    def main(x):
+        return g(x, [1, 2])
+    """
+    assert ids(src) == ["DF014"]
+
+
+def test_df014_fires_on_dict_literal_for_static_argname():
+    src = """
+    from functools import partial
+    import jax
+
+    @partial(jax.jit, static_argnames=("opts",))
+    def f(x, opts=None):
+        return x
+
+    def main(x):
+        return f(x, opts={"a": 1})
+    """
+    assert ids(src) == ["DF014"]
+
+
+def test_df014_silent_on_hashable_static_args():
+    src = """
+    import jax
+
+    def f(x, opts):
+        return x
+
+    g = jax.jit(f, static_argnums=(1,))
+
+    def main(x):
+        return g(x, (1, 2))
+    """
+    assert ids(src) == []
+
+
+# ---------------------------------------------------------------------------
+# DF021 asyncio primitive at import/class scope
+
+
+def test_df021_fires_at_module_and_class_scope():
+    src = """
+    import asyncio
+
+    LOCK = asyncio.Lock()
+
+    class A:
+        EV = asyncio.Event()
+    """
+    vs = dflint.lint_source(textwrap.dedent(src), "m.py")
+    assert [v.check for v in vs] == ["DF021", "DF021"]
+
+
+def test_df021_silent_inside_functions():
+    src = """
+    import asyncio
+
+    def make():
+        return asyncio.Queue()
+
+    async def run():
+        lock = asyncio.Lock()
+        async with lock:
+            pass
+    """
+    assert ids(src) == []
+
+
+# ---------------------------------------------------------------------------
+# DF022 time.sleep in async def
+
+
+def test_df022_fires_in_async_def():
+    src = """
+    import time
+
+    async def f():
+        time.sleep(1)
+    """
+    assert ids(src) == ["DF022"]
+
+
+def test_df022_catches_from_import_alias():
+    src = """
+    from time import sleep
+    from time import sleep as snooze
+
+    async def f():
+        sleep(1)
+        snooze(2)
+    """
+    vs = dflint.lint_source(textwrap.dedent(src), "m.py")
+    assert [v.check for v in vs] == ["DF022", "DF022"]
+
+
+def test_df021_catches_from_import_alias():
+    src = """
+    from asyncio import Lock, Queue
+
+    Q = Queue()
+
+    class A:
+        L = Lock()
+    """
+    vs = dflint.lint_source(textwrap.dedent(src), "m.py")
+    assert [v.check for v in vs] == ["DF021", "DF021"]
+
+
+def test_df022_silent_in_sync_def_and_asyncio_sleep():
+    src = """
+    import asyncio
+    import time
+
+    def f():
+        time.sleep(1)
+
+    async def g():
+        await asyncio.sleep(1)
+
+    async def h():
+        def inner():
+            time.sleep(1)
+        return inner
+    """
+    assert ids(src) == []
+
+
+# ---------------------------------------------------------------------------
+# DF023 inconsistent lock discipline
+
+
+def test_df023_fires_on_mixed_locked_unlocked_mutation():
+    src = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}
+
+        def put(self, k, v):
+            with self._lock:
+                self._items[k] = v
+
+        def drop(self, k):
+            self._items.pop(k, None)
+    """
+    vs = dflint.lint_source(textwrap.dedent(src), "m.py")
+    assert [v.check for v in vs] == ["DF023"]
+    assert vs[0].line == 14
+
+
+def test_df023_sees_tuple_unpack_targets():
+    # the guarded mutation is a tuple unpack; the unlocked one must still flag
+    src = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._a = None
+
+        def locked(self):
+            with self._lock:
+                self._a, other = 1, 2
+
+        def unlocked(self):
+            self._a = 3
+    """
+    vs = dflint.lint_source(textwrap.dedent(src), "m.py")
+    assert [v.check for v in vs] == ["DF023"]
+    assert vs[0].line == 14
+
+
+def test_df023_silent_when_discipline_is_consistent():
+    src = """
+    import threading
+
+    class C:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._items = {}
+            self._free = []
+
+        def put(self, k, v):
+            with self._lock:
+                self._items[k] = v
+
+        def drop(self, k):
+            with self._lock:
+                self._items.pop(k, None)
+
+        def note(self, x):
+            # never touched under the lock anywhere: the lock does not
+            # guard it, so no inconsistency exists
+            self._free.append(x)
+    """
+    assert ids(src) == []
+
+
+def test_df023_asyncio_lock_variant():
+    src = """
+    import asyncio
+
+    class C:
+        def __init__(self):
+            self._lock = asyncio.Lock()
+            self._items = {}
+
+        async def put(self, k, v):
+            async with self._lock:
+                self._items[k] = v
+
+        async def drop(self, k):
+            self._items.pop(k, None)
+    """
+    assert ids(src) == ["DF023"]
+
+
+# ---------------------------------------------------------------------------
+# DF031 silent swallow
+
+
+def test_df031_fires_on_silent_broad_handlers():
+    src = """
+    def f():
+        try:
+            work()
+        except Exception:
+            pass
+
+    def g(xs):
+        for x in xs:
+            try:
+                use(x)
+            except:
+                continue
+    """
+    vs = dflint.lint_source(textwrap.dedent(src), "m.py")
+    assert [v.check for v in vs] == ["DF031", "DF031"]
+
+
+def test_df031_silent_on_narrow_or_logged_handlers():
+    src = """
+    import logging
+
+    logger = logging.getLogger(__name__)
+
+    def f():
+        try:
+            work()
+        except ValueError:
+            pass
+
+    def g():
+        try:
+            work()
+        except Exception as e:
+            logger.debug("swallowed: %s", e)
+    """
+    assert ids(src) == []
+
+
+# ---------------------------------------------------------------------------
+# DF032 mutable defaults
+
+
+def test_df032_fires_on_mutable_defaults():
+    src = """
+    def f(x, items=[]):
+        return items
+
+    def g(x, *, m={}):
+        return m
+
+    def h(x, d=dict()):
+        return d
+    """
+    vs = dflint.lint_source(textwrap.dedent(src), "m.py")
+    assert [v.check for v in vs] == ["DF032", "DF032", "DF032"]
+
+
+def test_df032_silent_on_none_and_immutable_defaults():
+    src = """
+    def f(x, items=None, k=3, name="a", t=(1, 2)):
+        return items or []
+    """
+    assert ids(src) == []
+
+
+# ---------------------------------------------------------------------------
+# suppression handling
+
+
+def test_same_line_disable_is_honored():
+    src = """
+    def f(x, items=[]):  # dflint: disable=DF032
+        return items
+    """
+    assert ids(src) == []
+
+
+def test_disable_only_silences_listed_ids():
+    src = """
+    def f(x, items=[]):  # dflint: disable=DF031
+        return items
+    """
+    assert ids(src) == ["DF001", "DF032"] or ids(src) == ["DF032"]
+
+
+def test_multi_id_disable():
+    src = """
+    import time
+
+    async def f(x, items=[]):
+        time.sleep(1); g(items)  # noqa
+    """
+    # sanity: both fire without suppression
+    assert ids(src) == ["DF022", "DF032"]
+    src2 = """
+    import time
+
+    async def f(x, items=[]):  # dflint: disable=DF032
+        time.sleep(1)  # dflint: disable=DF022
+    """
+    assert ids(src2) == []
+
+
+def test_skip_file_is_honored():
+    src = """\
+    # dflint: skip-file
+    def f(x, items=[]):
+        return items
+    """
+    assert ids(src) == []
+
+
+def test_unknown_check_id_is_rejected():
+    src = """
+    def f(x, items=[]):  # dflint: disable=DF999
+        return items
+    """
+    got = ids(src)
+    assert "DF001" in got
+    # the bogus id must not silence the real finding either
+    assert "DF032" in got
+
+
+def test_syntax_error_is_reported_not_crashed():
+    assert ids("def f(:\n    pass\n") == ["DF002"]
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes: 0 clean / 1 violations / 2 crash-bad-usage
+
+
+def _run_cli(args: list[str]) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, str(DFLINT), *args],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+
+
+def test_cli_exit_0_on_clean_file(tmp_path):
+    f = tmp_path / "clean.py"
+    f.write_text("x = 1\n")
+    p = _run_cli([str(f)])
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "clean" in p.stdout
+
+
+def test_cli_exit_1_on_violations(tmp_path):
+    f = tmp_path / "bad.py"
+    f.write_text("def f(x, items=[]):\n    return items\n")
+    p = _run_cli([str(f)])
+    assert p.returncode == 1, p.stdout + p.stderr
+    assert "DF032" in p.stdout
+
+
+def test_cli_exit_2_on_missing_path():
+    p = _run_cli(["/no/such/path_xyz"])
+    assert p.returncode == 2
+
+
+def test_cli_exit_2_on_no_paths():
+    p = _run_cli([])
+    assert p.returncode == 2
+
+
+def test_cli_list_checks():
+    p = _run_cli(["--list-checks"])
+    assert p.returncode == 0
+    for check_id in ("DF011", "DF023", "DF032"):
+        assert check_id in p.stdout

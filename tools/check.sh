@@ -1,0 +1,73 @@
+#!/usr/bin/env bash
+# One-shot correctness gate: dflint → ruff → mypy → tier-1 pytest.
+# Stops at the first failing stage (after printing the summary table).
+# ruff/mypy are optional in this image and count as SKIP when absent.
+#
+#   bash tools/check.sh
+
+set -u
+cd "$(dirname "$0")/.."
+
+NAMES=()
+RESULTS=()
+SECS=()
+
+summarize() {
+    echo
+    echo "── check.sh summary ─────────────────────────"
+    printf '%-28s %-6s %8s\n' "stage" "result" "seconds"
+    for i in "${!NAMES[@]}"; do
+        printf '%-28s %-6s %8s\n' "${NAMES[$i]}" "${RESULTS[$i]}" "${SECS[$i]}"
+    done
+    echo "─────────────────────────────────────────────"
+}
+
+run_stage() {
+    local name="$1"; shift
+    local t0 t1 rc
+    echo
+    echo "━━ ${name}: $*"
+    t0=$(date +%s)
+    "$@"
+    rc=$?
+    t1=$(date +%s)
+    NAMES+=("$name")
+    SECS+=($((t1 - t0)))
+    if [ $rc -eq 0 ]; then
+        RESULTS+=("ok")
+    else
+        RESULTS+=("FAIL")
+        summarize
+        echo "check.sh: stage '${name}' failed (rc=$rc)" >&2
+        exit $rc
+    fi
+}
+
+skip_stage() {
+    NAMES+=("$1")
+    RESULTS+=("skip")
+    SECS+=("-")
+    echo
+    echo "━━ $1: skipped ($2)"
+}
+
+run_stage "dflint" python tools/dflint.py dragonfly2_tpu/ tools/ tests/ bench.py __graft_entry__.py
+
+if command -v ruff >/dev/null 2>&1; then
+    run_stage "ruff" ruff check dragonfly2_tpu tools bench.py
+else
+    skip_stage "ruff" "not installed"
+fi
+
+if command -v mypy >/dev/null 2>&1; then
+    run_stage "mypy" mypy dragonfly2_tpu/rpc dragonfly2_tpu/utils dragonfly2_tpu/telemetry
+else
+    skip_stage "mypy" "not installed"
+fi
+
+run_stage "pytest-tier1" env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+summarize
+echo "check.sh: all stages passed"

@@ -1,0 +1,795 @@
+#!/usr/bin/env python
+"""dflint — repo-native static analysis for dragonfly2_tpu.
+
+The reference Dragonfly2 leans on `go vet` and the race detector; this is the
+Python port's equivalent: AST-level checks for the JAX and concurrency bug
+classes that generic linters miss. Run as a tier-1 test (tests/test_lint.py)
+so the tree stays clean, or standalone:
+
+    python tools/dflint.py dragonfly2_tpu/ tools/ bench.py
+    python tools/dflint.py --list-checks
+
+Checks (see README.md "Static analysis" for the catalog):
+
+  DF011  float()/int()/bool() coercion inside a jit/pmap-traced function
+         (concretizes a tracer: TracerConversionError at best, silent
+         recompile-per-value at worst)
+  DF012  jnp.*/jax.numpy.* call inside a Python for/while loop in modules
+         under ops/, models/, parallel/ (unrolled-graph blowup)
+  DF013  time.perf_counter timing window around jax/jnp work with no
+         synchronization (block_until_ready or a D2H materialization) —
+         measures async dispatch, not compute
+  DF014  non-hashable literal (list/dict/set) passed for a static_argnums/
+         static_argnames parameter of a jitted callable (TypeError at trace)
+  DF021  asyncio primitive (Lock/Event/Condition/Semaphore/Queue...) created
+         at import or class-body scope (binds to / is shared across the
+         wrong event loop)
+  DF022  time.sleep() inside `async def` (blocks the event loop; use
+         asyncio.sleep)
+  DF023  inconsistent lock discipline: a `self._*` attribute mutated under
+         `with <lock>:` in one place and without it in another (the classic
+         data race the Go race detector catches)
+  DF031  silent exception swallow: bare/overbroad except whose body is only
+         pass/continue/... (no log, no narrowing)
+  DF032  mutable default argument (list/dict/set literal or constructor)
+
+Suppression:
+  - same line:   <code>  # dflint: disable=DF023 <reason>   (comma-separate ids;
+                 prose after the id list is the required human reason)
+  - whole file:  # dflint: skip-file     (on its own line, first 5 lines)
+  Unknown DFnnn-shaped ids in a disable comment are themselves reported (DF001).
+
+Exit codes: 0 clean, 1 violations found, 2 internal error / bad usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import re
+import sys
+import traceback
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, Iterator
+
+CHECKS: dict[str, str] = {
+    "DF001": "unknown check id in a dflint suppression comment",
+    "DF002": "file does not parse (syntax error)",
+    "DF011": "tracer coercion: float()/int()/bool() inside a traced function",
+    "DF012": "jnp call inside a Python loop (unrolled graph) in ops/models/parallel",
+    "DF013": "timed JAX region without synchronization (async dispatch mistimed)",
+    "DF014": "non-hashable literal passed for a static jit argument",
+    "DF021": "asyncio primitive created at import/class-body scope",
+    "DF022": "time.sleep inside async def (blocks the event loop)",
+    "DF023": "lock-guarded attribute also mutated outside the lock",
+    "DF031": "bare/overbroad except silently swallowing the error",
+    "DF032": "mutable default argument",
+}
+
+# Packages where Python-loop-over-jnp is an unrolled-graph hazard (DF012).
+JNP_LOOP_DIRS = {"ops", "models", "parallel"}
+
+# asyncio primitives that bind to (or are shared across) an event loop.
+ASYNC_PRIMITIVES = {
+    "Lock", "Event", "Condition", "Semaphore", "BoundedSemaphore",
+    "Queue", "LifoQueue", "PriorityQueue", "Barrier",
+}
+
+# Container methods that mutate in place (DF023).
+MUTATOR_METHODS = {
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popitem", "remove", "discard", "clear", "appendleft", "extendleft",
+    "popleft", "rotate",
+}
+
+# Calls that force completion of queued device work (DF013). A D2H
+# materialization (np.asarray / .item() / jax.device_get) is accepted as a
+# sync — on tunneled backends it is *stronger* than block_until_ready (see
+# bench.py _gnn_train_measured).
+SYNC_ATTRS = {"block_until_ready", "item"}
+SYNC_DOTTED = {
+    "jax.block_until_ready", "jax.device_get", "np.asarray", "numpy.asarray",
+    "np.array", "numpy.array", "jax.effects_barrier",
+}
+SYNC_NAMES = {"_sync"}
+
+# ids are DFnnn-shaped; trailing prose after the id list is the human reason
+# and is ignored ("# dflint: disable=DF023 single-threaded asyncio").
+_DISABLE_RE = re.compile(r"#\s*dflint:\s*disable=([A-Z]{2}\d{3}(?:\s*,\s*[A-Z]{2}\d{3})*)")
+_SKIP_FILE_RE = re.compile(r"^\s*#\s*dflint:\s*skip-file\b")
+
+
+@dataclass(frozen=True)
+class Violation:
+    path: str
+    line: int
+    col: int
+    check: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.check} {self.message}"
+
+
+def walk_pruned(node: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk that does NOT descend into nested function/lambda bodies —
+    code in a nested def runs later (or never), not in the enclosing scope."""
+    yield node
+    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        return
+    for child in ast.iter_child_nodes(node):
+        yield from walk_pruned(child)
+
+
+def dotted(node: ast.AST) -> str:
+    """'jax.numpy.dot' for Attribute/Name chains, '' for anything dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _call_name(node: ast.Call) -> str:
+    return dotted(node.func)
+
+
+def import_aliases(tree: ast.Module) -> dict[str, str]:
+    """Local name -> canonical dotted path for from-imports and import-as
+    (`from time import sleep` -> {'sleep': 'time.sleep'}), so checks keyed on
+    dotted names don't go blind to a from-import refactor."""
+    out: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and node.level == 0:
+            for a in node.names:
+                if a.name != "*":
+                    out[a.asname or a.name] = f"{node.module}.{a.name}"
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname:
+                    out[a.asname] = a.name
+    return out
+
+
+def _resolved_call_name(node: ast.Call, aliases: dict[str, str]) -> str:
+    """_call_name with the leading segment mapped through import aliases."""
+    name = _call_name(node)
+    if not name:
+        return name
+    head, sep, rest = name.partition(".")
+    if head in aliases:
+        return aliases[head] + (sep + rest if rest else "")
+    return name
+
+
+def _is_jit_like(name: str) -> bool:
+    return name in {
+        "jax.jit", "jit", "jax.pmap", "pmap", "jax.experimental.pjit.pjit", "pjit",
+    }
+
+
+def _jit_decorator(dec: ast.expr) -> bool:
+    """True for @jax.jit / @jit / @partial(jax.jit, ...) / @jax.jit(...)."""
+    if _is_jit_like(dotted(dec)):
+        return True
+    if isinstance(dec, ast.Call):
+        name = _call_name(dec)
+        if _is_jit_like(name):
+            return True
+        if name in {"partial", "functools.partial"} and dec.args:
+            return _is_jit_like(dotted(dec.args[0]))
+    return False
+
+
+def _is_jaxish_call(node: ast.Call) -> bool:
+    name = _call_name(node)
+    root = name.split(".", 1)[0]
+    return root in {"jnp", "jax"} or name.startswith("jax.numpy.")
+
+
+def _is_sync_call(node: ast.Call) -> bool:
+    name = _call_name(node)
+    if name in SYNC_DOTTED or name in SYNC_NAMES:
+        return True
+    # float(x)/int(x)/bool(x) on a device array materializes it (D2H sync)
+    if name in ("float", "int", "bool") and len(node.args) == 1:
+        return not isinstance(node.args[0], ast.Constant)
+    return isinstance(node.func, ast.Attribute) and node.func.attr in SYNC_ATTRS
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    """'x' for an Attribute `self.x`, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def _non_hashable_literal(node: ast.expr) -> bool:
+    return isinstance(
+        node, (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+    )
+
+
+# ---------------------------------------------------------------------------
+# suppression comments
+
+
+class Suppressions:
+    def __init__(self, source: str):
+        self.skip_file = False
+        self.by_line: dict[int, set[str]] = {}
+        self.unknown: list[tuple[int, str]] = []
+        for lineno, line in enumerate(source.splitlines(), start=1):
+            if lineno <= 5 and _SKIP_FILE_RE.match(line):
+                self.skip_file = True
+            m = _DISABLE_RE.search(line)
+            if not m:
+                continue
+            ids = {p.strip() for p in m.group(1).split(",") if p.strip()}
+            for check_id in ids:
+                if check_id not in CHECKS:
+                    self.unknown.append((lineno, check_id))
+            self.by_line.setdefault(lineno, set()).update(ids)
+
+    def allows(self, v: Violation) -> bool:
+        return v.check in self.by_line.get(v.line, ())
+
+
+# ---------------------------------------------------------------------------
+# individual checks
+
+
+def check_tracer_coercion(tree: ast.Module, path: str) -> Iterator[Violation]:
+    """DF011: float()/int()/bool() on non-literals inside traced functions."""
+    traced: set[ast.AST] = set()
+
+    # decorated defs, and defs/lambdas passed directly to jax.jit(...)
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_jit_decorator(d) for d in node.decorator_list):
+                traced.add(node)
+        elif isinstance(node, ast.Call) and _is_jit_like(_call_name(node)):
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Lambda):
+                    traced.add(arg)
+    # jitted-by-name: g = jax.jit(f) where f is a local def
+    defs_by_name = {
+        n.name: n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_like(_call_name(node)) and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Name) and target.id in defs_by_name:
+                traced.add(defs_by_name[target.id])
+
+    for fn in traced:
+        body = fn.body if not isinstance(fn, ast.Lambda) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                if isinstance(node.func, ast.Name) and node.func.id in (
+                    "float", "int", "bool"
+                ):
+                    if len(node.args) == 1 and not isinstance(
+                        node.args[0], ast.Constant
+                    ):
+                        yield Violation(
+                            path, node.lineno, node.col_offset, "DF011",
+                            f"{node.func.id}() on a value inside a traced "
+                            "function concretizes the tracer; compute with "
+                            "jnp or move the coercion outside the jit",
+                        )
+
+
+def check_jnp_in_loop(tree: ast.Module, path: str) -> Iterator[Violation]:
+    """DF012: jnp calls under for/while in ops/, models/, parallel/."""
+    if not JNP_LOOP_DIRS.intersection(Path(path).parts):
+        return
+    loops = [
+        n for n in ast.walk(tree) if isinstance(n, (ast.For, ast.While, ast.AsyncFor))
+    ]
+    seen: set[tuple[int, int]] = set()  # nested loops walk shared bodies
+    for loop in loops:
+        for stmt in loop.body + loop.orelse:
+            for node in walk_pruned(stmt):
+                if isinstance(node, ast.Call) and _is_jaxish_call(node):
+                    name = _call_name(node)
+                    if _is_jit_like(name):
+                        continue  # wrapping, not tracing work
+                    key = (node.lineno, node.col_offset)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield Violation(
+                        path, node.lineno, node.col_offset, "DF012",
+                        f"{name}() inside a Python loop unrolls into the "
+                        "traced graph; hoist it, vectorize, or use lax.scan/"
+                        "fori_loop",
+                    )
+
+
+class _Window:
+    __slots__ = ("start", "end", "var")
+
+    def __init__(self, start: int, end: int, var: str):
+        self.start, self.end, self.var = start, end, var
+
+
+def _perf_counter_windows(fn_body: list[ast.stmt]) -> list[_Window]:
+    """(assign-line, elapsed-use-line) pairs for `t = time.perf_counter()`
+    ... `time.perf_counter() - t` within one function body."""
+    assigns: dict[str, list[int]] = {}
+    uses: list[tuple[int, str]] = []
+    for stmt in fn_body:
+        for node in walk_pruned(stmt):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _call_name(node.value) == "time.perf_counter"
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+            ):
+                assigns.setdefault(node.targets[0].id, []).append(node.lineno)
+            if (
+                isinstance(node, ast.BinOp)
+                and isinstance(node.op, ast.Sub)
+                and isinstance(node.right, ast.Name)
+                and isinstance(node.left, ast.Call)
+                and _call_name(node.left) == "time.perf_counter"
+            ):
+                uses.append((node.lineno, node.right.id))
+    windows = []
+    for use_line, var in uses:
+        starts = [a for a in assigns.get(var, ()) if a < use_line]
+        if starts:
+            windows.append(_Window(max(starts), use_line, var))
+    return windows
+
+
+def check_unsynced_timing(tree: ast.Module, path: str) -> Iterator[Violation]:
+    """DF013: perf_counter window around jax/jnp calls with no sync."""
+    scopes: list[list[ast.stmt]] = [tree.body]
+    scopes.extend(
+        n.body
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    )
+    for body in scopes:
+        windows = _perf_counter_windows(body)
+        if not windows:
+            continue
+        calls: list[tuple[int, ast.Call]] = []
+        for stmt in body:
+            for node in walk_pruned(stmt):
+                if isinstance(node, ast.Call):
+                    calls.append((node.lineno, node))
+        for w in windows:
+            in_window = [c for line, c in calls if w.start < line <= w.end]
+            jaxish = [c for c in in_window if _is_jaxish_call(c)]
+            if jaxish and not any(_is_sync_call(c) for c in in_window):
+                yield Violation(
+                    path, w.end, 0, "DF013",
+                    f"timing window ({w.var}, lines {w.start}-{w.end}) around "
+                    f"{_call_name(jaxish[0])}() has no block_until_ready/D2H "
+                    "sync — it measures dispatch, not compute",
+                )
+
+
+def _static_spec(call: ast.Call) -> tuple[list[int], list[str]]:
+    """static_argnums/static_argnames from a jax.jit(...) call."""
+    nums: list[int] = []
+    names: list[str] = []
+    for kw in call.keywords:
+        vals: list[ast.expr]
+        if isinstance(kw.value, (ast.Tuple, ast.List)):
+            vals = list(kw.value.elts)
+        else:
+            vals = [kw.value]
+        if kw.arg == "static_argnums":
+            nums = [
+                v.value
+                for v in vals
+                if isinstance(v, ast.Constant) and isinstance(v.value, int)
+            ]
+        elif kw.arg == "static_argnames":
+            names = [
+                v.value
+                for v in vals
+                if isinstance(v, ast.Constant) and isinstance(v.value, str)
+            ]
+    return nums, names
+
+
+def check_static_arg_literals(tree: ast.Module, path: str) -> Iterator[Violation]:
+    """DF014: list/dict/set literals passed for static jit args."""
+    jitted: dict[str, tuple[list[int], list[str]]] = {}
+
+    for node in ast.walk(tree):
+        # g = jax.jit(f, static_argnums=...)
+        if (
+            isinstance(node, ast.Assign)
+            and isinstance(node.value, ast.Call)
+            and _is_jit_like(_call_name(node.value))
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            nums, names = _static_spec(node.value)
+            if nums or names:
+                jitted[node.targets[0].id] = (nums, names)
+        # @partial(jax.jit, static_argnums=...) / @jax.jit(...) decorated def
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and _jit_decorator(dec):
+                    nums, names = _static_spec(dec)
+                    if nums or names:
+                        jitted[node.name] = (nums, names)
+
+    def flag_call(call: ast.Call, nums: list[int], names: list[str]):
+        for i in nums:
+            if i < len(call.args) and _non_hashable_literal(call.args[i]):
+                yield Violation(
+                    path, call.args[i].lineno, call.args[i].col_offset, "DF014",
+                    f"static arg {i} gets a non-hashable literal — jit static "
+                    "args must be hashable (use a tuple/frozenset)",
+                )
+        for kw in call.keywords:
+            if kw.arg in names and _non_hashable_literal(kw.value):
+                yield Violation(
+                    path, kw.value.lineno, kw.value.col_offset, "DF014",
+                    f"static arg {kw.arg!r} gets a non-hashable literal — jit "
+                    "static args must be hashable (use a tuple/frozenset)",
+                )
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        # g(...) where g is a known jitted name
+        if isinstance(node.func, ast.Name) and node.func.id in jitted:
+            nums, names = jitted[node.func.id]
+            yield from flag_call(node, nums, names)
+        # jax.jit(f, static_argnums=...)(x, [..]) immediate call
+        elif isinstance(node.func, ast.Call) and _is_jit_like(_call_name(node.func)):
+            nums, names = _static_spec(node.func)
+            if nums or names:
+                yield from flag_call(node, nums, names)
+
+
+def check_asyncio_primitive_scope(tree: ast.Module, path: str) -> Iterator[Violation]:
+    """DF021: asyncio.Lock()/Queue()/... at import or class-body scope."""
+    aliases = import_aliases(tree)
+
+    def scan(stmts: Iterable[ast.stmt], where: str) -> Iterator[Violation]:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, ast.ClassDef):
+                yield from scan(stmt.body, f"class {stmt.name} body")
+                continue
+            for node in walk_pruned(stmt):
+                if isinstance(node, ast.Call):
+                    name = _resolved_call_name(node, aliases)
+                    if (
+                        name.startswith("asyncio.")
+                        and name.split(".")[-1] in ASYNC_PRIMITIVES
+                    ):
+                        yield Violation(
+                            path, node.lineno, node.col_offset, "DF021",
+                            f"{name}() at {where} binds to whichever loop "
+                            "exists at import time; create it inside the "
+                            "owning coroutine or start() path",
+                        )
+
+    yield from scan(tree.body, "module scope")
+
+
+def check_sleep_in_async(tree: ast.Module, path: str) -> Iterator[Violation]:
+    """DF022: time.sleep inside async def."""
+    aliases = import_aliases(tree)
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        for stmt in fn.body:
+            for node in walk_pruned(stmt):
+                if (
+                    isinstance(node, ast.Call)
+                    and _resolved_call_name(node, aliases) == "time.sleep"
+                ):
+                    yield Violation(
+                        path, node.lineno, node.col_offset, "DF022",
+                        "time.sleep() blocks the event loop inside "
+                        f"async {fn.name}(); use await asyncio.sleep()",
+                    )
+
+
+_LOCK_CTORS = {
+    "threading.Lock": "threading", "threading.RLock": "threading",
+    "asyncio.Lock": "asyncio", "Lock": "threading", "RLock": "threading",
+}
+
+
+def check_lock_discipline(tree: ast.Module, path: str) -> Iterator[Violation]:
+    """DF023: attribute mutated both under a lock and outside one.
+
+    The Go-race-detector shape: state that is *sometimes* accessed under the
+    class's lock and sometimes not. Attributes never touched under the lock
+    are not flagged (the lock evidently guards something else)."""
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        lock_attrs: set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                if _call_name(node.value) in _LOCK_CTORS:
+                    for t in node.targets:
+                        attr = _self_attr(t)
+                        if attr:
+                            lock_attrs.add(attr)
+        if not lock_attrs:
+            continue
+
+        # (attr, guarded, node, in_init) mutation records per method
+        mutations: list[tuple[str, bool, ast.AST, bool]] = []
+
+        def visit(node: ast.AST, guard_depth: int, in_init: bool) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                locked = any(
+                    _self_attr(item.context_expr) in lock_attrs
+                    or (
+                        isinstance(item.context_expr, ast.Call)
+                        and _self_attr(item.context_expr.func) in lock_attrs
+                    )
+                    for item in node.items
+                )
+                depth = guard_depth + (1 if locked else 0)
+                for child in ast.iter_child_nodes(node):
+                    visit(child, depth, in_init)
+                return
+            attr = None
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (
+                    node.targets if isinstance(node, ast.Assign) else [node.target]
+                )
+                flat: list[ast.expr] = []
+                for t in targets:  # a, b = ... unpacking counts per element
+                    if isinstance(t, (ast.Tuple, ast.List)):
+                        flat.extend(t.elts)
+                    else:
+                        flat.append(t)
+                for t in flat:
+                    if isinstance(t, ast.Starred):
+                        t = t.value
+                    if isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value)
+                    else:
+                        attr = _self_attr(t)
+                    if attr:
+                        mutations.append((attr, guard_depth > 0, node, in_init))
+                attr = None
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        attr = _self_attr(t.value)
+                        if attr:
+                            mutations.append((attr, guard_depth > 0, node, in_init))
+                attr = None
+            elif isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+                if node.func.attr in MUTATOR_METHODS:
+                    attr = _self_attr(node.func.value)
+                    if attr:
+                        mutations.append((attr, guard_depth > 0, node, in_init))
+            for child in ast.iter_child_nodes(node):
+                visit(child, guard_depth, in_init)
+
+        for method in cls.body:
+            if isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                init = method.name in ("__init__", "__new__")
+                for stmt in method.body:
+                    visit(stmt, 0, init)
+
+        guarded_attrs = {
+            attr for attr, guarded, _, _ in mutations if guarded
+        } - lock_attrs
+        for attr, guarded, node, in_init in mutations:
+            if attr in guarded_attrs and not guarded and not in_init:
+                yield Violation(
+                    path, node.lineno, node.col_offset, "DF023",
+                    f"self.{attr} is mutated under a lock elsewhere in "
+                    f"{cls.name} but not here — hold the lock or document "
+                    "why this site is safe",
+                )
+
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, (ast.Name, ast.Attribute)):
+        return dotted(t).split(".")[-1] in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(
+            isinstance(e, (ast.Name, ast.Attribute))
+            and dotted(e).split(".")[-1] in _BROAD
+            for e in t.elts
+        )
+    return False
+
+
+def check_silent_swallow(tree: ast.Module, path: str) -> Iterator[Violation]:
+    """DF031: broad except whose body is only pass/continue/ellipsis."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad_handler(node):
+            continue
+        silent = all(
+            isinstance(s, (ast.Pass, ast.Continue))
+            or (
+                isinstance(s, ast.Expr)
+                and isinstance(s.value, ast.Constant)
+                and s.value.value is Ellipsis
+            )
+            for s in node.body
+        )
+        if silent:
+            kind = "bare except" if node.type is None else f"except {dotted(node.type) or 'Exception'}"
+            yield Violation(
+                path, node.lineno, node.col_offset, "DF031",
+                f"{kind} silently swallows the error — narrow the type, log "
+                "at debug level, or suppress with a reason",
+            )
+
+
+_MUTABLE_CTORS = {
+    "list", "dict", "set", "bytearray", "collections.defaultdict",
+    "defaultdict", "collections.deque", "deque", "collections.OrderedDict",
+    "OrderedDict", "collections.Counter", "Counter",
+}
+
+
+def check_mutable_defaults(tree: ast.Module, path: str) -> Iterator[Violation]:
+    """DF032: mutable default arguments."""
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        defaults = list(fn.args.defaults) + [
+            d for d in fn.args.kw_defaults if d is not None
+        ]
+        for d in defaults:
+            bad = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                isinstance(d, ast.Call) and _call_name(d) in _MUTABLE_CTORS
+            )
+            if bad:
+                name = getattr(fn, "name", "<lambda>")
+                yield Violation(
+                    path, d.lineno, d.col_offset, "DF032",
+                    f"mutable default in {name}() is shared across calls; "
+                    "default to None and construct inside",
+                )
+
+
+ALL_CHECKS = (
+    check_tracer_coercion,
+    check_jnp_in_loop,
+    check_unsynced_timing,
+    check_static_arg_literals,
+    check_asyncio_primitive_scope,
+    check_sleep_in_async,
+    check_lock_discipline,
+    check_silent_swallow,
+    check_mutable_defaults,
+)
+
+
+# ---------------------------------------------------------------------------
+# driver
+
+
+def lint_source(source: str, path: str = "<string>") -> list[Violation]:
+    """All violations for one file's source, suppressions applied."""
+    sup = Suppressions(source)
+    if sup.skip_file:  # full opt-out, including DF001 (fixture/vendored files)
+        return []
+    out: list[Violation] = [
+        Violation(path, line, 0, "DF001", f"unknown check id {check_id!r} in suppression")
+        for line, check_id in sup.unknown
+    ]
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        out.append(
+            Violation(path, e.lineno or 1, e.offset or 0, "DF002", f"syntax error: {e.msg}")
+        )
+        return out
+    for check in ALL_CHECKS:
+        for v in check(tree, path):
+            if not sup.allows(v):
+                out.append(v)
+    out.sort(key=lambda v: (v.line, v.col, v.check))
+    return out
+
+
+def discover(paths: list[str]) -> list[Path]:
+    files: list[Path] = []
+    for p in paths:
+        pth = Path(p)
+        if pth.is_dir():
+            files.extend(
+                f
+                for f in sorted(pth.rglob("*.py"))
+                if not any(part.startswith(".") for part in f.parts)
+            )
+        elif pth.is_file():
+            files.append(pth)
+        else:
+            raise FileNotFoundError(f"no such file or directory: {p}")
+    return files
+
+
+def run_paths(paths: list[str]) -> list[Violation]:
+    out: list[Violation] = []
+    for f in discover(paths):
+        out.extend(lint_source(f.read_text(encoding="utf-8"), str(f)))
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="dflint", description="repo-native JAX + concurrency lints"
+    )
+    ap.add_argument("paths", nargs="*", help="files or directories to lint")
+    ap.add_argument(
+        "--list-checks", action="store_true", help="print the check catalog and exit"
+    )
+    ap.add_argument(
+        "--quiet", action="store_true", help="suppress the per-violation lines"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_checks:
+        for check_id in sorted(CHECKS):
+            print(f"{check_id}  {CHECKS[check_id]}")
+        return 0
+    if not args.paths:
+        ap.print_usage(sys.stderr)
+        print("dflint: error: no paths given", file=sys.stderr)
+        return 2
+
+    try:
+        files = discover(args.paths)
+    except FileNotFoundError as e:
+        print(f"dflint: error: {e}", file=sys.stderr)
+        return 2
+    violations: list[Violation] = []
+    for f in files:
+        violations.extend(lint_source(f.read_text(encoding="utf-8"), str(f)))
+
+    if not args.quiet:
+        for v in violations:
+            print(v.render())
+    status = "clean" if not violations else f"{len(violations)} violation(s)"
+    print(f"dflint: {len(files)} file(s), {status}")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except SystemExit:
+        raise
+    except BaseException:
+        traceback.print_exc()
+        sys.exit(2)

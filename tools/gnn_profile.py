@@ -33,7 +33,11 @@ def _sync(out) -> None:
     block_until_ready on the tunneled backend can return before queued work
     actually executes (see bench.py _gnn_train_measured). Works for any
     output pytree (grad dicts, TrainState, tuples); slices on DEVICE first so
-    only a single element crosses the tunnel, not a whole activation."""
+    only a single element crosses the tunnel, not a whole activation.
+
+    dflint DF013 recognizes this helper (and any np.asarray/float() pull) as
+    a valid sync inside a perf_counter window — do not drop the _sync() calls
+    from timed regions or the numbers time dispatch, not compute."""
     import jax
 
     leaf = jax.tree.leaves(out)[0]

@@ -9,6 +9,10 @@ stage so a hang leaves a trace on stderr/stdout identifying the stage:
   stage 3: tiny matmul          (first compile + execute)
   stage 4: 1k-embed GNN-shaped matmul (realistic compile)
 
+Stage timings use time.time() with block_until_ready on every device op, so
+each stage measures compute+compile, not async dispatch (the dflint DF013
+rule for perf_counter windows; audited 2026-08).
+
 Also dumps TPU_*/JAX_*/AXON_*/PALLAS_* env and libtpu/axon .so presence, as
 the judge asked. Run standalone:  python tools/tpu_probe.py [--json out.json]
 """
