@@ -30,10 +30,14 @@ from typing import Protocol
 
 import aiohttp
 
-from dragonfly2_tpu.daemon.source import SourceRegistry
+from dragonfly2_tpu.daemon.source import SourceError, SourceRegistry
 from dragonfly2_tpu.daemon.storage import StorageManager, TaskStorage
+from dragonfly2_tpu.resilience import deadline as dl
+from dragonfly2_tpu.resilience import faultline
+from dragonfly2_tpu.resilience.backoff import BackoffPolicy
 from dragonfly2_tpu.scheduler.service import HostInfo, ParentInfo, RegisterResult, TaskMeta
 from dragonfly2_tpu.utils import digest as digestlib
+from dragonfly2_tpu.utils.aio import gather_all_cancel_on_error
 from dragonfly2_tpu.utils.bitset import Bitset
 from dragonfly2_tpu.utils.pieces import Range, compute_piece_size, piece_count, piece_range
 from dragonfly2_tpu.utils.ratelimit import TokenBucket
@@ -140,6 +144,16 @@ class ConductorConfig:
     no_progress_reschedule: float = 5.0
     reschedule_limit: int = 5
     watchdog_timeout: float = 600.0
+    # Retry pacing for piece-level recovery (shared BackoffPolicy shape).
+    retry_backoff_base: float = 0.1
+    retry_backoff_max: float = 2.0
+    # A piece whose worker raised past _download_one_piece is re-enqueued at
+    # most this many times before it is reported failed to the scheduler and
+    # left to the dispatch loop (and ultimately cutover) to recover.
+    piece_requeue_limit: int = 2
+    # Ranged back-to-source: per-piece fetch retries before the whole task
+    # fails (origin blips must not kill a 95%-done download).
+    source_piece_retries: int = 3
 
 
 class PeerTaskConductor:
@@ -203,14 +217,27 @@ class PeerTaskConductor:
         self._t0 = 0.0
         self._sync_tasks: dict[str, asyncio.Task] = {}  # parent_id -> long-poll loop
         self._update_event = asyncio.Event()  # any parent state/metadata change
+        self._backoff = BackoffPolicy(
+            base=self.cfg.retry_backoff_base,
+            multiplier=2.0,
+            max_delay=self.cfg.retry_backoff_max,
+            jitter=0.5,
+        )
+        self._piece_errors: dict[int, int] = {}  # index -> worker-level failures
 
     # ---- entry ----
 
     async def run(self) -> TaskStorage:
-        """Download the task fully; returns its storage. Raises on failure."""
+        """Download the task fully; returns its storage. Raises on failure.
+
+        The watchdog is a deadline scope, not just a wait_for: nested rpc
+        calls and piece fetches see min(remaining, per-op) timeouts through
+        the propagated budget, and an engine-level scope (user --timeout)
+        narrows it further."""
         self._t0 = time.monotonic()
         try:
-            result = await asyncio.wait_for(self._run_inner(), self.cfg.watchdog_timeout)
+            with dl.scope(self.cfg.watchdog_timeout) as budget:
+                result = await asyncio.wait_for(self._run_inner(), budget.remaining())
             return result
         except BaseException:
             await self._safe_report_peer(success=False)
@@ -343,30 +370,66 @@ class PeerTaskConductor:
         """Pull missing pieces via CONCURRENT Range requests (the reference's
         multi-connection source download, piece_manager.go:67 ConcurrentOption):
         pieces write at disjoint offsets, so N in-flight ranges parallelize
-        the origin link the way p2p piece workers parallelize parents. First
-        failure cancels the rest (TaskGroup) and fails the task."""
+        the origin link the way p2p piece workers parallelize parents. Each
+        piece retries independently (shared backoff policy); a piece that
+        exhausts its retries fails the task, cancelling its siblings."""
         m = self.ts.meta
         sem = asyncio.Semaphore(max(1, self.cfg.source_concurrency))
 
-        async def fetch(idx: int) -> None:
-            async with sem:
-                r = piece_range(idx, m.piece_size, m.content_length)
-                t0 = time.monotonic()
-                buf = bytearray()
-                async for chunk in self.sources.download(self.meta.url, r, self.headers):
-                    buf.extend(chunk)
-                    await self.bucket.acquire(len(chunk))
-                if len(buf) != r.length:
-                    raise IOError(f"source piece {idx}: got {len(buf)}, want {r.length}")
-                await self.ts.write_piece(idx, bytes(buf))
-                self.bytes_from_source += len(buf)
+        async def fetch_once(idx: int) -> None:
+            from dragonfly2_tpu.daemon import metrics
+
+            if self.ts.has_piece(idx):
+                return  # idempotent under retry: the piece already landed
+            r = piece_range(idx, m.piece_size, m.content_length)
+            t0 = time.monotonic()
+            buf = bytearray()
+            async for chunk in self.sources.download(self.meta.url, r, self.headers):
+                buf.extend(chunk)
+                await self.bucket.acquire(len(chunk))
+            if len(buf) != r.length:
+                raise IOError(f"source piece {idx}: got {len(buf)}, want {r.length}")
+            await self.ts.write_piece(idx, bytes(buf))
+            self.bytes_from_source += len(buf)
+            # same accounting as the sequential path (_write_source_piece):
+            # cutover dashboards need parent vs back_to_source piece counts
+            # to sum to the task's total
+            metrics.PIECE_DOWNLOAD_TOTAL.inc(source="back_to_source")
+            metrics.DOWNLOAD_BYTES.inc(len(buf))
+            try:
                 await self.scheduler.report_piece_result(
                     self.peer_id, idx, success=True, cost_ms=(time.monotonic() - t0) * 1000
                 )
+            except Exception as e:  # noqa: BLE001 — the piece IS on disk; a
+                # failed advisory report must neither re-download it (double
+                # counting) nor fail a nearly-done task
+                self.log.debug("piece %d source report failed: %r", idx, e)
 
-        async with asyncio.TaskGroup() as tg:
-            for idx in self.ts.finished.missing_until(m.total_pieces):
-                tg.create_task(fetch(idx))
+        async def fetch(idx: int) -> None:
+            # Pieces retry independently with exponential backoff: an origin
+            # blip (reset, truncated body, 5xx) must cost one piece a retry,
+            # not the whole TaskGroup a cancellation cascade.
+            async with sem:
+                last: Exception | None = None
+                for attempt in range(self.cfg.source_piece_retries + 1):
+                    try:
+                        await fetch_once(idx)
+                        return
+                    except (SourceError, IOError, aiohttp.ClientError, asyncio.TimeoutError) as e:
+                        last = e
+                        remaining = dl.remaining()
+                        if remaining is not None and remaining <= 0:
+                            break  # budget gone: fail now, the watchdog is racing us
+                        if attempt < self.cfg.source_piece_retries:
+                            self.log.debug(
+                                "source piece %d attempt %d failed: %r", idx, attempt, e
+                            )
+                            await self._backoff.sleep(attempt)
+                raise last if last is not None else IOError(f"source piece {idx} failed")
+
+        await gather_all_cancel_on_error(
+            fetch(idx) for idx in self.ts.finished.missing_until(m.total_pieces)
+        )
 
     async def _download_source_sequential(self) -> None:
         """Origin without Range support: stream the whole body once, carving
@@ -542,29 +605,40 @@ class PeerTaskConductor:
         until the parent's task state changes past the seen version (ref
         pieceTaskSynchronizer.receive push loop)."""
         version = -1
+        errors = 0  # consecutive failures feed the shared backoff ladder
         url = f"http://{state.info.ip}:{state.info.download_port}/metadata/{self.meta.task_id}"
         while not state.blocked:
             try:
+                if faultline.ACTIVE is not None:
+                    await faultline.ACTIVE.fire("parent.metadata")
                 # `have` makes piece_digests a delta (digests we already hold
                 # are never re-sent — O(pieces) total instead of O(pieces²))
                 have = 0
                 for k in self._piece_digests:
                     have |= 1 << int(k)
+                # park no longer than the remaining task budget allows
+                wait = self.cfg.longpoll_wait
+                remaining = dl.remaining()
+                if remaining is not None:
+                    wait = max(0.1, min(wait, remaining))
                 async with session.get(
                     url,
                     params={
                         "since": str(version),
-                        "wait": str(self.cfg.longpoll_wait),
+                        "wait": str(wait),
                         "have": format(have, "x"),
                     },
-                    timeout=aiohttp.ClientTimeout(total=self.cfg.longpoll_wait + 10),
+                    timeout=aiohttp.ClientTimeout(total=wait + 10),
                 ) as resp:
                     if resp.status != 200:
                         state.record(False, 0)
                         self._update_event.set()
-                        await asyncio.sleep(0.5)  # parent may not know the task yet
+                        errors += 1
+                        # parent may not know the task yet
+                        await self._backoff.sleep(errors - 1)
                         continue
                     data = await resp.json()
+                errors = 0
                 version = data.get("version", version)
                 finished_hex = data.get("finished_hex")
                 if finished_hex is not None:
@@ -601,7 +675,8 @@ class PeerTaskConductor:
                 state.record(False, 0)
                 self._update_event.set()
                 self.log.debug("parent %s metadata sync error: %r", state.info.peer_id, e)
-                await asyncio.sleep(0.5)
+                errors += 1
+                await self._backoff.sleep(errors - 1)
                 continue
             self._update_event.set()
 
@@ -611,8 +686,31 @@ class PeerTaskConductor:
             try:
                 if not self.ts.has_piece(idx):
                     await self._download_one_piece(session, idx)
-            except Exception:
-                self.log.debug("piece %d failed", idx, exc_info=True)
+            except Exception as e:
+                # _download_one_piece handles the expected fetch/verify
+                # failures itself; anything landing HERE (storage write error,
+                # report rpc failure, injected storage fault) used to be
+                # debug-logged and silently dropped until the 600 s watchdog
+                # fired. Re-enqueue bounded; past the bound, report the piece
+                # failed so the dispatcher/cutover logic sees it immediately.
+                n = self._piece_errors.get(idx, 0) + 1
+                self._piece_errors[idx] = n
+                if n <= self.cfg.piece_requeue_limit and not self.ts.has_piece(idx):
+                    self.log.debug(
+                        "piece %d worker failed (attempt %d), re-enqueueing: %r", idx, n, e
+                    )
+                    queue.put_nowait(idx)
+                else:
+                    self.log.warning(
+                        "piece %d failed past the re-enqueue budget", idx, exc_info=True
+                    )
+                    try:
+                        await self.scheduler.report_piece_result(
+                            self.peer_id, idx, success=False
+                        )
+                    except Exception as report_err:  # noqa: BLE001 — the report is
+                        # best-effort; the dispatch loop re-sees the piece anyway
+                        self.log.debug("piece %d failure report failed: %r", idx, report_err)
             finally:
                 queue.task_done()
 
@@ -626,7 +724,13 @@ class PeerTaskConductor:
             f"/download/{self.meta.task_id[:3]}/{self.meta.task_id}?peerId={self.peer_id}"
         )
         t0 = time.monotonic()
+        # per-op timeout capped by the propagated task budget; the floor
+        # matters because aiohttp treats total=0 as "no timeout", which is
+        # exactly wrong for an exhausted budget
+        piece_timeout = max(0.001, dl.timeout(self.cfg.piece_timeout))
         try:
+            if faultline.ACTIVE is not None:
+                await faultline.ACTIVE.fire("parent.fetch")
             await self.bucket.acquire(r.length)
             if r.length >= self._RAW_FETCH_BYTES:
                 # big pieces ride the raw keep-alive client: the body lands
@@ -635,17 +739,21 @@ class PeerTaskConductor:
                 # on the checkpoint fan-out path (see daemon/rawrange.py)
                 data = await self._raw_http().get_range(
                     state.info.ip, state.info.download_port, path_qs,
-                    r.header(), r.length, timeout=self.cfg.piece_timeout,
+                    r.header(), r.length, timeout=piece_timeout,
                 )
             else:
                 async with session.get(
                     f"http://{state.info.ip}:{state.info.download_port}{path_qs}",
                     headers={"Range": r.header()},
-                    timeout=aiohttp.ClientTimeout(total=self.cfg.piece_timeout),
+                    timeout=aiohttp.ClientTimeout(total=piece_timeout),
                 ) as resp:
                     if resp.status != 206:
                         raise IOError(f"parent returned HTTP {resp.status}")
                     data = await resp.read()
+            if faultline.ACTIVE is not None:
+                # damage the payload AFTER the fetch so the digest check (and
+                # only it) is what stands between a corrupt parent and disk
+                data = faultline.ACTIVE.mutate("parent.piece_body", data)
         except (aiohttp.ClientError, asyncio.TimeoutError, IOError) as e:
             cost = (time.monotonic() - t0) * 1000
             state.record(False, cost)

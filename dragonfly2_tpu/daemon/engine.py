@@ -18,6 +18,7 @@ from dragonfly2_tpu.daemon.conductor import ConductorConfig, PeerTaskConductor, 
 from dragonfly2_tpu.daemon.source import SourceRegistry
 from dragonfly2_tpu.daemon.storage import OncePinRelease, StorageManager, TaskStorage
 from dragonfly2_tpu.daemon.upload import UploadServer
+from dragonfly2_tpu.resilience import deadline as dl
 from dragonfly2_tpu.scheduler.service import HostInfo, SchedulerService, TaskMeta
 from dragonfly2_tpu.utils import idgen
 
@@ -297,6 +298,7 @@ class PeerEngine:
         output_range: "tuple[int, int] | None" = None,
         seed: bool = False,
         headers: dict[str, str] | None = None,
+        timeout: float | None = None,
         **meta_kw,
     ) -> TaskStorage:
         """Download (or reuse) a task; optionally export to a named file.
@@ -305,7 +307,11 @@ class PeerEngine:
         exports just that slice — performed HERE, under this operation's pin,
         so a threaded storage reclaim can never evict the task between the
         download completing and the ranged export reading it. Raises
-        ValueError when the range falls outside the task's content length."""
+        ValueError when the range falls outside the task's content length.
+
+        `timeout` is the task's whole-download budget: it rides the deadline
+        contextvar into the conductor (whose watchdog narrows it) and from
+        there into every rpc call and piece fetch (resilience.deadline)."""
         from dragonfly2_tpu.daemon import metrics
         from dragonfly2_tpu.observability.tracing import default_tracer
         from dragonfly2_tpu.utils.pieces import Range
@@ -316,7 +322,10 @@ class PeerEngine:
         if seed:
             metrics.SEED_TASK_TOTAL.inc()
 
-        ts, producer = await self._reuse_or_conduct(meta, headers, seed=seed)
+        with dl.scope(timeout):
+            # the conductor task is created inside the scope, so it inherits
+            # the budget through its captured Context
+            ts, producer = await self._reuse_or_conduct(meta, headers, seed=seed)
         pinned = ts  # engine-held pin for this operation (reclaim immunity)
         try:
             if producer is not None:
@@ -352,6 +361,7 @@ class PeerEngine:
         url: str,
         *,
         headers: dict[str, str] | None = None,
+        timeout: float | None = None,
         **meta_kw,
     ):
         """Start (or reuse) a task and return (content_length, async-iterator)
@@ -366,7 +376,8 @@ class PeerEngine:
         meta = self.make_meta(url, **meta_kw)
         metrics.TASK_TOTAL.inc(type="stream")
 
-        ts, producer = await self._reuse_or_conduct(meta, headers)
+        with dl.scope(timeout):
+            ts, producer = await self._reuse_or_conduct(meta, headers)
 
         # The operation pin from _reuse_or_conduct is normally released by the
         # body generator's finally — but a caller that never iterates (or

@@ -545,7 +545,7 @@ class SniProxy:
                 # transient accept failure (e.g. EMFILE) must not kill the
                 # listener — asyncio.start_server survives these too
                 logger.warning("sni proxy accept failed: %s", e)
-                await asyncio.sleep(0.1)
+                await asyncio.sleep(0.1)  # dflint: disable=DF024 fixed listener re-accept pause (EMFILE relief), not a retry ladder
                 continue
             conn.setblocking(False)
             t = asyncio.ensure_future(self._handle(conn))

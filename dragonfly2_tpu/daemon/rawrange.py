@@ -115,39 +115,52 @@ class RawRangeClient:
     ) -> bytearray:
         """GET path_qs with the given Range header; expects a 206 whose body
         is exactly `length` bytes and returns it as a bytearray (received in
-        place). Raises IOError on any other status or a short body."""
-        async with asyncio.timeout(timeout):
-            # Transparent retries ONLY for pooled sockets that turn out to be
-            # stale keep-alive connections (server closed them between uses →
-            # ConnectionError before any response): the loop drains however
-            # many stale sockets the pool holds — with a cross-task shared
-            # pool, EVERY pooled socket to a host can be stale after an idle
-            # gap — and the final fresh-connection attempt is authoritative.
-            # Deterministic application failures (non-206, bad framing) raise
-            # plain IOError and are never replayed.
-            key = (ip, port)
-            while True:
-                sock = self._checkout(key)
-                pooled = sock is not None
-                try:
-                    if sock is None:
-                        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-                        sock.setblocking(False)
-                        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                        await asyncio.get_running_loop().sock_connect(sock, (ip, port))
-                    return await self._request(
-                        sock, key, ip, port, path_qs, range_header, length
-                    )
-                except BaseException as e:
-                    # every failure path — including timeout expiry and task
-                    # cancellation mid-body — must close the socket: a piece
-                    # timeout against a stalled parent is routine, and each
-                    # one would otherwise leak an fd
-                    if sock is not None:
-                        sock.close()
-                    if pooled and isinstance(e, ConnectionError):
-                        continue  # drain the next pooled socket (or go fresh)
-                    raise
+        place). Raises IOError on any other status or a short body, and
+        builtin TimeoutError past `timeout` (on this image's 3.10,
+        asyncio.TimeoutError is a separate class — callers match the builtin,
+        and as an OSError subclass it also rides every IOError retry path)."""
+        try:
+            return await asyncio.wait_for(
+                self._get_with_pool(ip, port, path_qs, range_header, length), timeout
+            )
+        except asyncio.TimeoutError:
+            raise TimeoutError(
+                f"range fetch from {ip}:{port} timed out after {timeout}s"
+            ) from None
+
+    async def _get_with_pool(
+        self, ip: str, port: int, path_qs: str, range_header: str, length: int
+    ) -> bytearray:
+        # Transparent retries ONLY for pooled sockets that turn out to be
+        # stale keep-alive connections (server closed them between uses →
+        # ConnectionError before any response): the loop drains however
+        # many stale sockets the pool holds — with a cross-task shared
+        # pool, EVERY pooled socket to a host can be stale after an idle
+        # gap — and the final fresh-connection attempt is authoritative.
+        # Deterministic application failures (non-206, bad framing) raise
+        # plain IOError and are never replayed.
+        key = (ip, port)
+        while True:
+            sock = self._checkout(key)
+            pooled = sock is not None
+            try:
+                if sock is None:
+                    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                    sock.setblocking(False)
+                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    await asyncio.get_running_loop().sock_connect(sock, (ip, port))
+                return await self._request(
+                    sock, key, ip, port, path_qs, range_header, length
+                )
+            except BaseException as e:
+                # every failure path — including timeout cancellation mid-body
+                # — must close the socket: a piece timeout against a stalled
+                # parent is routine, and each one would otherwise leak an fd
+                if sock is not None:
+                    sock.close()
+                if pooled and isinstance(e, ConnectionError):
+                    continue  # drain the next pooled socket (or go fresh)
+                raise
 
     async def _request(
         self,

@@ -215,7 +215,12 @@ async def run_daemon(
     per_task_rate_bps: float | None = None,
     ready_event: asyncio.Event | None = None,
 ) -> None:
+    from dragonfly2_tpu.resilience import faultline
     from dragonfly2_tpu.rpc.balancer import make_scheduler_client
+
+    # chaos runs opt in via DF_FAULTS="point:kind:rate[,...],seed=N" (see
+    # README "Resilience"); unset means faultline stays a no-op None check
+    faultline.install_from_env()
 
     # one address → plain client; "a:1,b:2" (or a manager address book) →
     # consistent-hash balanced with live membership (ref pkg/resolver fed by

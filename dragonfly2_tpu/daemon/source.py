@@ -20,6 +20,7 @@ from urllib.parse import urlsplit
 
 import aiohttp
 
+from dragonfly2_tpu.resilience import faultline
 from dragonfly2_tpu.utils.pieces import Range
 
 
@@ -403,7 +404,28 @@ class SourceRegistry:
     async def download(
         self, url: str, rng: Range | None = None, headers: dict | None = None
     ) -> AsyncIterator[bytes]:
+        # Faultline rides the registry (one seam covers every scheme client).
+        # Exactly TWO rng decisions per stream — `source.read` (latency/error/
+        # drop) at open, `source.body` (truncate/corrupt) on the first chunk —
+        # so injection probability is per-READ, independent of how the
+        # transport happens to chunk the body (per-chunk draws would compound
+        # a small rate into near-certain failure on a 64-chunk piece).
+        # Disabled cost: one module-global is-None check.
+        if faultline.ACTIVE is None:
+            async for chunk in self.client_for(url).download(url, rng, headers):
+                yield chunk
+            return
+        await faultline.ACTIVE.fire("source.read")
+        first = True
         async for chunk in self.client_for(url).download(url, rng, headers):
+            if first:
+                first = False
+                mutated = faultline.ACTIVE.mutate("source.body", chunk)
+                if len(mutated) != len(chunk):  # truncated: short body, then EOF
+                    if mutated:
+                        yield mutated
+                    return
+                chunk = mutated
             yield chunk
 
     async def list_entries(self, url: str, headers: dict | None = None) -> list[URLEntry]:

@@ -16,6 +16,7 @@ import time
 from dataclasses import asdict, dataclass, field
 from pathlib import Path
 
+from dragonfly2_tpu.resilience import faultline
 from dragonfly2_tpu.utils import digest as digestlib
 from dragonfly2_tpu.utils.bitset import Bitset
 from dragonfly2_tpu.utils.pieces import Range, piece_range
@@ -197,6 +198,10 @@ class TaskStorage:
         in-flight future so racing writes can never interleave bytes."""
         if self.meta.piece_size <= 0:
             raise ValueError("task info not set before write_piece")
+        if faultline.ACTIVE is not None:
+            # `storage.write`: injected disk latency / write errors — the
+            # piece-worker re-enqueue path must absorb these
+            await faultline.ACTIVE.fire("storage.write")
         r = piece_range(index, self.meta.piece_size, self.meta.content_length)
         if len(data) != r.length:
             raise ValueError(f"piece {index}: got {len(data)} bytes, want {r.length}")

@@ -164,7 +164,15 @@ def launch_localhost(
     Each process gets the DF_DIST_* env (coordinator on a free port) plus
     `local_devices` virtual CPU devices. Returns the completed processes in
     process-id order; raises if any exits nonzero.
+
+    `timeout` is ONE wall-clock budget for the whole cluster, not a fresh
+    allowance per process: a deadlocked collective stalls every process, and
+    N sequential full timeouts would multiply the wait by N (a tier-1 run
+    lost most of its budget to exactly that before this was a deadline).
     """
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
     coord = f"127.0.0.1:{free_port()}"
     procs: list[subprocess.Popen] = []
     for pid in range(num_processes):
@@ -193,11 +201,12 @@ def launch_localhost(
     failed: list[str] = []
     for pid, p in enumerate(procs):
         try:
-            out, err = p.communicate(timeout=timeout)
+            remaining = max(1.0, deadline - _time.monotonic())
+            out, err = p.communicate(timeout=remaining)
         except subprocess.TimeoutExpired:
             p.kill()
             out, err = p.communicate()
-            failed.append(f"process {pid} timed out after {timeout}s")
+            failed.append(f"process {pid} timed out ({timeout}s cluster budget)")
         done.append(subprocess.CompletedProcess(p.args, p.returncode, out, err))
         if p.returncode != 0:
             failed.append(

@@ -1,0 +1,30 @@
+"""Unified resilience layer for the P2P data plane.
+
+Dragonfly's value proposition is that a download survives the cluster
+misbehaving; this package centralizes the machinery that makes that true
+instead of ad-hoc per-module retry loops (the pre-PR-2 state: linear sleeps
+in rpc/core.py, a fixed 50 ms interval in scheduler/scheduling.py, a 600 s
+watchdog in daemon/conductor.py and nothing else):
+
+  backoff   — BackoffPolicy: exponential backoff with deterministic seeded
+              jitter, shared by every retry loop in the tree (dflint DF024
+              flags hand-rolled asyncio.sleep retry ladders outside here)
+  breaker   — CircuitBreaker: per-target open/half-open/closed state so a
+              dead scheduler costs one failure burst, not a timeout per call
+  deadline  — cooperative deadline propagation (contextvar): a budget carried
+              engine → conductor → scheduler-client, so nested rpc calls and
+              piece fetches get min(remaining, per-op) timeouts instead of
+              independent 30 s / 600 s constants
+  faultline — deterministic, seeded fault injection behind named points in
+              the hot paths (rpc frame IO, parent piece fetch, metadata
+              long-poll, origin reads, storage writes); a single module-
+              global None check when disabled, so production pays nothing
+
+See README.md "Resilience" for semantics and the DF_FAULTS spec grammar.
+"""
+
+from dragonfly2_tpu.resilience.backoff import BackoffPolicy
+from dragonfly2_tpu.resilience.breaker import CircuitBreaker
+from dragonfly2_tpu.resilience.deadline import Deadline
+
+__all__ = ["BackoffPolicy", "CircuitBreaker", "Deadline"]

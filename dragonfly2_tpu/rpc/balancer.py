@@ -16,6 +16,12 @@ address list). Design differences for this stack:
 - The resolver polls a callback (usually manager ListSchedulers through
   dynconfig) and rebuilds the ring on membership change; a dead address's
   tasks re-hash to survivors on the next pick.
+- Per-target circuit breakers (each RpcClient carries one,
+  resilience.breaker) feed placement: NEW keys walk the ring past addresses
+  whose breaker is open, so a dead scheduler costs its first callers a
+  failure burst and everyone else nothing; learned (sticky) routes are NOT
+  rerouted — their state lives on the original scheduler — they fast-fail
+  at the breaker until its half-open probe readmits the target.
 """
 
 from __future__ import annotations
@@ -68,12 +74,25 @@ class ConsistentHashRing:
     def addresses(self) -> set[str]:
         return set(self._addresses)
 
-    def pick(self, key: str) -> str:
+    def pick(self, key: str, avoid: "set[str] | frozenset[str]" = frozenset()) -> str:
+        """Owner address for `key`. `avoid` (e.g. addresses whose circuit
+        breaker is open) is skipped by walking the ring forward — keys not
+        owned by an avoided address keep their placement, and the fallback
+        owner is itself consistent, so reroutes are stable too. If every
+        address is avoided the natural owner is returned (the breaker there
+        will fast-fail, which is still cheaper than no answer)."""
         if not self._ring:
             raise RpcError("no scheduler addresses available", code="unavailable")
         h = _hash(key)
         idx = bisect.bisect_right(self._ring, (h, "￿")) % len(self._ring)
-        return self._ring[idx][1]
+        natural = self._ring[idx][1]
+        if natural not in avoid:
+            return natural
+        for step in range(1, len(self._ring)):
+            addr = self._ring[(idx + step) % len(self._ring)][1]
+            if addr not in avoid:
+                return addr
+        return natural
 
 
 class BalancedSchedulerClient:
@@ -137,6 +156,17 @@ class BalancedSchedulerClient:
             client = self._clients[addr] = self._factory(addr)
         return client
 
+    def _open_addresses(self) -> set[str]:
+        """Addresses whose circuit breaker is currently refusing calls.
+        Only instantiated clients can be open (no traffic, no failures); the
+        breaker's cooldown lapse re-admits an address so probes still flow."""
+        out = set()
+        for addr, client in self._clients.items():
+            breaker = getattr(client, "breaker", None)
+            if breaker is not None and breaker.is_open:
+                out.add(addr)
+        return out
+
     @staticmethod
     def _prune(mapping: dict, cap: int) -> None:
         while len(mapping) > cap:  # drop oldest entries (dict insert order)
@@ -149,9 +179,12 @@ class BalancedSchedulerClient:
         self._prune(self._task_addr, self._map_cap)
 
     def _for_task(self, task_id: str) -> Any:
+        # learned owners stay sticky even through an open breaker: the task's
+        # state lives there, and rerouting would answer from a scheduler that
+        # has never seen the peer
         addr = self._task_addr.get(task_id)
         if addr is None or addr not in self.ring.addresses:
-            addr = self.ring.pick(task_id)
+            addr = self.ring.pick(task_id, avoid=self._open_addresses())
         return self._client(addr)
 
     def _for_peer(self, peer_id: str) -> Any:
@@ -159,16 +192,17 @@ class BalancedSchedulerClient:
         if addr is None or addr not in self.ring.addresses:
             # unknown peer (restart?) — fall back to hashing the peer id so
             # at least routing is deterministic
-            addr = self.ring.pick(peer_id)
+            addr = self.ring.pick(peer_id, avoid=self._open_addresses())
         return self._client(addr)
 
     # ---- SchedulerClient protocol ----
 
     def _owner_for_task(self, task_id: str) -> str:
-        """Learned owner first (sticky across membership change), else ring."""
+        """Learned owner first (sticky across membership change), else ring —
+        routing NEW tasks away from schedulers whose breaker is open."""
         addr = self._task_addr.get(task_id)
         if addr is None or addr not in self.ring.addresses:
-            addr = self.ring.pick(task_id)
+            addr = self.ring.pick(task_id, avoid=self._open_addresses())
         return addr
 
     async def register_peer(self, peer_id, meta, host):

@@ -10,7 +10,9 @@ Server: asyncio.start_server (tcp or unix), method registry, per-server QPS
 token bucket (reference default 10k QPS / 20k burst,
 pkg/rpc/scheduler/server/server.go:43-44), error mapping.
 Client: one connection with request multiplexing, auto-reconnect, retry with
-linear backoff (ref interceptor chain's retry), request timeout.
+exponential backoff + jitter (resilience.BackoffPolicy, ref interceptor
+chain's retry), a per-target circuit breaker, and deadline-aware request
+timeouts (min of the per-op timeout and the caller's propagated budget).
 """
 
 from __future__ import annotations
@@ -22,6 +24,10 @@ from typing import Any, Awaitable, Callable
 
 import msgpack
 
+from dragonfly2_tpu.resilience import deadline as dl
+from dragonfly2_tpu.resilience import faultline
+from dragonfly2_tpu.resilience.backoff import BackoffPolicy
+from dragonfly2_tpu.resilience.breaker import CircuitBreaker
 from dragonfly2_tpu.utils.ratelimit import TokenBucket
 
 logger = logging.getLogger(__name__)
@@ -42,6 +48,8 @@ class ConnectionClosed(RpcError):
 
 
 async def _read_frame(reader: asyncio.StreamReader) -> dict:
+    if faultline.ACTIVE is not None:
+        await faultline.ACTIVE.fire("rpc.read")
     header = await reader.readexactly(_LEN.size)
     (length,) = _LEN.unpack(header)
     if length > MAX_FRAME:
@@ -51,6 +59,8 @@ async def _read_frame(reader: asyncio.StreamReader) -> dict:
 
 
 def _write_frame(writer: asyncio.StreamWriter, msg: dict) -> None:
+    if faultline.ACTIVE is not None:
+        faultline.ACTIVE.check("rpc.write")
     body = msgpack.packb(msg, use_bin_type=True)
     writer.write(_LEN.pack(len(body)) + body)
 
@@ -159,7 +169,10 @@ class RpcServer:
             while True:
                 try:
                     msg = await _read_frame(reader)
-                except (asyncio.IncompleteReadError, ConnectionResetError):
+                except (asyncio.IncompleteReadError, OSError):
+                    # peer hung up, or the transport (or an injected rpc.read
+                    # fault) failed the read — either way this connection is
+                    # done; the client's retry path owns recovery
                     break
                 if not isinstance(msg, dict):
                     logger.warning("malformed frame (%s), closing connection", type(msg).__name__)
@@ -200,8 +213,10 @@ class RpcServer:
             try:
                 _write_frame(writer, out)
                 await writer.drain()
-            except (ConnectionResetError, BrokenPipeError):
-                pass
+            except OSError as e:
+                # peer gone mid-response (reset/broken pipe) or an injected
+                # rpc.write fault: the client's retry path owns recovery
+                logger.debug("response write for %s failed: %r", method, e)
 
 
 class RpcClient:
@@ -212,12 +227,22 @@ class RpcClient:
         timeout: float = 30.0,
         retries: int = 3,
         retry_backoff: float = 0.2,
+        backoff: BackoffPolicy | None = None,
+        breaker: CircuitBreaker | None = None,
         ssl: Any = None,
     ):
         self.address = address
         self.timeout = timeout
         self.retries = retries
-        self.retry_backoff = retry_backoff
+        self.retry_backoff = retry_backoff  # kept: seeds the default policy base
+        # exponential + jitter, capped well under the per-op timeout so the
+        # retry budget is spent on attempts, not waiting
+        self.backoff = backoff or BackoffPolicy(
+            base=retry_backoff, multiplier=2.0, max_delay=5.0, jitter=0.5
+        )
+        # per-target state: one client == one address, so this breaker IS the
+        # per-target breaker (the balancer keeps one client per scheduler)
+        self.breaker = breaker or CircuitBreaker()
         self.ssl = ssl  # ssl.SSLContext (security.ca.client_ssl_context)
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
@@ -263,7 +288,10 @@ class RpcClient:
                     fut.set_exception(RpcError(err.get("message", ""), err.get("code", "internal")))
                 else:
                     fut.set_result(msg.get("r"))
-        except (asyncio.IncompleteReadError, ConnectionResetError, asyncio.CancelledError):
+        except (asyncio.IncompleteReadError, OSError, asyncio.CancelledError):
+            # OSError covers transport failures AND injected rpc.read faults
+            # (FaultError is an IOError); the finally below fails the pending
+            # futures so call() reconnects and retries
             pass
         finally:
             for fut in self._pending.values():
@@ -282,20 +310,57 @@ class RpcClient:
                 self._reader = self._writer = None  # dflint: disable=DF023 loop-thread reset, no await around it
                 self._recv_task = None  # dflint: disable=DF023 loop-thread reset, no await around it
 
+    def _effective_timeout(self, timeout: float | None, method: str) -> float:
+        """min(per-op timeout, propagated deadline remaining). An exhausted
+        budget fails fast instead of issuing a request that cannot finish."""
+        per_op = timeout or self.timeout
+        rem = dl.remaining()
+        if rem is None:
+            return per_op
+        if rem <= 0:
+            raise RpcError(
+                f"{method}: deadline exhausted before call", code="deadline_exceeded"
+            )
+        return min(per_op, rem)
+
     async def call(self, method: str, payload: Any = None, *, timeout: float | None = None) -> Any:
         last_err: Exception | None = None
         for attempt in range(self.retries + 1):
+            if not self.breaker.allow():
+                raise RpcError(
+                    f"circuit open to {self.address}"
+                    + (f" (last: {last_err})" if last_err else ""),
+                    code="unavailable",
+                )
+            # outside the try: an exhausted caller budget is not the target's
+            # fault and must not feed the breaker
+            per_op = timeout or self.timeout
+            effective = self._effective_timeout(timeout, method)
             try:
-                return await self._call_once(method, payload, timeout or self.timeout)
+                result = await self._call_once(method, payload, effective)
+                self.breaker.record_success()
+                return result
             except (ConnectionClosed, ConnectionError, OSError) as e:
+                self.breaker.record_failure()
                 last_err = e
                 self._drop_connection()
                 if attempt < self.retries:  # no pointless sleep before raising
-                    await asyncio.sleep(self.retry_backoff * (attempt + 1))  # linear backoff
+                    await self.backoff.sleep(attempt)
             except RpcError as e:
+                if e.code == "deadline_exceeded":
+                    if effective >= per_op:
+                        # silent for the FULL per-op window: counts against
+                        # the target
+                        self.breaker.record_failure()
+                    # else: the caller's nearly-spent budget shrank the
+                    # window — a healthy target may simply not have had time;
+                    # record nothing either way
+                else:
+                    # any decoded response (even an error) proves the target alive
+                    self.breaker.record_success()
                 if e.code == "resource_exhausted" and attempt < self.retries:
                     last_err = e
-                    await asyncio.sleep(self.retry_backoff * (attempt + 1))
+                    await self.backoff.sleep(attempt)
                     continue
                 raise
         raise last_err or RpcError("rpc call failed")
@@ -327,10 +392,25 @@ class RpcClient:
         self._reader = self._writer = None  # dflint: disable=DF023 sync method, atomic on the loop thread
 
     async def close(self) -> None:
+        writer = self._writer
         self._drop_connection()
+        # In-flight futures must fail NOW, not hang until their timeout: the
+        # recv task's finally does this too, but its cancellation completes on
+        # a later loop cycle — close() callers (shutdown paths) need it done
+        # before they proceed.
+        for fut in list(self._pending.values()):
+            if not fut.done():
+                fut.set_exception(ConnectionClosed())
+        self._pending.clear()
+        if writer is not None:
+            try:
+                await writer.wait_closed()
+            except (ConnectionResetError, BrokenPipeError, OSError):
+                pass
 
     async def healthy(self) -> bool:
         try:
             return await self.call("_ping", timeout=2.0) == "pong"
-        except Exception:
+        except (RpcError, ConnectionError, OSError, asyncio.TimeoutError) as e:
+            logger.debug("health probe of %s failed: %r", self.address, e)
             return False

@@ -127,6 +127,12 @@ class RemoteSchedulerClient:
     def __init__(self, address: str, **client_kw: Any):
         self._rpc = RpcClient(address, **client_kw)
 
+    @property
+    def breaker(self):
+        """Per-target circuit breaker (surfaced for the balancer's
+        breaker-aware ring placement)."""
+        return self._rpc.breaker
+
     async def register_peer(self, peer_id: str, meta: TaskMeta, host: HostInfo) -> RegisterResult:
         out = await self._rpc.call(
             "register_peer",

@@ -188,14 +188,22 @@ class ManagerLink:
 
     async def _job_loop(self) -> None:
         """Preheat consumer (ref scheduler/job preheat handler)."""
+        from dragonfly2_tpu.resilience.backoff import BackoffPolicy
+
         queue = f"scheduler_cluster_{self.cluster_id}"
+        # a down manager backs off exponentially (5 s → 30 s cap) instead of
+        # the old flat 5 s hammering; any successful pull resets the ladder
+        backoff = BackoffPolicy(base=5.0, multiplier=2.0, max_delay=30.0, jitter=0.3)
+        failures = 0
         while True:
             try:
                 item = await self.manager.pull_job(queue, timeout=30.0)
             except Exception as e:
                 logger.warning("job pull failed: %s", e)
-                await asyncio.sleep(5.0)
+                await backoff.sleep(failures)
+                failures += 1
                 continue
+            failures = 0
             if item is None:
                 continue
             await self._run_job(item)

@@ -4,8 +4,13 @@ Parity with reference scheduler/scheduling/scheduling.go:81-207 and the
 constants at scheduler/config/constants.go:36-76: per round, sample up to 40
 random peers from the task DAG, run the candidate filters, score the
 survivors with the (batched) evaluator, and hand back the top 4; retry up to
-10 times at 50 ms intervals, escalating to back-to-source after 5 empty
-rounds.
+10 times, escalating to back-to-source after 5 empty rounds.
+
+Retry pacing is the shared resilience BackoffPolicy (exponential from
+retry_interval with seeded jitter, capped at 16x the base) instead of the
+reference's fixed 50 ms ticks: empty rounds early in a task's life are
+common (parents still registering) and deserve a fast re-try, while a task
+that stays parentless shouldn't hammer the DAG sampler every 50 ms.
 
 The retry loop is async (the reference used a goroutine sleep loop); filters
 are pure functions over the resource model so they unit-test without mocks.
@@ -13,7 +18,6 @@ are pure functions over the resource model so they unit-test without mocks.
 
 from __future__ import annotations
 
-import asyncio
 import logging
 import random
 from dataclasses import dataclass, field
@@ -21,6 +25,7 @@ from typing import Callable
 
 import numpy as np
 
+from dragonfly2_tpu.resilience.backoff import BackoffPolicy
 from dragonfly2_tpu.scheduler.evaluator import Evaluator
 from dragonfly2_tpu.scheduler.resource import (
     PEER_BACK_TO_SOURCE,
@@ -59,6 +64,15 @@ class Scheduling:
         self.evaluator = evaluator
         self.config = config or SchedulingConfig()
         self._rng = random.Random(0)
+        # Own rng (not self._rng): backoff draws must not perturb the
+        # candidate-sampling sequence, which tests pin by seed.
+        self._backoff = BackoffPolicy(
+            base=self.config.retry_interval,
+            multiplier=2.0,
+            max_delay=self.config.retry_interval * 16,
+            jitter=0.3,
+            rng=random.Random(0),
+        )
 
     # ---- filters (ref filterCandidateParents' 8 conditions) ----
 
@@ -232,7 +246,7 @@ class Scheduling:
                 if committed:
                     child.schedule_rounds += 1
                     return ScheduleOutcome(parents=committed, rounds=attempt + 1)
-            await asyncio.sleep(cfg.retry_interval)
+            await self._backoff.sleep(attempt)
         # retries exhausted: last resort is back-to-source, else failure
         if child.task.can_back_to_source():
             child.fsm.fire("back_to_source")

@@ -143,11 +143,12 @@ async def fetch_checkpoint(
             )
             logger.info("%s: fetched %d bytes via p2p", entry.path, entry.size)
 
-    # TaskGroup: first failure cancels the remaining fetches instead of
-    # leaving multi-GB downloads running detached after the error returns
-    async with asyncio.TaskGroup() as tg:
-        for e in manifest.files:
-            tg.create_task(fetch_one(e))
+    # first failure cancels the remaining fetches instead of leaving multi-GB
+    # downloads running detached after the error returns (TaskGroup semantics;
+    # utils.aio provides them on this image's 3.10)
+    from dragonfly2_tpu.utils.aio import gather_all_cancel_on_error
+
+    await gather_all_cancel_on_error(fetch_one(e) for e in manifest.files)
     (dest / MANIFEST_NAME).write_text(manifest.to_json())
     return dest
 

@@ -1,0 +1,38 @@
+"""Small asyncio compatibility helpers.
+
+This image runs Python 3.10, where asyncio.TaskGroup (3.11+) does not exist —
+the two fan-out sites that wanted its semantics (ranged back-to-source piece
+fetches, checkpoint multi-file fetch) raised AttributeError at runtime the
+moment they were reached. `gather_all_cancel_on_error` provides the one
+TaskGroup behavior those sites rely on: run everything, and on the first
+failure cancel the stragglers before re-raising (so multi-GB sibling
+downloads don't keep running detached after the caller has already failed).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Iterable
+
+__all__ = ["gather_all_cancel_on_error"]
+
+
+async def gather_all_cancel_on_error(coros: Iterable[Awaitable]) -> None:
+    """Await all coroutines; first failure cancels the rest and re-raises.
+
+    Unlike bare asyncio.gather (which returns control on the first error but
+    leaves the remaining tasks running detached), every task is finished or
+    cancelled by the time this returns — TaskGroup semantics on 3.10. The
+    first exception (in completion order) propagates; later ones are eaten,
+    as with TaskGroup's primary-error behavior for non-ExceptionGroup users.
+    """
+    tasks = [asyncio.ensure_future(c) for c in coros]
+    if not tasks:
+        return
+    try:
+        await asyncio.gather(*tasks)
+    except BaseException:
+        for t in tasks:
+            t.cancel()
+        await asyncio.gather(*tasks, return_exceptions=True)
+        raise

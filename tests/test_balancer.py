@@ -184,3 +184,61 @@ class TestBalancedClient:
             await bc.close()
 
         run(body())
+
+
+class TestBreakerAwareRouting:
+    def test_ring_pick_avoid_walks_forward_consistently(self):
+        addrs = [f"10.0.0.{i}:9000" for i in range(4)]
+        ring = ConsistentHashRing(addrs)
+        keys = [f"task-{i}" for i in range(500)]
+        natural = {k: ring.pick(k) for k in keys}
+        dead = addrs[0]
+        rerouted = {k: ring.pick(k, avoid={dead}) for k in keys}
+        for k in keys:
+            if natural[k] != dead:
+                assert rerouted[k] == natural[k]  # unaffected keys stay put
+            else:
+                assert rerouted[k] != dead
+        # fallback owners are themselves deterministic
+        assert rerouted == {k: ring.pick(k, avoid={dead}) for k in keys}
+        # everything avoided → natural owner comes back (breaker fast-fails)
+        assert ring.pick(keys[0], avoid=set(addrs)) == natural[keys[0]]
+
+    def test_new_tasks_route_around_open_breaker(self, run):
+        from dragonfly2_tpu.resilience.breaker import CircuitBreaker
+
+        async def body():
+            bc = _balanced(["a:1", "b:2", "c:3"])
+            host = HostInfo(id="h1", ip="127.0.0.1", hostname="h1")
+            # find a task whose natural owner is "a:1", then open a:1's breaker
+            tid = next(
+                f"{i:064d}" for i in range(1000) if bc.ring.pick(f"{i:064d}") == "a:1"
+            )
+            breaker = CircuitBreaker(failure_threshold=1, reset_timeout=60.0)
+            breaker.record_failure()
+            bc._client("a:1").breaker = breaker  # FakeClient grows a breaker
+            meta = TaskMeta(task_id=tid, url="http://x")
+            await bc.register_peer("p1", meta, host)
+            assert bc._task_addr[tid] != "a:1"  # routed around the open target
+            assert FakeClient.instances["a:1"].calls == []
+            await bc.close()
+
+        run(body())
+
+    def test_sticky_tasks_stay_on_open_owner(self, run):
+        from dragonfly2_tpu.resilience.breaker import CircuitBreaker
+
+        async def body():
+            bc = _balanced(["a:1", "b:2"])
+            meta = TaskMeta(task_id="t" * 64, url="http://x")
+            host = HostInfo(id="h1", ip="127.0.0.1", hostname="h1")
+            await bc.register_peer("p1", meta, host)
+            owner = bc._task_addr[meta.task_id]
+            breaker = CircuitBreaker(failure_threshold=1, reset_timeout=60.0)
+            breaker.record_failure()
+            bc._client(owner).breaker = breaker
+            # learned route is NOT rerouted: its state lives on the owner
+            assert bc._for_task(meta.task_id).addr == owner
+            await bc.close()
+
+        run(body())

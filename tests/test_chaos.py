@@ -1,0 +1,420 @@
+"""Chaos suite: the in-process cluster (engine + SchedulerService + upload
+servers) under deterministic injected faults (resilience.faultline).
+
+Every test pins a faultline seed, so a failing run replays exactly. The
+contract under every fault class — latency, error, connection drop,
+truncated bodies, bit-flipped piece payloads — is the same: the download
+COMPLETES, BIT-EXACT. Degradation is allowed (parent blocked, reschedule,
+back-to-source cutover); data loss and corruption are not. Plus the two
+named degradation paths: parent death mid-transfer forces a reschedule, and
+retry-budget exhaustion forces back-to-source cutover with byte/metric
+accounting intact. All cases here are tier-1-fast; the suite doubles as the
+`chaos` marker's home (tools/check.sh runs it as the chaos-smoke leg)."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import pytest
+from test_e2e import Origin, fast_conductor, make_engine
+
+from dragonfly2_tpu.daemon import metrics
+from dragonfly2_tpu.daemon.engine import InProcessSchedulerClient
+from dragonfly2_tpu.resilience import faultline
+from dragonfly2_tpu.scheduler.service import HostInfo, SchedulerService
+from dragonfly2_tpu.utils.pieces import Range
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _faultline_cleanup():
+    """No chaos test may leak an ACTIVE faultline into the rest of tier-1."""
+    yield
+    faultline.disable()
+
+
+@pytest.fixture
+def payload():
+    return bytes(range(256)) * (40 * 1024)  # 10 MiB -> 3 pieces of 4 MiB
+
+
+def _piece_counts() -> tuple[float, float]:
+    parent = metrics.PIECE_DOWNLOAD_TOTAL.labels(source="parent").value
+    source = metrics.PIECE_DOWNLOAD_TOTAL.labels(source="back_to_source").value
+    return parent, source
+
+
+async def _seed_parent(tmp_path, client, origin, payload):
+    """e1 downloads clean (faultline off) and becomes the task's parent."""
+    e1 = make_engine(tmp_path, client, "parent1")
+    await e1.start()
+    await e1.download_task(origin.url("f.bin"))
+    return e1
+
+
+# ---------------------------------------------------------------------------
+# fault classes on the parent (p2p) path
+
+
+# (name, DF_FAULTS spec) — rates chosen so the seeded run both injects
+# faults AND leaves the retry/reschedule budget room to finish
+PARENT_FAULTS = [
+    ("latency", "parent.fetch:latency:0.8:0.05,seed=11"),
+    ("error", "parent.fetch:error:0.5,seed=12"),
+    ("drop", "parent.fetch:drop:0.5,seed=13"),
+    ("truncation", "parent.piece_body:truncate:0.5,seed=14"),
+    ("corruption", "parent.piece_body:corrupt:0.5,seed=15"),
+    ("storage-write", "storage.write:error:0.4,seed=16"),
+]
+
+
+class TestParentPathFaults:
+    @pytest.mark.parametrize("name,spec", PARENT_FAULTS, ids=[n for n, _ in PARENT_FAULTS])
+    def test_download_completes_bit_exact(self, run, tmp_path, payload, name, spec):
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            async with Origin({"f.bin": payload}) as origin:
+                e1 = await _seed_parent(tmp_path, client, origin, payload)
+                e2 = make_engine(tmp_path, client, "child1")
+                await e2.start()
+                try:
+                    fl = faultline.enable(spec)
+                    out = tmp_path / "chaos.bin"
+                    ts = await asyncio.wait_for(
+                        e2.download_task(origin.url("f.bin"), output=out), 60
+                    )
+                    faultline.disable()
+                    assert ts.is_complete() and ts.meta.done
+                    assert out.read_bytes() == payload  # bit-exact under faults
+                    assert fl.injected_total() > 0, "fault class never fired"
+                finally:
+                    faultline.disable()
+                    await e1.stop()
+                    await e2.stop()
+
+        run(body())
+
+    def test_corrupt_piece_never_marked_finished(self, run, tmp_path, payload):
+        """Under 100% piece corruption from the parent, the digest check must
+        reject every parent byte: the child finishes via origin (cutover) and
+        nothing corrupt is ever served onward."""
+
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            async with Origin({"f.bin": payload}) as origin:
+                e1 = await _seed_parent(tmp_path, client, origin, payload)
+                e2 = make_engine(tmp_path, client, "child1")
+                await e2.start()
+                try:
+                    fl = faultline.enable("parent.piece_body:corrupt:1.0,seed=21")
+                    out = tmp_path / "c.bin"
+                    ts = await asyncio.wait_for(
+                        e2.download_task(origin.url("f.bin"), output=out), 60
+                    )
+                    faultline.disable()
+                    assert out.read_bytes() == payload
+                    assert fl.injected[("parent.piece_body", "corrupt")] >= 1
+                    # every corrupted fetch was rejected: zero corrupt bytes
+                    # were accepted from the parent into a finished piece
+                    assert ts.verify()
+                finally:
+                    faultline.disable()
+                    await e1.stop()
+                    await e2.stop()
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# fault classes on the origin (back-to-source) path
+
+
+# source.read/source.body draw once per stream (per piece attempt on the
+# ranged path), so these rates trade off against source_piece_retries=3:
+# per-piece failure-after-retries at 0.3 is ~0.8%
+SOURCE_FAULTS = [
+    ("latency", "source.read:latency:0.5:0.02,seed=31"),
+    ("error", "source.read:error:0.3,seed=32"),
+    ("drop", "source.read:drop:0.3,seed=41"),
+    ("truncation", "source.body:truncate:0.3,seed=40"),
+]
+
+
+class TestSourcePathFaults:
+    @pytest.mark.parametrize("name,spec", SOURCE_FAULTS, ids=[n for n, _ in SOURCE_FAULTS])
+    def test_back_to_source_survives(self, run, tmp_path, payload, name, spec):
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            async with Origin({"f.bin": payload}) as origin:
+                e1 = make_engine(tmp_path, client, "peer1")
+                await e1.start()
+                try:
+                    fl = faultline.enable(spec)
+                    out = tmp_path / "src.bin"
+                    ts = await asyncio.wait_for(
+                        e1.download_task(origin.url("f.bin"), output=out), 60
+                    )
+                    faultline.disable()
+                    assert ts.is_complete()
+                    assert out.read_bytes() == payload
+                    assert fl.injected_total() > 0, "fault class never fired"
+                finally:
+                    faultline.disable()
+                    await e1.stop()
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# rpc (control-plane) faults over the real wire transport
+
+
+class TestRpcFaults:
+    def test_cluster_survives_rpc_frame_faults(self, run, tmp_path, payload):
+        """Scheduler served over the real msgpack transport; frame reads
+        suffer injected drops + latency. Client-side backoff/retry (and the
+        breaker's half-open probe if it ever trips) must keep both the
+        back-to-source and the p2p download alive."""
+        from dragonfly2_tpu.rpc.scheduler import RemoteSchedulerClient, serve_scheduler
+
+        async def body():
+            svc = SchedulerService()
+            server = serve_scheduler(svc)
+            await server.start()
+            clients = []
+
+            def wire_client():
+                c = RemoteSchedulerClient(
+                    f"127.0.0.1:{server.port}",
+                    timeout=5.0,
+                    retries=5,
+                    retry_backoff=0.02,
+                )
+                clients.append(c)
+                return c
+
+            async with Origin({"f.bin": payload}) as origin:
+                e1 = make_engine(tmp_path, wire_client(), "peer1")
+                e2 = make_engine(tmp_path, wire_client(), "peer2")
+                await e1.start()
+                await e2.start()
+                try:
+                    fl = faultline.enable(
+                        "rpc.read:drop:0.08,rpc.read:latency:0.2:0.01,seed=41"
+                    )
+                    url = origin.url("f.bin")
+                    await asyncio.wait_for(e1.download_task(url), 60)
+                    out = tmp_path / "rpc.bin"
+                    await asyncio.wait_for(e2.download_task(url, output=out), 60)
+                    faultline.disable()
+                    assert out.read_bytes() == payload
+                    assert fl.injected_total("rpc.read") > 0
+                finally:
+                    faultline.disable()
+                    await e1.stop()
+                    await e2.stop()
+                    for c in clients:
+                        await c.close()
+                    await server.stop()
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# named degradation paths
+
+
+class TestDegradationPaths:
+    def test_parent_death_mid_transfer_reschedules(self, run, tmp_path, payload):
+        """Parent dies mid-transfer (upload server gone + host left): the
+        child must reschedule and finish bit-exact via cutover."""
+
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            async with Origin({"f.bin": payload}) as origin:
+                url = origin.url("f.bin")
+                parent = make_engine(tmp_path, client, "parent1")
+                await parent.start()
+                # throttle the child so the parent death lands mid-task
+                child = make_engine(
+                    tmp_path, client, "child1", total_download_rate_bps=8e6
+                )
+                await child.start()
+                try:
+                    await parent.download_task(url)
+                    task = asyncio.ensure_future(
+                        child.download_task(url, output=tmp_path / "pd.bin")
+                    )
+                    deadline = time.monotonic() + 15
+                    while time.monotonic() < deadline:
+                        cts = child.storage.get(child.make_meta(url).task_id)
+                        if cts is not None and 0 < cts.finished_count() < 3:
+                            break
+                        await asyncio.sleep(0.02)
+                    else:
+                        pytest.fail("child never reached a partial state")
+                    await parent.upload.stop()
+                    svc.leave_host(parent.host_id)
+                    ts = await asyncio.wait_for(task, 60)
+                    assert ts.is_complete()
+                    assert (tmp_path / "pd.bin").read_bytes() == payload
+                    assert origin.bytes_sent > len(payload)  # finish came from origin
+                finally:
+                    await parent.stop()
+                    await child.stop()
+
+        run(body())
+
+    def test_retry_budget_exhaustion_cuts_over_to_source(self, run, tmp_path, payload):
+        """Satellite: a parent that fails EVERY piece fetch exhausts the
+        child's retry/reschedule budget; the remaining pieces must arrive
+        from origin with bytes_from_parents / bytes_from_source and the
+        piece-source metrics all consistent."""
+        from dragonfly2_tpu.daemon.conductor import PeerTaskConductor
+        from dragonfly2_tpu.daemon.source import SourceRegistry
+        from dragonfly2_tpu.daemon.storage import StorageManager
+
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            async with Origin({"f.bin": payload}) as origin:
+                url = origin.url("f.bin")
+                e1 = await _seed_parent(tmp_path, client, origin, payload)
+                origin_bytes_before_child = origin.bytes_sent
+                parent_count0, source_count0 = _piece_counts()
+                bytes0 = metrics.DOWNLOAD_BYTES.value
+
+                meta = e1.make_meta(url)
+                # a DIFFERENT host than the parent: the scheduler's
+                # different_host filter would otherwise never offer e1 at all
+                # and the test would skip the retry budget entirely
+                host = HostInfo(id="chaos-child-host", ip="127.0.0.1", hostname="chaos-child")
+                conductor = PeerTaskConductor(
+                    peer_id="chaos-child-peer",
+                    meta=meta,
+                    host=host,
+                    scheduler=client,
+                    storage=StorageManager(tmp_path / "child-direct"),
+                    sources=SourceRegistry(),
+                    config=fast_conductor(),
+                )
+                try:
+                    fl = faultline.enable("parent.fetch:error:1.0,seed=51")
+                    ts = await asyncio.wait_for(conductor.run(), 60)
+                    faultline.disable()
+                    assert ts.is_complete()
+                    assert fl.injected[("parent.fetch", "error")] >= 1
+                    # every byte came from origin; accounting adds up exactly
+                    assert conductor.bytes_from_parents == 0
+                    assert conductor.bytes_from_source == len(payload)
+                    assert origin.bytes_sent - origin_bytes_before_child == len(payload)
+                    parent_count1, source_count1 = _piece_counts()
+                    assert parent_count1 == parent_count0  # no parent piece landed
+                    assert source_count1 - source_count0 == ts.meta.total_pieces
+                    assert metrics.DOWNLOAD_BYTES.value - bytes0 == len(payload)
+                    data = await ts.read_range(Range(0, ts.meta.content_length))
+                    assert data == payload
+                finally:
+                    faultline.disable()
+                    await e1.stop()
+
+        run(body())
+
+    def test_partial_parent_service_splits_accounting(self, run, tmp_path, payload):
+        """Seeded partial failure (error rate 0.55): whatever the parent does
+        deliver counts as parent bytes, the rest as source bytes, and the two
+        sum exactly to the content length (piece-count metrics agree)."""
+        from dragonfly2_tpu.daemon.conductor import PeerTaskConductor
+        from dragonfly2_tpu.daemon.source import SourceRegistry
+        from dragonfly2_tpu.daemon.storage import StorageManager
+
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            async with Origin({"f.bin": payload}) as origin:
+                url = origin.url("f.bin")
+                e1 = await _seed_parent(tmp_path, client, origin, payload)
+                parent_count0, source_count0 = _piece_counts()
+
+                conductor = PeerTaskConductor(
+                    peer_id="chaos-split-peer",
+                    meta=e1.make_meta(url),
+                    host=HostInfo(id="chaos-split-host", ip="127.0.0.1", hostname="chaos-split"),
+                    scheduler=client,
+                    storage=StorageManager(tmp_path / "child-split"),
+                    sources=SourceRegistry(),
+                    config=fast_conductor(),
+                )
+                try:
+                    faultline.enable("parent.fetch:error:0.55,seed=52")
+                    ts = await asyncio.wait_for(conductor.run(), 60)
+                    faultline.disable()
+                    assert ts.is_complete()
+                    total = conductor.bytes_from_parents + conductor.bytes_from_source
+                    assert total == len(payload)
+                    parent_count1, source_count1 = _piece_counts()
+                    landed = (parent_count1 - parent_count0) + (source_count1 - source_count0)
+                    assert landed == ts.meta.total_pieces
+                finally:
+                    faultline.disable()
+                    await e1.stop()
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# disabled == free
+
+
+class TestDisabledOverhead:
+    def test_disabled_faultline_is_structurally_free(self, run, tmp_path, payload):
+        """With faultline disabled the hot paths' guard is a single
+        module-global identity check and mutate() is never reachable: a full
+        p2p download must record ZERO injections and ACTIVE must stay None."""
+
+        async def body():
+            assert faultline.ACTIVE is None
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            async with Origin({"f.bin": payload}) as origin:
+                e1 = await _seed_parent(tmp_path, client, origin, payload)
+                e2 = make_engine(tmp_path, client, "child1")
+                await e2.start()
+                try:
+                    out = tmp_path / "off.bin"
+                    await e2.download_task(origin.url("f.bin"), output=out)
+                    assert out.read_bytes() == payload
+                    assert faultline.ACTIVE is None
+                finally:
+                    await e1.stop()
+                    await e2.stop()
+
+        run(body())
+
+    def test_disabled_guard_microcost(self):
+        """The disabled-path guard (`faultline.ACTIVE is not None`) must cost
+        nanoseconds. A very generous wall-clock ceiling (10M checks in < 2 s
+        ≈ 200 ns/check) guards against someone replacing the module-global
+        check with a lookup/call chain; the piece fetch path runs this guard
+        twice per piece, so even the ceiling is invisible next to a 4 MiB
+        HTTP fetch."""
+        assert faultline.ACTIVE is None
+        t0 = time.perf_counter()
+        hits = 0
+        for _ in range(10_000_000):
+            if faultline.ACTIVE is not None:  # the exact hot-path guard shape
+                hits += 1
+        elapsed = time.perf_counter() - t0
+        assert hits == 0
+        assert elapsed < 2.0, f"disabled guard cost {elapsed:.3f}s / 10M checks"
+
+    def test_mutate_passthrough_does_not_copy(self):
+        fl = faultline.Faultline([], seed=0)
+        data = b"q" * (1 << 20)
+        assert fl.mutate("parent.piece_body", data) is data

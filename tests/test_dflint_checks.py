@@ -422,6 +422,91 @@ def test_df023_asyncio_lock_variant():
 
 
 # ---------------------------------------------------------------------------
+# DF024 raw retry sleep
+
+
+def test_df024_fires_on_sleep_in_except_in_loop():
+    src = """
+    import asyncio
+
+    async def pull():
+        while True:
+            try:
+                await fetch()
+            except Exception:
+                await asyncio.sleep(5.0)
+                continue
+    """
+    vs = dflint.lint_source(textwrap.dedent(src), "dragonfly2_tpu/daemon/mod.py")
+    assert [v.check for v in vs] == ["DF024"]
+    assert vs[0].line == 9
+
+
+def test_df024_fires_on_attempt_derived_delay():
+    src = """
+    import asyncio
+
+    async def call(retries, base):
+        for attempt in range(retries):
+            ok = await try_once()
+            if not ok:
+                await asyncio.sleep(base * (attempt + 1))
+    """
+    assert ids(src) == ["DF024"]
+
+
+def test_df024_sees_from_import_alias():
+    src = """
+    from asyncio import sleep as snooze
+
+    async def f():
+        for attempt in range(3):
+            try:
+                await go()
+            except OSError:
+                await snooze(0.5)
+    """
+    assert ids(src) == ["DF024"]
+
+
+def test_df024_silent_on_unconditional_poll_pacing():
+    # a poll loop's schedule sleep is pacing, not a retry ladder
+    src = """
+    import asyncio
+
+    async def poll(interval):
+        while True:
+            await refresh()
+            await asyncio.sleep(interval)
+    """
+    assert ids(src) == []
+
+
+def test_df024_silent_inside_resilience_package():
+    src = """
+    import asyncio
+
+    async def sleep_for(attempt, base):
+        for attempt in range(3):
+            await asyncio.sleep(base * attempt)
+    """
+    assert ids(src, path="dragonfly2_tpu/resilience/backoff.py") == []
+
+
+def test_df024_silent_on_policy_sleep():
+    # the shared-policy call is exactly what the check pushes people toward
+    src = """
+    async def call(policy, retries):
+        for attempt in range(retries):
+            try:
+                return await once()
+            except OSError:
+                await policy.sleep(attempt)
+    """
+    assert ids(src) == []
+
+
+# ---------------------------------------------------------------------------
 # DF031 silent swallow
 
 

@@ -1,0 +1,425 @@
+"""Unit tests for the resilience primitives: BackoffPolicy determinism and
+bounds, CircuitBreaker state machine, deadline propagation (incl. across
+task creation — the engine → conductor path), and faultline spec parsing /
+injection semantics / the disabled fast path."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import time
+
+import pytest
+
+from dragonfly2_tpu.resilience import deadline as dl
+from dragonfly2_tpu.resilience import faultline
+from dragonfly2_tpu.resilience.backoff import BackoffPolicy
+from dragonfly2_tpu.resilience.breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+
+
+@pytest.fixture(autouse=True)
+def _faultline_off():
+    yield
+    faultline.disable()
+
+
+# ---------------------------------------------------------------------------
+# BackoffPolicy
+
+
+class TestBackoff:
+    def test_exponential_ladder_without_jitter(self):
+        p = BackoffPolicy(base=0.1, multiplier=2.0, max_delay=0.5, jitter=0.0)
+        assert [p.delay(a) for a in range(4)] == [0.1, 0.2, 0.4, 0.5]
+
+    def test_jitter_only_shortens_and_is_seeded(self):
+        p1 = BackoffPolicy(base=0.1, multiplier=2.0, max_delay=5.0, jitter=0.5, seed=42)
+        p2 = BackoffPolicy(base=0.1, multiplier=2.0, max_delay=5.0, jitter=0.5, seed=42)
+        seq1 = [p1.delay(a) for a in range(8)]
+        seq2 = [p2.delay(a) for a in range(8)]
+        assert seq1 == seq2  # same seed, same schedule
+        for a, d in enumerate(seq1):
+            ceiling = min(5.0, 0.1 * 2.0 ** a)
+            assert ceiling * 0.5 <= d <= ceiling  # jitter in [0.5x, 1x]
+
+    def test_negative_attempt_clamps_to_base(self):
+        p = BackoffPolicy(base=0.1, multiplier=2.0, jitter=0.0)
+        assert p.delay(-3) == pytest.approx(0.1)
+
+    def test_bad_config_rejected(self):
+        with pytest.raises(ValueError):
+            BackoffPolicy(multiplier=0.5)
+        with pytest.raises(ValueError):
+            BackoffPolicy(jitter=1.5)
+
+    def test_sleep_returns_delay(self, run):
+        async def body():
+            p = BackoffPolicy(base=0.01, multiplier=1.0, jitter=0.0)
+            t0 = time.monotonic()
+            d = await p.sleep(0)
+            assert d == pytest.approx(0.01)
+            assert time.monotonic() - t0 >= 0.009
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestBreaker:
+    def test_opens_after_threshold_consecutive_failures(self):
+        clock = FakeClock()
+        b = CircuitBreaker(failure_threshold=3, reset_timeout=10.0, clock=clock)
+        for _ in range(2):
+            b.record_failure()
+        assert b.state == CLOSED and b.allow()
+        b.record_failure()
+        assert b.state == OPEN
+        assert not b.allow()
+        assert b.is_open
+
+    def test_success_resets_the_failure_count(self):
+        b = CircuitBreaker(failure_threshold=2)
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        assert b.state == CLOSED  # never two consecutive
+
+    def test_half_open_single_probe_then_close(self):
+        clock = FakeClock()
+        b = CircuitBreaker(failure_threshold=1, reset_timeout=5.0, clock=clock)
+        b.record_failure()
+        assert not b.allow()
+        clock.t = 5.0
+        assert not b.is_open  # cooldown lapsed: routable again
+        assert b.allow()  # the probe
+        assert b.state == HALF_OPEN
+        assert not b.allow()  # second caller refused while probe in flight
+        b.record_success()
+        assert b.state == CLOSED and b.allow()
+
+    def test_abandoned_probe_slot_self_heals(self):
+        """A probe whose caller vanished without reporting (cancelled rpc)
+        must not wedge the breaker in half-open forever: the slot re-arms
+        after reset_timeout."""
+        clock = FakeClock()
+        b = CircuitBreaker(failure_threshold=1, reset_timeout=5.0, clock=clock)
+        b.record_failure()
+        clock.t = 5.0
+        assert b.allow()  # probe taken... and its caller is cancelled
+        assert not b.allow()
+        clock.t = 10.0  # a probe-slot lifetime later
+        assert b.allow()  # fresh probe admitted
+        b.record_success()
+        assert b.state == CLOSED
+
+    def test_half_open_failed_probe_reopens(self):
+        clock = FakeClock()
+        b = CircuitBreaker(failure_threshold=1, reset_timeout=5.0, clock=clock)
+        b.record_failure()
+        clock.t = 5.0
+        assert b.allow()
+        b.record_failure()
+        assert b.state == OPEN
+        assert not b.allow()  # a fresh cooldown started
+        clock.t = 9.9
+        assert not b.allow()
+        clock.t = 10.0
+        assert b.allow()
+
+
+# ---------------------------------------------------------------------------
+# deadline
+
+
+class TestDeadline:
+    def test_no_scope_means_no_budget(self):
+        assert dl.current() is None
+        assert dl.remaining() is None
+        assert dl.timeout(30.0) == 30.0
+        assert dl.timeout(None) is None
+
+    def test_scope_caps_per_op_timeouts(self, run):
+        async def body():
+            with dl.scope(10.0):
+                assert 9.0 < dl.remaining() <= 10.0
+                assert dl.timeout(30.0) <= 10.0  # capped by the budget
+                assert dl.timeout(0.5) == 0.5  # small per-op unchanged
+            assert dl.remaining() is None  # scope exited
+
+        run(body())
+
+    def test_nested_scope_only_shrinks(self, run):
+        async def body():
+            with dl.scope(10.0):
+                with dl.scope(60.0):  # wider request cannot extend the budget
+                    assert dl.remaining() <= 10.0
+                with dl.scope(1.0):
+                    assert dl.remaining() <= 1.0
+                assert dl.remaining() > 5.0  # inner scopes restored
+
+        run(body())
+
+    def test_none_scope_is_passthrough(self, run):
+        async def body():
+            with dl.scope(None) as budget:
+                assert budget is None
+            with dl.scope(5.0):
+                with dl.scope(None) as inherited:
+                    assert inherited is not None and inherited.remaining() <= 5.0
+
+        run(body())
+
+    def test_budget_propagates_into_created_tasks(self, run):
+        """The engine → conductor shape: a task created inside a scope sees
+        the budget even though the scope exits before the task finishes."""
+
+        async def child():
+            await asyncio.sleep(0.01)
+            return dl.remaining()
+
+        async def body():
+            with dl.scope(5.0):
+                t = asyncio.ensure_future(child())
+            rem = await t
+            assert rem is not None and 0 < rem <= 5.0
+            assert dl.remaining() is None  # parent scope exited for us
+
+        run(body())
+
+    def test_expiry(self, run):
+        async def body():
+            with dl.scope(0.01) as budget:
+                await asyncio.sleep(0.02)
+                assert budget.expired
+                assert budget.remaining() == 0.0
+                assert dl.timeout(30.0) == 0.0
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# faultline
+
+
+class TestFaultline:
+    def test_spec_roundtrip(self):
+        fl = faultline.parse_spec(
+            "parent.fetch:error:0.25,source.read:latency:1.0:0.02,rpc.read:drop:0.1,seed=99"
+        )
+        assert fl.seed == 99
+        assert [r.kind for r in fl.rules] == ["error", "latency", "drop"]
+        assert fl.rules[1].param == pytest.approx(0.02)
+
+    def test_bad_specs_fail_loudly(self):
+        with pytest.raises(ValueError):
+            faultline.parse_spec("parent.fetch:error")  # missing rate
+        with pytest.raises(ValueError):
+            faultline.parse_spec("parent.fetch:frobnicate:0.5")  # unknown kind
+        with pytest.raises(ValueError):
+            faultline.parse_spec("parent.fetch:error:1.5")  # rate out of range
+
+    def test_error_and_drop_raise_right_types(self, run):
+        async def body():
+            fl = faultline.parse_spec("p.err:error:1.0,p.drop:drop:1.0")
+            with pytest.raises(faultline.FaultError):
+                await fl.fire("p.err")
+            with pytest.raises(ConnectionResetError):
+                await fl.fire("p.drop")
+            await fl.fire("p.unknown")  # unregistered point: no-op
+            assert fl.injected_total() == 2
+            assert fl.injected[("p.err", "error")] == 1
+
+        run(body())
+
+    def test_rate_respects_seed_determinism(self):
+        a = faultline.Faultline([faultline.FaultRule("p", "error", 0.5)], seed=7)
+        b = faultline.Faultline([faultline.FaultRule("p", "error", 0.5)], seed=7)
+        seq_a = [a._rng.random() for _ in range(16)]
+        seq_b = [b._rng.random() for _ in range(16)]
+        assert seq_a == seq_b
+
+    def test_corrupt_flips_exactly_one_bit(self):
+        fl = faultline.Faultline([faultline.FaultRule("p", "corrupt", 1.0)], seed=1)
+        data = bytes(range(256))
+        out = fl.mutate("p", data)
+        assert len(out) == len(data)
+        diff = [(x, y) for x, y in zip(data, out) if x != y]
+        assert len(diff) == 1
+        x, y = diff[0]
+        assert bin(x ^ y).count("1") == 1
+
+    def test_truncate_shortens(self):
+        fl = faultline.Faultline([faultline.FaultRule("p", "truncate", 1.0, 10)], seed=1)
+        data = b"x" * 100
+        assert fl.mutate("p", data) == b"x" * 90
+        # param 0 → drop half
+        fl2 = faultline.Faultline([faultline.FaultRule("p", "truncate", 1.0)], seed=1)
+        assert len(fl2.mutate("p", data)) == 50
+
+    def test_mutate_without_rule_returns_same_object(self):
+        fl = faultline.Faultline([faultline.FaultRule("other", "corrupt", 1.0)], seed=1)
+        data = b"payload"
+        assert fl.mutate("p", data) is data  # no copy on the pass-through path
+
+    def test_sync_check_raises_for_error_kind(self):
+        fl = faultline.Faultline([faultline.FaultRule("w", "error", 1.0)], seed=1)
+        with pytest.raises(faultline.FaultError):
+            fl.check("w")
+
+    def test_enable_disable_module_global(self):
+        assert faultline.ACTIVE is None
+        fl = faultline.enable("p:error:1.0,seed=3")
+        assert faultline.ACTIVE is fl
+        faultline.disable()
+        assert faultline.ACTIVE is None
+
+    def test_install_from_env(self, monkeypatch):
+        monkeypatch.setenv("DF_FAULTS", "rpc.read:latency:0.5:0.01,seed=11")
+        fl = faultline.install_from_env()
+        assert fl is not None and fl.seed == 11 and faultline.ACTIVE is fl
+        faultline.disable()
+        monkeypatch.delenv("DF_FAULTS")
+        assert faultline.install_from_env() is None
+        assert faultline.ACTIVE is None
+
+    def test_latency_rule_sleeps(self, run):
+        async def body():
+            fl = faultline.Faultline(
+                [faultline.FaultRule("p", "latency", 1.0, 0.02)], seed=1
+            )
+            t0 = time.monotonic()
+            await fl.fire("p")
+            assert time.monotonic() - t0 >= 0.015
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# RpcClient integration: breaker + backoff + deadline
+
+
+class TestRpcResilience:
+    def test_circuit_opens_on_dead_target_and_fast_fails(self, run):
+        from dragonfly2_tpu.rpc.core import RpcClient, RpcError
+
+        async def body():
+            client = RpcClient(
+                "127.0.0.1:1",  # nothing listens here
+                timeout=0.5,
+                retries=1,
+                retry_backoff=0.01,
+            )
+            client.breaker.failure_threshold = 2
+            client.breaker.reset_timeout = 30.0
+            with pytest.raises((RpcError, OSError)):
+                await client.call("_ping")
+            # breaker open (2 attempts = 2 connect failures): next call is a
+            # LOCAL refusal, not a connect timeout
+            assert client.breaker.state == "open"
+            t0 = time.monotonic()
+            with pytest.raises(RpcError) as ei:
+                await client.call("_ping")
+            assert "circuit open" in str(ei.value)
+            assert time.monotonic() - t0 < 0.2
+            await client.close()
+
+        run(body())
+
+    def test_deadline_caps_rpc_timeout(self, run):
+        from dragonfly2_tpu.rpc.core import RpcClient, RpcError, RpcServer
+
+        async def body():
+            server = RpcServer()
+
+            async def stall(payload):
+                await asyncio.sleep(5.0)
+
+            server.register("stall", stall)
+            await server.start()
+            client = RpcClient(f"127.0.0.1:{server.port}", retries=0)
+            try:
+                with dl.scope(0.3):
+                    t0 = time.monotonic()
+                    with pytest.raises(RpcError) as ei:
+                        await client.call("stall")  # per-op default is 30 s
+                    assert ei.value.code == "deadline_exceeded"
+                    assert time.monotonic() - t0 < 2.0  # budget, not 30 s
+            finally:
+                await client.close()
+                await server.stop()
+
+        run(body())
+
+    def test_exhausted_deadline_fails_before_wire(self, run):
+        from dragonfly2_tpu.rpc.core import RpcClient, RpcError
+
+        async def body():
+            client = RpcClient("127.0.0.1:1")
+            with dl.scope(0.001):
+                await asyncio.sleep(0.01)
+                with pytest.raises(RpcError) as ei:
+                    await client.call("_ping")
+                assert ei.value.code == "deadline_exceeded"
+            # and the budget failure did NOT count against the target
+            assert client.breaker.failures == 0
+            await client.close()
+
+        run(body())
+
+    def test_close_fails_pending_immediately(self, run):
+        from dragonfly2_tpu.rpc.core import ConnectionClosed, RpcClient, RpcServer
+
+        async def body():
+            server = RpcServer()
+
+            async def stall(payload):
+                await asyncio.sleep(30.0)
+
+            server.register("stall", stall)
+            await server.start()
+            client = RpcClient(f"127.0.0.1:{server.port}", retries=0, timeout=30.0)
+            call = asyncio.ensure_future(client.call("stall"))
+            await asyncio.sleep(0.1)  # request on the wire, future pending
+            t0 = time.monotonic()
+            await client.close()
+            with pytest.raises(ConnectionClosed):
+                await call
+            # failed NOW, not after the 30 s timeout
+            assert time.monotonic() - t0 < 1.0
+            await server.stop()
+
+        run(body())
+
+
+def test_rpc_write_and_read_faults_are_injected(run):
+    """rpc.read / rpc.write points live in the frame codec itself."""
+    from dragonfly2_tpu.rpc.core import RpcClient, RpcServer
+
+    async def body():
+        server = RpcServer()
+        await server.start()
+        # drops hit BOTH sides' frame reads (~28% per attempt at rate 0.15);
+        # 6 attempts per call make survival overwhelmingly likely, and the
+        # seeded rng makes this exact run reproducible
+        client = RpcClient(f"127.0.0.1:{server.port}", retries=5, retry_backoff=0.01)
+        try:
+            fl = faultline.enable("rpc.read:drop:0.15,seed=5")
+            for _ in range(10):
+                assert await client.call("_ping") == "pong"  # retries absorb drops
+            assert fl.injected_total("rpc.read") > 0
+        finally:
+            faultline.disable()
+            await client.close()
+            await server.stop()
+
+    run(body())

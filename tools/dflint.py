@@ -29,6 +29,10 @@ Checks (see README.md "Static analysis" for the catalog):
   DF023  inconsistent lock discipline: a `self._*` attribute mutated under
          `with <lock>:` in one place and without it in another (the classic
          data race the Go race detector catches)
+  DF024  hand-rolled retry pacing: await asyncio.sleep() inside an except
+         handler in a loop, or with a delay computed from the loop's attempt
+         variable — outside dragonfly2_tpu/resilience/, retries must use the
+         shared BackoffPolicy (exponential + seeded jitter) instead
   DF031  silent exception swallow: bare/overbroad except whose body is only
          pass/continue/... (no log, no narrowing)
   DF032  mutable default argument (list/dict/set literal or constructor)
@@ -63,6 +67,7 @@ CHECKS: dict[str, str] = {
     "DF021": "asyncio primitive created at import/class-body scope",
     "DF022": "time.sleep inside async def (blocks the event loop)",
     "DF023": "lock-guarded attribute also mutated outside the lock",
+    "DF024": "raw asyncio.sleep retry loop outside the resilience module",
     "DF031": "bare/overbroad except silently swallowing the error",
     "DF032": "mutable default argument",
 }
@@ -610,6 +615,76 @@ def check_lock_discipline(tree: ast.Module, path: str) -> Iterator[Violation]:
                 )
 
 
+def check_raw_retry_sleep(tree: ast.Module, path: str) -> Iterator[Violation]:
+    """DF024: hand-rolled retry pacing outside dragonfly2_tpu/resilience/.
+
+    Two shapes mark a raw retry ladder:
+      1. `await asyncio.sleep(...)` lexically inside an `except` handler that
+         sits inside a for/while loop (sleep-on-failure-then-retry), and
+      2. `await asyncio.sleep(expr)` where expr references the enclosing
+         for-loop's induction variable (a linear/exponential backoff formula,
+         e.g. `base * (attempt + 1)`).
+    Unconditional pacing sleeps in poll loops (sleep(interval) in the loop
+    body proper) are NOT flagged — those are schedules, not retries. The
+    resilience package itself is exempt: BackoffPolicy.sleep is the one
+    place allowed to spell this."""
+    if "resilience" in Path(path).parts:
+        return
+    aliases = import_aliases(tree)
+
+    def is_asyncio_sleep(node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Await)
+            and isinstance(node.value, ast.Call)
+            and _resolved_call_name(node.value, aliases) == "asyncio.sleep"
+        )
+
+    def names_in(expr: ast.AST) -> set[str]:
+        return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+    seen: set[tuple[int, int]] = set()  # nested loops share bodies
+
+    def emit(node: ast.Await, why: str) -> Iterator[Violation]:
+        key = (node.lineno, node.col_offset)
+        if key in seen:
+            return
+        seen.add(key)
+        yield Violation(
+            path, node.lineno, node.col_offset, "DF024",
+            f"{why} — use resilience.BackoffPolicy (exponential + seeded "
+            "jitter) instead of a hand-rolled retry sleep",
+        )
+
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        induction: set[str] = set()
+        if isinstance(loop, (ast.For, ast.AsyncFor)):
+            induction = names_in(loop.target)
+        for stmt in loop.body + loop.orelse:
+            for node in walk_pruned(stmt):
+                # shape 2: sleep delay computed from the attempt variable
+                if (
+                    induction
+                    and is_asyncio_sleep(node)
+                    and node.value.args
+                    and induction & names_in(node.value.args[0])
+                ):
+                    yield from emit(
+                        node, "asyncio.sleep() delay derived from the retry attempt variable"
+                    )
+                # shape 1: sleep inside an except handler inside the loop
+                if isinstance(node, (ast.Try,)):
+                    for handler in node.handlers:
+                        for h_stmt in handler.body:
+                            for inner in walk_pruned(h_stmt):
+                                if is_asyncio_sleep(inner):
+                                    yield from emit(
+                                        inner,
+                                        "asyncio.sleep() inside an except handler in a retry loop",
+                                    )
+
+
 _BROAD = {"Exception", "BaseException"}
 
 
@@ -689,6 +764,7 @@ ALL_CHECKS = (
     check_asyncio_primitive_scope,
     check_sleep_in_async,
     check_lock_discipline,
+    check_raw_retry_sleep,
     check_silent_swallow,
     check_mutable_defaults,
 )
