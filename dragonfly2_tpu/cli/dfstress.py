@@ -205,17 +205,25 @@ async def run_scoring_stress(args: argparse.Namespace) -> dict:
             ev._prepare(children[0], cand)
         prepare_us = (time.monotonic() - t0) / probe_n * 1e6
         feats, cc, pp, _known = ev._prepare(children[0], cand)
-        M = 8
-        mf = np.tile(feats, (M, 1, 1))
-        mc = np.tile(cc, (M, 1))
-        mp = np.tile(pp, (M, 1))
-        for _ in range(5):
-            scorer.score_rounds(mf, child=mc, parent=mp)
-        t0 = time.monotonic()
-        for _ in range(probe_n // M):
-            scorer.score_rounds(mf, child=mc, parent=mp)
-        ffi_us = (time.monotonic() - t0) / probe_n * 1e6
-        ceiling_rps = 1e6 / (prepare_us + ffi_us)
+        if cc is None:
+            # hosts unknown to the serving graph: the per-stage ceiling
+            # cannot be probed — degrade the report to null ceiling fields
+            # instead of crashing after the measurements completed
+            # (ADVICE r05 #2)
+            ffi_us = None
+            ceiling_rps = None
+        else:
+            M = 8
+            mf = np.tile(feats, (M, 1, 1))
+            mc = np.tile(cc, (M, 1))
+            mp = np.tile(pp, (M, 1))
+            for _ in range(5):
+                scorer.score_rounds(mf, child=mc, parent=mp)
+            t0 = time.monotonic()
+            for _ in range(probe_n // M):
+                scorer.score_rounds(mf, child=mc, parent=mp)
+            ffi_us = (time.monotonic() - t0) / probe_n * 1e6
+            ceiling_rps = 1e6 / (prepare_us + ffi_us)
         scorer.close()
 
     def pct(lat: np.ndarray, q: float) -> float:
@@ -237,9 +245,11 @@ async def run_scoring_stress(args: argparse.Namespace) -> dict:
             "native_flushes": eval_flushes,
             "native_rounds": eval_rounds,
             "prepare_us_per_round": round(prepare_us, 1),
-            "ffi_us_per_round_amortized": round(ffi_us, 1),
-            "single_core_ceiling_rps": round(ceiling_rps, 1),
-            "ceiling_fraction_achieved": round(eval_rps / ceiling_rps, 3),
+            "ffi_us_per_round_amortized": round(ffi_us, 1) if ffi_us is not None else None,
+            "single_core_ceiling_rps": round(ceiling_rps, 1) if ceiling_rps else None,
+            "ceiling_fraction_achieved": (
+                round(eval_rps / ceiling_rps, 3) if ceiling_rps else None
+            ),
             "host_cpu_count": os.cpu_count(),
         },
     }

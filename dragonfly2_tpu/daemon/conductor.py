@@ -30,6 +30,7 @@ from typing import Protocol
 
 import aiohttp
 
+from dragonfly2_tpu.daemon.rawrange import AddressFamilyError
 from dragonfly2_tpu.daemon.source import SourceError, SourceRegistry
 from dragonfly2_tpu.daemon.storage import StorageManager, TaskStorage
 from dragonfly2_tpu.resilience import deadline as dl
@@ -43,6 +44,13 @@ from dragonfly2_tpu.utils.pieces import Range, compute_piece_size, piece_count, 
 from dragonfly2_tpu.utils.ratelimit import TokenBucket
 
 logger = logging.getLogger(__name__)
+
+
+def _url_host(ip: str) -> str:
+    """IPv6 literals must be bracketed in URLs (yarl rejects bare colons —
+    an unbracketed v6 parent URL would fail as InvalidURL and charge the
+    parent, defeating the raw-client's aiohttp fallback entirely)."""
+    return f"[{ip}]" if ":" in ip else ip
 
 
 class SchedulerClient(Protocol):
@@ -154,6 +162,15 @@ class ConductorConfig:
     # Ranged back-to-source: per-piece fetch retries before the whole task
     # fails (origin blips must not kill a 95%-done download).
     source_piece_retries: int = 3
+    # Hand filled piece buffers to writer tasks WITHOUT awaiting them, so one
+    # worker pipelines recv of piece N+1 into the store write of piece N.
+    # Default OFF: on the 2-core CI image the piece-worker pool already
+    # overlaps recv/hash/write across workers on both cores, and the extra
+    # in-flight write tasks measured ~10% SLOWER (343 vs 311 MB/s in the
+    # 4-worker pipeline A/B); on hosts with cores to spare the deferral buys
+    # single-worker pipelining. Backpressure either way: the buffer pool's
+    # bounded leases park recv when writers fall behind.
+    defer_piece_writes: bool = False
 
 
 class PeerTaskConductor:
@@ -171,6 +188,7 @@ class PeerTaskConductor:
         headers: dict[str, str] | None = None,
         shaper=None,
         raw_client=None,
+        pipeline=None,
     ):
         from dragonfly2_tpu.utils.dflog import with_context
 
@@ -200,6 +218,14 @@ class PeerTaskConductor:
         # parents survive across this host's tasks); else lazily owned
         self._raw_client = raw_client
         self._owns_raw = raw_client is None
+        # engine-shared PiecePipeline (pooled buffers + hash threads reused
+        # across every transfer on the host); else lazily owned
+        self._pipeline_obj = pipeline
+        self._owns_pipeline = pipeline is None
+        # deferred store writes: a piece worker hands its filled buffer to a
+        # writer task and immediately recycles a fresh buffer into recv; the
+        # dispatch loop drains these at round end (see _spawn_piece_write)
+        self._pending_writes: set[asyncio.Task] = set()
         self.ts: TaskStorage | None = None
         self.bytes_from_parents = 0
         self.bytes_from_source = 0
@@ -252,6 +278,8 @@ class PeerTaskConductor:
                 await self._session.close()
             if self._owns_raw and self._raw_client is not None:
                 await self._raw_client.close()
+            if self._owns_pipeline and self._pipeline_obj is not None:
+                self._pipeline_obj.close()
 
     async def _run_inner(self) -> TaskStorage:
         reg = await self.scheduler.register_peer(self.peer_id, self.meta, self.host)
@@ -383,19 +411,40 @@ class PeerTaskConductor:
                 return  # idempotent under retry: the piece already landed
             r = piece_range(idx, m.piece_size, m.content_length)
             t0 = time.monotonic()
-            buf = bytearray()
-            async for chunk in self.sources.download(self.meta.url, r, self.headers):
-                buf.extend(chunk)
-                await self.bucket.acquire(len(chunk))
-            if len(buf) != r.length:
-                raise IOError(f"source piece {idx}: got {len(buf)}, want {r.length}")
-            await self.ts.write_piece(idx, bytes(buf))
-            self.bytes_from_source += len(buf)
+            # pooled buffer + hash-on-receive: chunks land straight in a
+            # reused buffer (no bytearray growth reallocs, no final bytes()
+            # copy) and the piece digest is computed as they arrive instead
+            # of in write_piece's second pass
+            pipeline = self._pipeline()
+            pooled = await pipeline.pool.acquire(r.length)
+            try:
+                pump = pipeline.hash_pump(pooled.view)
+                try:
+                    off = 0
+                    async for chunk in self.sources.download(self.meta.url, r, self.headers):
+                        if off + len(chunk) > r.length:
+                            raise IOError(
+                                f"source piece {idx}: got more than {r.length} bytes"
+                            )
+                        pooled.view[off : off + len(chunk)] = chunk
+                        off += len(chunk)
+                        pump.feed(off)
+                        await self.bucket.acquire(len(chunk))
+                    if off != r.length:
+                        raise IOError(f"source piece {idx}: got {off}, want {r.length}")
+                    d = await pump.finish()
+                except BaseException:
+                    pump.abort()
+                    raise
+                await self.ts.write_piece_view(idx, pooled.view, digest=d)
+            finally:
+                pooled.release()
+            self.bytes_from_source += r.length
             # same accounting as the sequential path (_write_source_piece):
             # cutover dashboards need parent vs back_to_source piece counts
             # to sum to the task's total
             metrics.PIECE_DOWNLOAD_TOTAL.inc(source="back_to_source")
-            metrics.DOWNLOAD_BYTES.inc(len(buf))
+            metrics.DOWNLOAD_BYTES.inc(r.length)
             try:
                 await self.scheduler.report_piece_result(
                     self.peer_id, idx, success=True, cost_ms=(time.monotonic() - t0) * 1000
@@ -558,8 +607,13 @@ class PeerTaskConductor:
                 for w in workers:
                     w.cancel()
                 await asyncio.gather(*workers, return_exceptions=True)
+                # writes the workers deferred must land before the loop
+                # re-reads the bitset, or still-in-flight pieces would look
+                # missing and be refetched
+                await self._drain_writes()
                 last_update = time.monotonic()
         finally:
+            await self._drain_writes()
             for t in self._sync_tasks.values():
                 t.cancel()
             await asyncio.gather(*self._sync_tasks.values(), return_exceptions=True)
@@ -606,7 +660,10 @@ class PeerTaskConductor:
         pieceTaskSynchronizer.receive push loop)."""
         version = -1
         errors = 0  # consecutive failures feed the shared backoff ladder
-        url = f"http://{state.info.ip}:{state.info.download_port}/metadata/{self.meta.task_id}"
+        url = (
+            f"http://{_url_host(state.info.ip)}:{state.info.download_port}"
+            f"/metadata/{self.meta.task_id}"
+        )
         while not state.blocked:
             try:
                 if faultline.ACTIVE is not None:
@@ -728,62 +785,172 @@ class PeerTaskConductor:
         # matters because aiohttp treats total=0 as "no timeout", which is
         # exactly wrong for an exhausted budget
         piece_timeout = max(0.001, dl.timeout(self.cfg.piece_timeout))
+        use_raw = r.length >= self._RAW_FETCH_BYTES
+        pooled = None
+        digest = ""
+        data = b""
         try:
             if faultline.ACTIVE is not None:
                 await faultline.ACTIVE.fire("parent.fetch")
             await self.bucket.acquire(r.length)
-            if r.length >= self._RAW_FETCH_BYTES:
-                # big pieces ride the raw keep-alive client: the body lands
-                # straight in a preallocated buffer (sock_recv_into), skipping
-                # aiohttp's chunk-list assembly — one full copy of every byte
-                # on the checkpoint fan-out path (see daemon/rawrange.py)
-                data = await self._raw_http().get_range(
-                    state.info.ip, state.info.download_port, path_qs,
-                    r.header(), r.length, timeout=piece_timeout,
-                )
-            else:
+            if use_raw:
+                # big pieces ride the zero-copy pipeline: the body lands
+                # straight in a POOLED buffer (sock_recv_into, no per-piece
+                # allocation) and is sha256'd AS IT ARRIVES on the pipeline's
+                # hash thread — recv and hash run on two cores instead of two
+                # serial passes on one (daemon/rawrange.py + pipeline.py).
+                # Truncate/corrupt faults fire inside the recv loop — the
+                # pipeline's read point — so chaos proofs cover this path.
+                pipeline = self._pipeline()
+                pooled = await pipeline.pool.acquire(r.length)
+                pump = pipeline.hash_pump(pooled.view)
+                try:
+                    await self._raw_http().get_range_into(
+                        state.info.ip, state.info.download_port, path_qs,
+                        r.header(), pooled.view, timeout=piece_timeout,
+                        on_chunk=pump.feed, fault_point="parent.piece_body",
+                    )
+                    digest = await pump.finish()
+                except AddressFamilyError:
+                    # this host cannot speak the parent's address family over
+                    # a raw socket (e.g. IPv6 parent, odd local stack): not
+                    # the parent's fault — retry below via aiohttp, whose
+                    # resolver handles mixed stacks (ADVICE r05 #1)
+                    pump.abort()
+                    pooled.release()
+                    pooled = None
+                    use_raw = False
+                    self.log.debug(
+                        "parent %s: raw socket family unavailable for %s, "
+                        "falling back to aiohttp", state.info.peer_id, state.info.ip,
+                    )
+                except BaseException:
+                    pump.abort()
+                    pooled.release()
+                    pooled = None
+                    raise
+            if not use_raw:
                 async with session.get(
-                    f"http://{state.info.ip}:{state.info.download_port}{path_qs}",
+                    f"http://{_url_host(state.info.ip)}:{state.info.download_port}{path_qs}",
                     headers={"Range": r.header()},
                     timeout=aiohttp.ClientTimeout(total=piece_timeout),
                 ) as resp:
                     if resp.status != 206:
                         raise IOError(f"parent returned HTTP {resp.status}")
                     data = await resp.read()
-            if faultline.ACTIVE is not None:
-                # damage the payload AFTER the fetch so the digest check (and
-                # only it) is what stands between a corrupt parent and disk
-                data = faultline.ACTIVE.mutate("parent.piece_body", data)
+                if faultline.ACTIVE is not None:
+                    # damage the payload AFTER the fetch so the digest check
+                    # (and only it) stands between a corrupt parent and disk
+                    data = faultline.ACTIVE.mutate("parent.piece_body", data)
         except (aiohttp.ClientError, asyncio.TimeoutError, IOError) as e:
-            cost = (time.monotonic() - t0) * 1000
-            state.record(False, cost)
-            await self.scheduler.report_piece_result(
-                self.peer_id, idx, success=False, cost_ms=cost, parent_id=state.info.peer_id
+            await self._record_piece_failure(
+                state, idx, (time.monotonic() - t0) * 1000, f"failed: {e}"
             )
-            self.log.debug("piece %d from %s failed: %s", idx, state.info.peer_id, e)
             return
         cost = (time.monotonic() - t0) * 1000
         expected = self._piece_digests.get(str(idx), "")
         if not expected:
             self._pieces_unverified += 1
+        if use_raw:
+            if expected and digest != expected:
+                # checked HERE, before any write is (possibly deferred to a
+                # writer task): the parent must be charged and the piece
+                # retried immediately, not after a write round-trip
+                pooled.release()
+                await self._record_piece_failure(
+                    state, idx, cost,
+                    f"corrupt: digest {digest[:12]} != {expected[:12]}", corrupt=True,
+                )
+                return
+            # the store write runs on a worker thread either way
+            # (write_piece_view offloads big writes); deferring additionally
+            # lets THIS worker recycle a fresh buffer into recv before the
+            # write lands — see ConductorConfig.defer_piece_writes for the
+            # measured trade-off
+            if self.cfg.defer_piece_writes:
+                self._spawn_piece_write(state, idx, pooled, digest, cost, r.length)
+            else:
+                await self._write_fetched_piece(state, idx, pooled, digest, cost, r.length)
+            return
         try:
             await self.ts.write_piece(idx, data, expected_digest=expected)
         except (ValueError, digestlib.InvalidDigestError) as e:
-            state.record(False, cost)
-            await self.scheduler.report_piece_result(
-                self.peer_id, idx, success=False, cost_ms=cost, parent_id=state.info.peer_id
-            )
-            self.log.warning("piece %d from %s corrupt: %s", idx, state.info.peer_id, e)
+            await self._record_piece_failure(state, idx, cost, f"corrupt: {e}", corrupt=True)
             return
+        await self._account_piece_success(state, idx, cost, len(data))
+
+    async def _record_piece_failure(
+        self, state, idx, cost, why: str, *, corrupt: bool = False
+    ) -> None:
+        """Shared failure accounting for every per-piece rejection path:
+        charge the parent, tell the scheduler, log (warning for corruption —
+        it implicates the parent's data, debug for routine fetch errors)."""
+        state.record(False, cost)
+        await self.scheduler.report_piece_result(
+            self.peer_id, idx, success=False, cost_ms=cost, parent_id=state.info.peer_id
+        )
+        log = self.log.warning if corrupt else self.log.debug
+        log("piece %d from %s %s", idx, state.info.peer_id, why)
+
+    def _spawn_piece_write(self, state, idx, pooled, digest, cost, length) -> None:
+        t = asyncio.ensure_future(
+            self._write_fetched_piece(state, idx, pooled, digest, cost, length)
+        )
+        self._pending_writes.add(t)
+        t.add_done_callback(self._pending_writes.discard)
+
+    async def _write_fetched_piece(self, state, idx, pooled, digest, cost, length) -> None:
+        """Land a digest-verified pooled buffer in storage (writer side of
+        the recv/hash/write overlap; awaited inline or spawned per
+        defer_piece_writes). A write failure leaves the piece's bitset bit
+        unset, so the dispatch loop refetches it — the same bounded recovery
+        the worker-level re-enqueue gives small-piece writes."""
+        try:
+            try:
+                await self.ts.write_piece_view(idx, pooled.view, digest=digest)
+            finally:
+                pooled.release()
+        except Exception as e:
+            n = self._piece_errors.get(idx, 0) + 1
+            self._piece_errors[idx] = n
+            if n <= self.cfg.piece_requeue_limit and not self.ts.has_piece(idx):
+                self.log.debug(
+                    "piece %d deferred write failed (attempt %d), will refetch: %r",
+                    idx, n, e,
+                )
+                return
+            self.log.warning("piece %d failed past the write-retry budget", idx,
+                             exc_info=True)
+            try:
+                await self.scheduler.report_piece_result(self.peer_id, idx, success=False)
+            except Exception as report_err:  # noqa: BLE001 — best-effort advisory;
+                # the dispatch loop re-sees the piece anyway
+                self.log.debug("piece %d failure report failed: %r", idx, report_err)
+            return
+        await self._account_piece_success(state, idx, cost, length)
+
+    async def _account_piece_success(self, state, idx, cost, length) -> None:
         state.record(True, cost)
-        self.bytes_from_parents += len(data)
+        self.bytes_from_parents += length
         from dragonfly2_tpu.daemon import metrics
 
         metrics.PIECE_DOWNLOAD_TOTAL.inc(source="parent")
-        metrics.DOWNLOAD_BYTES.inc(len(data))
-        await self.scheduler.report_piece_result(
-            self.peer_id, idx, success=True, cost_ms=cost, parent_id=state.info.peer_id
-        )
+        metrics.DOWNLOAD_BYTES.inc(length)
+        try:
+            await self.scheduler.report_piece_result(
+                self.peer_id, idx, success=True, cost_ms=cost, parent_id=state.info.peer_id
+            )
+        except Exception as e:  # noqa: BLE001 — the piece IS on disk; a failed
+            # advisory report must not fail a landed piece (the worker-level
+            # catch would re-enqueue a piece that needs no refetch)
+            self.log.debug("piece %d success report failed: %r", idx, e)
+
+    async def _drain_writes(self) -> None:
+        """Barrier for deferred store writes (round end / teardown). Write
+        tasks handle their own failures, so gather only shields teardown
+        from surprise cancellation races."""
+        while self._pending_writes:
+            await asyncio.gather(*list(self._pending_writes), return_exceptions=True)
 
     # ---- helpers ----
 
@@ -805,6 +972,13 @@ class PeerTaskConductor:
 
             self._raw_client = RawRangeClient()
         return self._raw_client
+
+    def _pipeline(self):
+        if self._pipeline_obj is None:
+            from dragonfly2_tpu.daemon.pipeline import PiecePipeline
+
+            self._pipeline_obj = PiecePipeline()
+        return self._pipeline_obj
 
     async def _safe_report_peer(self, *, success: bool) -> None:
         if self._peer_reported:  # failure paths raise after reporting: once only

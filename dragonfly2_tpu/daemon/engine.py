@@ -144,6 +144,7 @@ class PeerEngine:
         # (parents never contacted again must not pin fds forever)
         self.gc.add("raw-pool-prune", 120.0, self._prune_raw_pool)
         self._raw_client = None
+        self._piece_pipeline = None
         self._started = False
 
     async def _run_reclaim(self, **kw) -> None:
@@ -187,6 +188,17 @@ class PeerEngine:
             self._raw_client = RawRangeClient()
         return self._raw_client
 
+    def _shared_pipeline(self):
+        """One piece pipeline (buffer pool + hash threads) for ALL
+        conductors: pooled piece buffers and the hash-on-receive executor
+        are host-level resources — per-task pools would re-pay the warmup
+        allocations on every file of a multi-file checkpoint fetch."""
+        if self._piece_pipeline is None:
+            from dragonfly2_tpu.daemon.pipeline import PiecePipeline
+
+            self._piece_pipeline = PiecePipeline()
+        return self._piece_pipeline
+
     async def _prune_raw_pool(self) -> None:
         if self._raw_client is not None:
             closed = self._raw_client.prune()
@@ -201,6 +213,9 @@ class PeerEngine:
             if self._raw_client is not None:
                 await self._raw_client.close()
                 self._raw_client = None
+            if self._piece_pipeline is not None:
+                self._piece_pipeline.close()
+                self._piece_pipeline = None
             self.storage.flush_all()  # persist debounced piece metadata
             self._started = False
 
@@ -274,6 +289,7 @@ class PeerEngine:
             headers=headers,
             shaper=self.shaper,
             raw_client=self._shared_raw_client(),
+            pipeline=self._shared_pipeline(),
         )
         producer = asyncio.ensure_future(conductor.run())
         # Wait until the conductor registered storage + metadata. Polling:

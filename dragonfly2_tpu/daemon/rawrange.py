@@ -1,4 +1,4 @@
-"""Zero-copy-ish HTTP/1.1 range client for peer piece fetches.
+"""Zero-copy HTTP/1.1 range client for peer piece fetches.
 
 The piece hot path (conductor._download_one_piece) fetched bodies through
 aiohttp: every received chunk passes the protocol's feed_data, is appended to
@@ -7,14 +7,31 @@ payload byte, plus per-chunk event-loop machinery. A cProfile of the
 checkpoint fan-out bench put that assembly (aiohttp data_received +
 bytes.join) at ~1.2 ns/byte of the ~3.7 ns/byte fetch-path total.
 
-This client receives the body DIRECTLY into a caller-visible preallocated
-buffer with ``loop.sock_recv_into`` — bytes go kernel→piece buffer with no
-intermediate chunk objects and no join pass. It speaks just enough HTTP/1.1
-for the peer upload server's download endpoint (daemon/upload.py
-_handle_download → aiohttp FileResponse): status 206, Content-Length framing
-(FileResponse never chunk-encodes a known-length range), keep-alive pooling
-per (host, port), one transparent retry when a pooled connection turns out to
-be a stale keep-alive socket.
+This client receives the body DIRECTLY into a caller-provided buffer with
+``loop.sock_recv_into`` — bytes go kernel→piece buffer with no intermediate
+chunk objects and no join pass. ``get_range_into`` is the pipeline entry:
+the caller passes a (typically pooled — daemon/pipeline.py) memoryview plus
+an ``on_chunk(filled)`` callback, so a HashPump hashes the piece WHILE it is
+still arriving instead of in a second cold-buffer pass. ``get_range`` keeps
+the old allocate-and-return shape on top of it.
+
+It speaks just enough HTTP/1.1 for the peer upload server's download
+endpoint (daemon/upload.py _handle_download → aiohttp FileResponse): status
+206, Content-Length framing (FileResponse never chunk-encodes a known-length
+range), keep-alive pooling per (host, port), transparent retries for pooled
+connections that turn out to be stale keep-alive sockets. IPv6 parents are
+reached with an AF_INET6 socket (``':' in ip``); where the local stack
+cannot route the family at all, AddressFamilyError tells the caller to fall
+back to the aiohttp path rather than recording a parent failure.
+
+Fault injection: when a ``fault_point`` is given and faultline is ACTIVE,
+truncate/corrupt rules are applied to the FIRST body bytes inside the recv
+loop — the pipeline's read point — mirroring the source registry's
+one-draw-per-stream discipline (per-chunk draws would compound a small rate
+into near-certain failure). A truncation surfaces as the short-body IOError
+a real early close produces; a corruption flows through hash-on-receive and
+is caught by the digest check, so chaos proofs exercise the same rejection
+path production corruption would take.
 
 Reference context: the piece transfer protocol is the reference's HTTP
 `GET /download/{taskID[:3]}/{taskID}?peerId=` with a Range header
@@ -26,9 +43,12 @@ contract, with the client tuned for multi-hundred-MB/s single-core fan-out
 from __future__ import annotations
 
 import asyncio
+import errno
 import logging
 import socket
-from typing import Optional
+from typing import Callable, Optional
+
+from dragonfly2_tpu.resilience import faultline
 
 logger = logging.getLogger(__name__)
 
@@ -39,9 +59,40 @@ _MAX_IDLE_PER_HOST = 4
 # pruned periodically rather than tried
 _IDLE_TTL_S = 60.0
 
+# errnos meaning "this host cannot speak that address family at all" —
+# distinct from a refused/unreachable PEER, which is a real parent failure
+_AF_ERRNOS = frozenset(
+    e
+    for e in (
+        getattr(errno, "EAFNOSUPPORT", None),
+        getattr(errno, "EPFNOSUPPORT", None),
+        getattr(errno, "EADDRNOTAVAIL", None),
+    )
+    if e is not None
+)
+# On a v4-only host socket(AF_INET6) typically SUCCEEDS and the miss shows
+# up at connect() as net/host-unreachable — those must also route to the
+# aiohttp fallback for IPv6 targets (a genuinely dead v6 parent still gets
+# charged when the fallback fails too, so no blame is lost)
+_AF_CONNECT_ERRNOS = _AF_ERRNOS | frozenset(
+    e
+    for e in (
+        getattr(errno, "ENETUNREACH", None),
+        getattr(errno, "EHOSTUNREACH", None),
+    )
+    if e is not None
+)
+
+
+class AddressFamilyError(OSError):
+    """The parent's address family is unusable from this host (no IPv6
+    stack/route for an IPv6 parent, or vice versa). Callers should retry the
+    fetch over the aiohttp path — whose resolver handles mixed stacks —
+    instead of charging the parent with a failure."""
+
 
 class RawRangeClient:
-    """Pooled keep-alive range GETs into preallocated buffers."""
+    """Pooled keep-alive range GETs into caller-provided buffers."""
 
     def __init__(
         self,
@@ -114,14 +165,41 @@ class RawRangeClient:
         timeout: float = 30.0,
     ) -> bytearray:
         """GET path_qs with the given Range header; expects a 206 whose body
-        is exactly `length` bytes and returns it as a bytearray (received in
-        place). Raises IOError on any other status or a short body, and
-        builtin TimeoutError past `timeout` (on this image's 3.10,
-        asyncio.TimeoutError is a separate class — callers match the builtin,
-        and as an OSError subclass it also rides every IOError retry path)."""
+        is exactly `length` bytes and returns it as a fresh bytearray
+        (received in place). Pipelined callers use get_range_into with a
+        pooled buffer instead."""
+        buf = bytearray(length)
+        await self.get_range_into(
+            ip, port, path_qs, range_header, memoryview(buf), timeout=timeout
+        )
+        return buf
+
+    async def get_range_into(
+        self,
+        ip: str,
+        port: int,
+        path_qs: str,
+        range_header: str,
+        view: memoryview,
+        *,
+        timeout: float = 30.0,
+        on_chunk: "Callable[[int], None] | None" = None,
+        fault_point: str | None = None,
+    ) -> None:
+        """GET path_qs with the given Range header, receiving the body
+        directly into `view` (whose length is the expected byte count).
+        `on_chunk(filled)` fires on the event loop after each recv with the
+        total bytes landed so far — the hash-on-receive hook. Raises IOError
+        on any other status or a short body, and builtin TimeoutError past
+        `timeout` (on this image's 3.10, asyncio.TimeoutError is a separate
+        class — callers match the builtin, and as an OSError subclass it
+        also rides every IOError retry path)."""
         try:
-            return await asyncio.wait_for(
-                self._get_with_pool(ip, port, path_qs, range_header, length), timeout
+            await asyncio.wait_for(
+                self._get_with_pool(
+                    ip, port, path_qs, range_header, view, on_chunk, fault_point
+                ),
+                timeout,
             )
         except asyncio.TimeoutError:
             raise TimeoutError(
@@ -129,38 +207,75 @@ class RawRangeClient:
             ) from None
 
     async def _get_with_pool(
-        self, ip: str, port: int, path_qs: str, range_header: str, length: int
-    ) -> bytearray:
+        self,
+        ip: str,
+        port: int,
+        path_qs: str,
+        range_header: str,
+        view: memoryview,
+        on_chunk: "Callable[[int], None] | None",
+        fault_point: str | None,
+    ) -> None:
         # Transparent retries ONLY for pooled sockets that turn out to be
-        # stale keep-alive connections (server closed them between uses →
-        # ConnectionError before any response): the loop drains however
+        # stale keep-alive connections: server closed them between uses →
+        # ConnectionError BEFORE ANY RESPONSE BYTE. The loop drains however
         # many stale sockets the pool holds — with a cross-task shared
         # pool, EVERY pooled socket to a host can be stale after an idle
         # gap — and the final fresh-connection attempt is authoritative.
-        # Deterministic application failures (non-206, bad framing) raise
-        # plain IOError and are never replayed.
+        # A ConnectionError AFTER response bytes arrived (mid-body RST) is
+        # NOT replayed (ADVICE r05 #4): the caller's hash pump has already
+        # consumed body bytes, a systematically-resetting parent should be
+        # charged per attempt, and the conductor's piece retry owns
+        # recovery. Deterministic application failures (non-206, bad
+        # framing) raise plain IOError and are never replayed either.
         key = (ip, port)
         while True:
             sock = self._checkout(key)
             pooled = sock is not None
+            got_response = [False]  # set by _request on the first response byte
             try:
                 if sock is None:
-                    sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-                    sock.setblocking(False)
-                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                    await asyncio.get_running_loop().sock_connect(sock, (ip, port))
-                return await self._request(
-                    sock, key, ip, port, path_qs, range_header, length
+                    sock = self._fresh_socket(ip)
+                    try:
+                        await asyncio.get_running_loop().sock_connect(sock, (ip, port))
+                    except OSError as e:
+                        if ":" in ip and e.errno in _AF_CONNECT_ERRNOS:
+                            raise AddressFamilyError(
+                                f"no route to IPv6 target {ip!r} from this host"
+                            ) from e
+                        raise
+                await self._request(
+                    sock, key, ip, port, path_qs, range_header,
+                    view, on_chunk, fault_point, got_response,
                 )
+                return
             except BaseException as e:
                 # every failure path — including timeout cancellation mid-body
                 # — must close the socket: a piece timeout against a stalled
                 # parent is routine, and each one would otherwise leak an fd
                 if sock is not None:
                     sock.close()
-                if pooled and isinstance(e, ConnectionError):
+                if pooled and isinstance(e, ConnectionError) and not got_response[0]:
                     continue  # drain the next pooled socket (or go fresh)
                 raise
+
+    def _fresh_socket(self, ip: str) -> socket.socket:
+        """Non-blocking TCP socket in the family `ip` needs (':' marks an
+        IPv6 literal — parents advertise addresses, not names). A stack that
+        cannot create the family at all raises AddressFamilyError so the
+        caller falls back to aiohttp instead of blaming the parent."""
+        family = socket.AF_INET6 if ":" in ip else socket.AF_INET
+        try:
+            sock = socket.socket(family, socket.SOCK_STREAM)
+        except OSError as e:
+            if e.errno in _AF_ERRNOS:
+                raise AddressFamilyError(
+                    f"address family for {ip!r} unsupported on this host"
+                ) from e
+            raise
+        sock.setblocking(False)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
 
     async def _request(
         self,
@@ -170,12 +285,17 @@ class RawRangeClient:
         port: int,
         path_qs: str,
         range_header: str,
-        length: int,
-    ) -> bytearray:
+        view: memoryview,
+        on_chunk: "Callable[[int], None] | None",
+        fault_point: str | None,
+        got_response: list,
+    ) -> None:
+        length = len(view)
         loop = asyncio.get_running_loop()
+        host = f"[{ip}]" if ":" in ip else ip
         req = (
             f"GET {path_qs} HTTP/1.1\r\n"
-            f"Host: {ip}:{port}\r\n"
+            f"Host: {host}:{port}\r\n"
             f"Range: {range_header}\r\n"
             "Connection: keep-alive\r\n"
             "\r\n"
@@ -192,6 +312,7 @@ class RawRangeClient:
             chunk = await loop.sock_recv(sock, 8192)
             if not chunk:
                 raise ConnectionError("connection closed before response headers")
+            got_response[0] = True  # past here, ConnectionErrors are not replayed
             head += chunk
         header_blob, leftover = head[:end].decode("latin-1"), head[end + 4 :]
         lines = header_blob.split("\r\n")
@@ -217,21 +338,51 @@ class RawRangeClient:
             sock.close()
             raise IOError("chunked range response unsupported")
 
-        buf = bytearray(length)
-        view = memoryview(buf)
         off = len(leftover)
         if off > length:
             sock.close()
             raise IOError("server sent more body bytes than Content-Length")
         view[:off] = leftover
+        faulted = fault_point is None or faultline.ACTIVE is None
+        if off:
+            if not faulted:
+                self._fault_first_body(fault_point, view, 0, off, sock)
+                faulted = True
+            if on_chunk is not None:
+                on_chunk(off)
         while off < length:
             n = await loop.sock_recv_into(sock, view[off:])
             if n == 0:
                 sock.close()
                 raise IOError(f"connection closed at byte {off}/{length}")
+            if not faulted:
+                self._fault_first_body(fault_point, view, off, off + n, sock)
+                faulted = True
             off += n
+            if on_chunk is not None:
+                on_chunk(off)
         if headers.get("connection", "").lower() == "close":
             sock.close()
         else:
             self._checkin(key, sock)
-        return buf
+
+    @staticmethod
+    def _fault_first_body(
+        point: str, view: memoryview, start: int, end: int, sock: socket.socket
+    ) -> None:
+        """Apply one seeded truncate/corrupt draw to the first body bytes —
+        the pipeline's read point. Truncation becomes the short-body close a
+        real mid-transfer disconnect produces; corruption is written back
+        into the buffer so hash-on-receive digests the damaged bytes and the
+        digest check rejects them."""
+        data = bytes(view[start:end])
+        mutated = faultline.ACTIVE.mutate(point, data)
+        if len(mutated) != len(data):  # truncate: simulate the dead socket
+            view[start : start + len(mutated)] = mutated
+            sock.close()
+            raise IOError(
+                f"connection closed at byte {start + len(mutated)}/{len(view)}"
+                " (injected truncation)"
+            )
+        if mutated is not data:
+            view[start:end] = mutated

@@ -196,15 +196,7 @@ class TaskStorage:
         concurrent piece writers genuinely parallelize. Duplicate writers for
         the SAME index (p2p/back-source overlap) are serialized by an
         in-flight future so racing writes can never interleave bytes."""
-        if self.meta.piece_size <= 0:
-            raise ValueError("task info not set before write_piece")
-        if faultline.ACTIVE is not None:
-            # `storage.write`: injected disk latency / write errors — the
-            # piece-worker re-enqueue path must absorb these
-            await faultline.ACTIVE.fire("storage.write")
-        r = piece_range(index, self.meta.piece_size, self.meta.content_length)
-        if len(data) != r.length:
-            raise ValueError(f"piece {index}: got {len(data)} bytes, want {r.length}")
+        r = self._piece_write_range(index, len(data))
         offload = len(data) > self._INLINE_HASH_BYTES
         if offload:
             d = await asyncio.to_thread(digestlib.sha256_bytes, data)
@@ -214,6 +206,41 @@ class TaskStorage:
             raise digestlib.InvalidDigestError(
                 f"piece {index} digest mismatch: {d[:12]} != {expected_digest[:12]}"
             )
+        return await self._land_piece(index, data, d, r, offload)
+
+    async def write_piece_view(
+        self, index: int, data: "bytes | bytearray | memoryview", *, digest: str
+    ) -> str:
+        """Land a piece whose sha256 the caller already computed — the
+        hash-on-receive pipeline (daemon/pipeline.py HashPump digests the
+        bytes AS they arrive off the socket, so the second full hash pass of
+        write_piece is gone). `data` is typically a memoryview into a pooled
+        buffer: the file write happens directly from it, no copy. The caller
+        must keep the buffer untouched until this returns (the conductor's
+        _write_fetched_piece releases it back to the pool afterwards), and
+        must have verified `digest` against the expected one itself."""
+        r = self._piece_write_range(index, len(data))
+        return await self._land_piece(
+            index, data, digest, r, len(data) > self._INLINE_HASH_BYTES
+        )
+
+    def _piece_write_range(self, index: int, nbytes: int) -> Range:
+        if self.meta.piece_size <= 0:
+            raise ValueError("task info not set before write_piece")
+        r = piece_range(index, self.meta.piece_size, self.meta.content_length)
+        if nbytes != r.length:
+            raise ValueError(f"piece {index}: got {nbytes} bytes, want {r.length}")
+        return r
+
+    async def _land_piece(
+        self, index: int, data, d: str, r: Range, offload: bool
+    ) -> str:
+        """Dedup racing writers, write the (already-validated) bytes at their
+        offset, flip the bitset bit, debounce-persist metadata."""
+        if faultline.ACTIVE is not None:
+            # `storage.write`: injected disk latency / write errors — the
+            # piece-worker re-enqueue path must absorb these
+            await faultline.ACTIVE.fire("storage.write")
         while True:
             if self._bitset.test(index):
                 return d  # duplicate download of a finished piece

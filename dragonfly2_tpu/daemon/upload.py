@@ -13,8 +13,10 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 import time
 import weakref
+from collections import OrderedDict
 
 from aiohttp import web
 
@@ -61,7 +63,17 @@ class UploadServer:
         self.bucket = TokenBucket(rate_limit_bps, burst=64 << 20)
         self.bytes_served = 0
         self.pieces_served = 0
+        # hot-piece accounting: ranges served more than once recently (the
+        # fan-out shape — one seed, N children pulling the same pieces).
+        # Repeat serves ride sendfile straight out of page cache; the fd
+        # cache below keeps a readahead hint warm per hot task.
+        self.pieces_served_hot = 0
+        self._recent_serves: OrderedDict[tuple[str, int, int], int] = OrderedDict()
+        self._fd_cache: OrderedDict[str, int] = OrderedDict()  # task_id -> O_RDONLY fd
         self._runner: web.AppRunner | None = None
+
+    _RECENT_SERVES_MAX = 4096
+    _FD_CACHE_MAX = 32
 
     def _app(self) -> web.Application:
         # no /metrics here: the upload port is the public p2p data path;
@@ -90,6 +102,78 @@ class UploadServer:
         if self._runner is not None:
             await self._runner.cleanup()
             self._runner = None
+        for fd in self._fd_cache.values():
+            try:
+                os.close(fd)
+            except OSError as e:
+                logger.debug("fd-cache close failed: %r", e)
+        self._fd_cache.clear()
+
+    def _advise_range(self, ts: TaskStorage, start: int, length: int) -> None:
+        """Nudge the kernel to keep the served range resident
+        (POSIX_FADV_WILLNEED through a cached per-task fd): the first child's
+        serve pre-warms page cache for the rest of the fan-out, so repeat
+        serves stay on the sendfile/page-cache path with zero userspace
+        copies. Best-effort — tmpfs stores and platforms without fadvise just
+        skip it."""
+        if not hasattr(os, "posix_fadvise"):
+            return
+        task_id = ts.meta.task_id
+        fd = self._fd_cache.get(task_id)
+        try:
+            if fd is not None and os.fstat(fd).st_ino != os.stat(ts.data_path).st_ino:
+                # the task was deleted and re-registered since this fd was
+                # cached: advising the orphaned inode would warm nothing
+                self._fd_cache.pop(task_id, None)
+                os.close(fd)
+                fd = None
+            if fd is None:
+                fd = os.open(ts.data_path, os.O_RDONLY)
+                self._fd_cache[task_id] = fd
+                if len(self._fd_cache) > self._FD_CACHE_MAX:
+                    _, old = self._fd_cache.popitem(last=False)
+                    os.close(old)
+            else:
+                self._fd_cache.move_to_end(task_id)
+            os.posix_fadvise(fd, start, length, os.POSIX_FADV_WILLNEED)
+        except OSError as e:
+            # an unlinked (reclaimed) task or exotic fs: the serve itself is
+            # unaffected, only the readahead hint is lost
+            logger.debug("fadvise for %s failed: %r", task_id[:12], e)
+            stale = self._fd_cache.pop(task_id, None)
+            if stale is not None:
+                try:
+                    os.close(stale)
+                except OSError:
+                    logger.debug("stale fd close failed for %s", task_id[:12])
+
+    def _prune_fd_cache(self) -> None:
+        """Drop cached fds whose tasks were reclaimed (run every 64 serves):
+        an open fd pins a deleted task's unlinked inode, so the disk blocks
+        storage reclaim thought it freed would stay allocated until LRU
+        eviction — on a seed serving few distinct tasks, indefinitely."""
+        for tid in list(self._fd_cache):
+            if self.storage.get(tid) is None:
+                fd = self._fd_cache.pop(tid)
+                try:
+                    os.close(fd)
+                except OSError as e:
+                    logger.debug("fd-cache prune close failed: %r", e)
+
+    def _note_serve(self, task_id: str, start: int, length: int) -> bool:
+        """Track (task, range) repeat serves; True when this range is hot
+        (served before recently). Bounded LRU — eviction only loses hotness
+        accounting, never correctness."""
+        key = (task_id, start, length)
+        seen = self._recent_serves.get(key)
+        if seen is None:
+            self._recent_serves[key] = 1
+            if len(self._recent_serves) > self._RECENT_SERVES_MAX:
+                self._recent_serves.popitem(last=False)
+            return False
+        self._recent_serves.move_to_end(key)
+        self._recent_serves[key] = seen + 1
+        return True
 
     async def _handle_health(self, request: web.Request) -> web.Response:
         return web.json_response({"ok": True})
@@ -166,17 +250,29 @@ class UploadServer:
         except ValueError as e:
             raise web.HTTPRequestRangeNotSatisfiable(text=str(e))
 
-        # The requested range must be fully covered by finished pieces.
-        psize = ts.meta.piece_size
-        first_piece = rng.start // psize
-        last_piece = (rng.start + rng.length - 1) // psize
-        for idx in range(first_piece, last_piece + 1):
-            if not ts.has_piece(idx):
-                raise web.HTTPNotFound(text=f"piece {idx} not yet available")
+        # The requested range must be fully covered by finished pieces. A
+        # done task has every piece — skip the per-piece loop (O(pieces) per
+        # serve; ~1k has_piece calls per whole-shard range on a large
+        # checkpoint), which is pure overhead on the repeat-serve hot path.
+        if not ts.meta.done:
+            psize = ts.meta.piece_size
+            first_piece = rng.start // psize
+            last_piece = (rng.start + rng.length - 1) // psize
+            for idx in range(first_piece, last_piece + 1):
+                if not ts.has_piece(idx):
+                    raise web.HTTPNotFound(text=f"piece {idx} not yet available")
 
         await self.bucket.acquire(rng.length)
         self.bytes_served += rng.length
         self.pieces_served += 1
+        if self.pieces_served % 64 == 0:
+            self._prune_fd_cache()
+        if self._note_serve(task_id, rng.start, rng.length):
+            self.pieces_served_hot += 1
+        else:
+            # first serve of this range: pre-warm page cache for the rest of
+            # the fan-out (repeat serves then sendfile straight from cache)
+            self._advise_range(ts, rng.start, rng.length)
         from dragonfly2_tpu.daemon import metrics
 
         metrics.UPLOAD_BYTES.inc(rng.length)

@@ -47,6 +47,13 @@ class ConnectionClosed(RpcError):
         super().__init__("connection closed", code="unavailable")
 
 
+# Frames at/above this size take the zero-copy paths: bodies are read into a
+# preallocated buffer (readinto-style — readexactly would assemble the chunk
+# list with an extra full-frame join copy) and written without the
+# header+body concatenation copy. Below it, syscall count beats copy cost.
+_BIG_FRAME = 64 << 10
+
+
 async def _read_frame(reader: asyncio.StreamReader) -> dict:
     if faultline.ACTIVE is not None:
         await faultline.ACTIVE.fire("rpc.read")
@@ -54,6 +61,20 @@ async def _read_frame(reader: asyncio.StreamReader) -> dict:
     (length,) = _LEN.unpack(header)
     if length > MAX_FRAME:
         raise RpcError(f"frame too large: {length}", code="resource_exhausted")
+    if length >= _BIG_FRAME:
+        # piece-payload-sized frame: land chunks directly in one
+        # preallocated buffer and unpack from the memoryview — no chunk-list
+        # join, no second full-frame allocation
+        buf = bytearray(length)
+        view = memoryview(buf)
+        off = 0
+        while off < length:
+            chunk = await reader.read(length - off)
+            if not chunk:
+                raise asyncio.IncompleteReadError(bytes(view[:off]), length)
+            view[off : off + len(chunk)] = chunk
+            off += len(chunk)
+        return msgpack.unpackb(view, raw=False)
     body = await reader.readexactly(length)
     return msgpack.unpackb(body, raw=False)
 
@@ -62,7 +83,14 @@ def _write_frame(writer: asyncio.StreamWriter, msg: dict) -> None:
     if faultline.ACTIVE is not None:
         faultline.ACTIVE.check("rpc.write")
     body = msgpack.packb(msg, use_bin_type=True)
-    writer.write(_LEN.pack(len(body)) + body)
+    header = _LEN.pack(len(body))
+    if len(body) >= _BIG_FRAME:
+        # two buffered writes: skips concatenating a multi-MB body with its
+        # 4-byte header (a full-frame copy per direct-piece/piece-body frame)
+        writer.write(header)
+        writer.write(body)
+    else:
+        writer.write(header + body)
 
 
 Handler = Callable[[Any], Awaitable[Any]]

@@ -1,0 +1,532 @@
+"""Zero-copy piece pipeline (daemon/pipeline.py) + the raw-range
+hash-on-receive path.
+
+Covers the buffer pool contract (reuse, bucket sizing, backpressure, no
+cross-piece data bleed), incremental-hash equivalence with
+digestlib.sha256_bytes on chunked/truncated/corrupted input, the no-rehash
+storage landing (write_piece_view), and — chaos marker — the proof that
+corrupt/truncate faults injected at the NEW pipeline's read points
+(rawrange's recv loop) still never land a bad piece."""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+
+import pytest
+from aiohttp import web
+
+from dragonfly2_tpu.daemon.pipeline import (
+    MIN_BUCKET,
+    BufferPool,
+    PiecePipeline,
+    bucket_size,
+)
+from dragonfly2_tpu.daemon.rawrange import RawRangeClient
+from dragonfly2_tpu.daemon.storage import StorageManager
+from dragonfly2_tpu.resilience import faultline
+from dragonfly2_tpu.utils import digest as digestlib
+
+
+@pytest.fixture(autouse=True)
+def _faultline_cleanup():
+    yield
+    faultline.disable()
+
+
+# ---------------------------------------------------------------------------
+# buffer pool
+
+
+class TestBufferPool:
+    def test_bucket_sizing(self):
+        assert bucket_size(1) == MIN_BUCKET
+        assert bucket_size(MIN_BUCKET) == MIN_BUCKET
+        assert bucket_size(MIN_BUCKET + 1) == MIN_BUCKET * 2
+        assert bucket_size(4 << 20) == 4 << 20
+        assert bucket_size((4 << 20) + 7) == 8 << 20
+
+    def test_view_is_exact_length(self, run):
+        async def body():
+            pool = BufferPool()
+            pb = await pool.acquire(1000)
+            assert len(pb.view) == 1000
+            pb.release()
+
+        run(body())
+
+    def test_reuse_same_buffer(self, run):
+        async def body():
+            pool = BufferPool()
+            pb = await pool.acquire(1 << 20)
+            underlying = pb._buf
+            pb.release()
+            pb2 = await pool.acquire(1 << 20)
+            assert pb2._buf is underlying  # pooled, not reallocated
+            assert pool.stats()["hits"] == 1
+            pb2.release()
+
+        run(body())
+
+    def test_release_idempotent(self, run):
+        async def body():
+            pool = BufferPool(max_idle_per_bucket=4)
+            pb = await pool.acquire(100)
+            pb.release()
+            pb.release()  # double release (finally + error path) must not
+            # double-checkin the buffer
+            a = await pool.acquire(100)
+            b = await pool.acquire(100)
+            assert a._buf is not b._buf
+            a.release()
+            b.release()
+
+        run(body())
+
+    def test_backpressure_blocks_until_release(self, run):
+        async def body():
+            pool = BufferPool(max_outstanding_per_bucket=1)
+            pb = await pool.acquire(512)
+            waiter = asyncio.ensure_future(pool.acquire(512))
+            with pytest.raises(asyncio.TimeoutError):
+                await asyncio.wait_for(asyncio.shield(waiter), 0.1)
+            assert not waiter.done()  # parked: the bucket's one lease is out
+            pb.release()
+            pb2 = await asyncio.wait_for(waiter, 2)
+            pb2.release()
+
+        run(body())
+
+    def test_no_cross_piece_bleed(self, run):
+        """A recycled buffer serves a SMALLER piece: the lease's view must
+        expose exactly the new piece's bytes, never the stale tail."""
+
+        async def body():
+            pool = BufferPool()
+            pb = await pool.acquire(4096)
+            pb.view[:] = b"\xaa" * 4096
+            pb.release()
+            pb2 = await pool.acquire(100)
+            assert pb2._buf is pb._buf
+            pb2.view[:] = b"\x55" * 100
+            assert bytes(pb2.view) == b"\x55" * 100
+            assert len(pb2.view) == 100  # stale 0xAA tail is unreachable
+            pb2.release()
+
+        run(body())
+
+    def test_oversized_request_not_pooled(self, run):
+        async def body():
+            from dragonfly2_tpu.daemon.pipeline import MAX_BUCKET
+
+            pool = BufferPool(max_outstanding_per_bucket=1)
+            # two concurrent oversized leases: no backpressure slot, no reuse
+            a = await pool.acquire(MAX_BUCKET + 1)
+            b = await pool.acquire(MAX_BUCKET + 1)
+            a.release()
+            b.release()
+            c = await pool.acquire(MAX_BUCKET + 1)
+            assert c._buf is not a._buf and c._buf is not b._buf
+            c.release()
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# hash-on-receive
+
+
+class TestHashPump:
+    def _fill_and_digest(self, run, size: int, chunk: int, *, hash_chunk=64 << 10):
+        async def body():
+            payload = bytes(range(256)) * (size // 256 + 1)
+            payload = payload[:size]
+            pipeline = PiecePipeline(hash_chunk_bytes=hash_chunk, inline_hash_bytes=4096)
+            try:
+                buf = bytearray(size)
+                view = memoryview(buf)
+                pump = pipeline.hash_pump(view)
+                off = 0
+                while off < size:
+                    n = min(chunk, size - off)
+                    view[off : off + n] = payload[off : off + n]
+                    off += n
+                    pump.feed(off)
+                got = await pump.finish()
+                assert got == digestlib.sha256_bytes(payload)
+            finally:
+                pipeline.close()
+
+        run(body())
+
+    def test_equivalence_threaded_odd_chunks(self, run):
+        # > inline threshold with odd chunking: worker-thread updates chained
+        # in order must equal the one-shot digest
+        self._fill_and_digest(run, 600 * 1024, 37_013)
+
+    def test_equivalence_inline_small(self, run):
+        async def body():
+            pipeline = PiecePipeline()  # default inline threshold 256 KiB
+            data = b"q" * 1000
+            buf = bytearray(data)
+            pump = pipeline.hash_pump(memoryview(buf))
+            pump.feed(1000)
+            assert await pump.finish() == digestlib.sha256_bytes(data)
+            pipeline.close()
+
+        run(body())
+
+    def test_corrupted_buffer_changes_digest(self, run):
+        """A bit flip anywhere in the received bytes yields a different
+        digest — the comparison against the expected digest is what rejects
+        a corrupt piece in the pipelined path."""
+
+        async def body():
+            pipeline = PiecePipeline(hash_chunk_bytes=64 << 10, inline_hash_bytes=4096)
+            try:
+                clean = b"\x11" * (300 * 1024)
+                buf = bytearray(clean)
+                buf[123_456] ^= 0x40
+                pump = pipeline.hash_pump(memoryview(buf))
+                pump.feed(len(buf))
+                got = await pump.finish()
+                assert got != digestlib.sha256_bytes(clean)
+            finally:
+                pipeline.close()
+
+        run(body())
+
+    def test_shard_survives_aborted_pump_with_released_buffer(self, run):
+        """A routine fetch failure aborts its pump and releases the pooled
+        buffer while hash jobs may still be queued; the shard thread must
+        survive stale jobs (it serves every later pump on this host — a dead
+        shard would hang all subsequent finish() calls forever)."""
+
+        async def body():
+            pipeline = PiecePipeline(hash_chunk_bytes=16 << 10, inline_hash_bytes=1024)
+            try:
+                pb = await pipeline.pool.acquire(256 * 1024)
+                pump = pipeline.hash_pump(pb.view)
+                pump.feed(len(pb.view))  # queue work for the shard
+                pump.abort()
+                pb.release()  # buffer recycled while jobs may be in flight
+                # the SAME shard must still complete a fresh pump (pumps
+                # round-robin over hash_threads=2 shards: exercise both)
+                for _ in range(2):
+                    pb2 = await pipeline.pool.acquire(256 * 1024)
+                    pb2.view[:] = b"\x33" * len(pb2.view)
+                    pump2 = pipeline.hash_pump(pb2.view)
+                    pump2.feed(len(pb2.view))
+                    got = await asyncio.wait_for(pump2.finish(), 5)
+                    assert got == digestlib.sha256_bytes(bytes(pb2.view))
+                    pb2.release()
+            finally:
+                pipeline.close()
+
+        run(body())
+
+    def test_finish_after_close_fails_fast(self, run):
+        """Pipeline closed while a fetch is mid-hash (daemon shutdown racing
+        a download): finish() must raise promptly, never await a signal the
+        dead shard will not deliver (the piece worker would otherwise stall
+        until the 600 s task watchdog)."""
+
+        async def body():
+            pipeline = PiecePipeline(hash_chunk_bytes=16 << 10, inline_hash_bytes=1024)
+            buf = bytearray(128 * 1024)
+            pump = pipeline.hash_pump(memoryview(buf))
+            pump.feed(64 * 1024)
+            pipeline.close()
+            await asyncio.sleep(0.05)  # let the shard consume its sentinel
+            pump.feed(128 * 1024)  # post-close feeds must not pile up
+            with pytest.raises(RuntimeError):
+                await asyncio.wait_for(pump.finish(), 5)
+
+        run(body())
+
+    def test_truncated_fill_differs_from_full(self, run):
+        """Hashing only the bytes that arrived (truncation) can never match
+        the full piece's digest — belt to the length check's suspenders."""
+
+        async def body():
+            pipeline = PiecePipeline()
+            full = b"\x22" * 8192
+            buf = bytearray(full[:4096])
+            pump = pipeline.hash_pump(memoryview(buf))
+            pump.feed(4096)
+            assert await pump.finish() != digestlib.sha256_bytes(full)
+            pipeline.close()
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# no-rehash storage landing
+
+
+class TestWritePieceView:
+    def test_lands_piece_from_pooled_view(self, run, tmp_path):
+        async def body():
+            sm = StorageManager(tmp_path / "store")
+            ts = sm.register_task("t-pipeline")
+            ts.set_task_info(content_length=300, piece_size=100, total_pieces=3)
+            pool = BufferPool()
+            pb = await pool.acquire(100)
+            pb.view[:] = b"b" * 100
+            d = digestlib.sha256_bytes(b"b" * 100)
+            got = await ts.write_piece_view(1, pb.view, digest=d)
+            pb.release()
+            assert got == d
+            assert ts.has_piece(1)
+            assert await ts.read_piece(1) == b"b" * 100
+            assert ts.meta.piece_digests["1"] == d
+
+        run(body())
+
+    def test_size_mismatch_rejected(self, run, tmp_path):
+        async def body():
+            sm = StorageManager(tmp_path / "store")
+            ts = sm.register_task("t-size")
+            ts.set_task_info(content_length=300, piece_size=100, total_pieces=3)
+            with pytest.raises(ValueError):
+                await ts.write_piece_view(0, memoryview(bytearray(99)), digest="0" * 64)
+
+        run(body())
+
+    def test_recycled_buffer_write_is_exact(self, run, tmp_path):
+        """End-to-end bleed proof: a piece written from a RECYCLED buffer
+        lands exactly its own bytes, nothing from the previous tenant."""
+
+        async def body():
+            sm = StorageManager(tmp_path / "store")
+            ts = sm.register_task("t-bleed")
+            ts.set_task_info(content_length=250, piece_size=100, total_pieces=3)
+            pool = BufferPool()
+            pb = await pool.acquire(100)
+            pb.view[:] = b"X" * 100
+            await ts.write_piece_view(0, pb.view, digest=digestlib.sha256_bytes(b"X" * 100))
+            pb.release()
+            # last piece is SHORTER (50 bytes) and reuses the same bytearray
+            pb2 = await pool.acquire(50)
+            assert pb2._buf is pb._buf
+            pb2.view[:] = b"Y" * 50
+            await ts.write_piece_view(2, pb2.view, digest=digestlib.sha256_bytes(b"Y" * 50))
+            pb2.release()
+            assert await ts.read_piece(2) == b"Y" * 50
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# raw-range pipelined fetch + chaos at the pipeline's read points
+
+
+class _RangeServer:
+    """Minimal 206 range server (aiohttp) serving one payload."""
+
+    def __init__(self, payload: bytes):
+        self.payload = payload
+        self.port = 0
+        self._runner = None
+
+    async def __aenter__(self):
+        from dragonfly2_tpu.utils.pieces import parse_http_range
+
+        async def handle(request):
+            r = parse_http_range(request.headers["Range"], len(self.payload))
+            return web.Response(
+                status=206, body=self.payload[r.start : r.start + r.length]
+            )
+
+        app = web.Application()
+        app.router.add_get("/{tail:.*}", handle)
+        self._runner = web.AppRunner(app, access_log=None)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return self
+
+    async def __aexit__(self, *exc):
+        await self._runner.cleanup()
+
+
+@pytest.fixture
+def big_payload():
+    return bytes(range(256)) * 2400  # 600 KiB: above the inline-hash threshold
+
+
+class TestRawRangePipelined:
+    def test_get_range_into_with_hash_pump(self, run, big_payload):
+        async def body():
+            async with _RangeServer(big_payload) as srv:
+                pipeline = PiecePipeline(hash_chunk_bytes=64 << 10, inline_hash_bytes=4096)
+                raw = RawRangeClient()
+                try:
+                    pool = pipeline.pool
+                    pb = await pool.acquire(len(big_payload))
+                    pump = pipeline.hash_pump(pb.view)
+                    await raw.get_range_into(
+                        "127.0.0.1", srv.port, "/p", f"bytes=0-{len(big_payload)-1}",
+                        pb.view, on_chunk=pump.feed,
+                    )
+                    assert bytes(pb.view) == big_payload
+                    assert await pump.finish() == digestlib.sha256_bytes(big_payload)
+                    pb.release()
+                finally:
+                    await raw.close()
+                    pipeline.close()
+
+        run(body())
+
+    @pytest.mark.chaos
+    def test_corrupt_at_read_point_never_lands(self, run, tmp_path, big_payload):
+        """faultline corrupt fires INSIDE the recv loop (the pipeline's read
+        point); hash-on-receive digests the damaged bytes, the expected-digest
+        comparison rejects them, and the store never sees the piece — the
+        exact rejection flow the conductor's pipelined path runs."""
+
+        async def body():
+            sm = StorageManager(tmp_path / "store")
+            ts = sm.register_task("t-chaos")
+            n = len(big_payload)
+            ts.set_task_info(content_length=n, piece_size=n, total_pieces=1)
+            expected = digestlib.sha256_bytes(big_payload)
+            async with _RangeServer(big_payload) as srv:
+                pipeline = PiecePipeline(hash_chunk_bytes=64 << 10, inline_hash_bytes=4096)
+                raw = RawRangeClient()
+                try:
+                    fl = faultline.enable("parent.piece_body:corrupt:1.0,seed=71")
+                    pb = await pipeline.pool.acquire(n)
+                    pump = pipeline.hash_pump(pb.view)
+                    await raw.get_range_into(
+                        "127.0.0.1", srv.port, "/p", f"bytes=0-{n-1}", pb.view,
+                        on_chunk=pump.feed, fault_point="parent.piece_body",
+                    )
+                    got = await pump.finish()
+                    assert fl.injected[("parent.piece_body", "corrupt")] >= 1
+                    # the conductor writes only when got == expected; the flip
+                    # guarantees a mismatch, so the store never sees the piece
+                    assert got != expected
+                    pb.release()
+                    assert not ts.has_piece(0)  # nothing corrupt ever landed
+                finally:
+                    faultline.disable()
+                    await raw.close()
+                    pipeline.close()
+
+        run(body())
+
+    @pytest.mark.chaos
+    def test_truncate_at_read_point_raises_short_body(self, run, big_payload):
+        """faultline truncate at the recv loop surfaces as the short-body
+        IOError a real early close produces — the piece fetch fails before
+        any write is attempted."""
+
+        async def body():
+            n = len(big_payload)
+            async with _RangeServer(big_payload) as srv:
+                pipeline = PiecePipeline()
+                raw = RawRangeClient()
+                try:
+                    fl = faultline.enable("parent.piece_body:truncate:1.0,seed=72")
+                    pb = await pipeline.pool.acquire(n)
+                    pump = pipeline.hash_pump(pb.view)
+                    with pytest.raises(IOError):
+                        await raw.get_range_into(
+                            "127.0.0.1", srv.port, "/p", f"bytes=0-{n-1}", pb.view,
+                            on_chunk=pump.feed, fault_point="parent.piece_body",
+                        )
+                    pump.abort()
+                    pb.release()
+                    assert fl.injected[("parent.piece_body", "truncate")] >= 1
+                finally:
+                    faultline.disable()
+                    await raw.close()
+                    pipeline.close()
+
+        run(body())
+
+    def test_ipv6_unreachable_maps_to_address_family_error(self, run, monkeypatch):
+        """A v4-only host typically creates the AF_INET6 socket fine and
+        fails at connect() with ENETUNREACH — that must surface as
+        AddressFamilyError so the conductor falls back to aiohttp instead of
+        charging the parent (ADVICE r05 #1)."""
+        import errno as errno_mod
+
+        from dragonfly2_tpu.daemon.rawrange import AddressFamilyError
+
+        async def body():
+            raw = RawRangeClient()
+
+            async def refuse(sock, addr):
+                raise OSError(errno_mod.ENETUNREACH, "Network is unreachable")
+
+            loop = asyncio.get_running_loop()
+            monkeypatch.setattr(loop, "sock_connect", refuse)
+            buf = memoryview(bytearray(10))
+            with pytest.raises(AddressFamilyError):
+                await raw.get_range_into("2001:db8::1", 8000, "/p", "bytes=0-9", buf)
+            # the SAME errno against an IPv4 parent is a real network
+            # failure and must stay an ordinary OSError (parent is charged)
+            with pytest.raises(OSError) as exc:
+                await raw.get_range_into("10.255.255.1", 8000, "/p", "bytes=0-9", buf)
+            assert not isinstance(exc.value, AddressFamilyError)
+            await raw.close()
+
+        run(body())
+
+    def test_url_host_brackets_ipv6(self):
+        from dragonfly2_tpu.daemon.conductor import _url_host
+
+        assert _url_host("10.0.0.1") == "10.0.0.1"
+        assert _url_host("2001:db8::1") == "[2001:db8::1]"
+
+    def test_get_range_compat_shape(self, run, big_payload):
+        """The allocate-and-return wrapper still serves non-pipelined
+        callers (engine-less tests, tools)."""
+
+        async def body():
+            async with _RangeServer(big_payload) as srv:
+                raw = RawRangeClient()
+                try:
+                    got = await raw.get_range(
+                        "127.0.0.1", srv.port, "/p", "bytes=0-99", 100
+                    )
+                    assert isinstance(got, bytearray)
+                    assert bytes(got) == big_payload[:100]
+                finally:
+                    await raw.close()
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# rpc big-frame zero-copy paths
+
+
+class TestRpcBigFrames:
+    def test_big_frame_roundtrip(self, run):
+        """Frames above the zero-copy threshold (two-write send, readinto
+        assembly, memoryview unpack) round-trip bit-exact."""
+        from dragonfly2_tpu.rpc.core import RpcClient, RpcServer
+
+        async def body():
+            server = RpcServer()
+            blob = bytes(range(256)) * 2048  # 512 KiB >= _BIG_FRAME
+
+            async def echo(payload):
+                return {"body": payload["body"], "n": len(payload["body"])}
+
+            server.register("echo", echo)
+            await server.start()
+            client = RpcClient(f"127.0.0.1:{server.port}")
+            try:
+                out = await client.call("echo", {"body": blob})
+                assert out["n"] == len(blob)
+                assert out["body"] == blob
+            finally:
+                await client.close()
+                await server.stop()
+
+        run(body())

@@ -205,21 +205,29 @@ class TestRateLimit:
 
     def test_try_acquire_during_sleep_extends_wait(self, run):
         async def body():
-            tb = ratelimit.TokenBucket(rate=1000, burst=10)
+            # rate 200 → the waiter's 10 tokens take 50 ms: wide enough that
+            # a loaded-box oversleep of the 15 ms pause still lands the steal
+            # INSIDE the waiter's window (at rate 1000 a ~3 ms oversleep let
+            # the waiter finish before the steal and flaked tier-1). The
+            # steal is 2 tokens — refilled after 10 ms, so the 15 ms pause
+            # guarantees they are available (oversleep only adds tokens).
+            tb = ratelimit.TokenBucket(rate=200, burst=10)
             await tb.acquire(10)  # drain
-
-            async def waiter():
-                t0 = time.monotonic()
-                await tb.acquire(10)
-                return time.monotonic() - t0
-
-            w = asyncio.ensure_future(waiter())
-            await asyncio.sleep(0.008)
-            stolen = tb.try_acquire(5)  # steal mid-sleep
-            elapsed = await w
+            # clock anchored at DRAIN time, not the waiter task's first run:
+            # tokens accrue from the drain, so a loaded-box delay starting
+            # the waiter would otherwise shrink its measured wait below the
+            # token-math floor (observed 50.9 ms vs the 55 ms assert)
+            t0 = time.monotonic()
+            w = asyncio.ensure_future(tb.acquire(10))
+            await asyncio.sleep(0.015)
+            stolen = tb.try_acquire(2)  # steal mid-sleep
+            await w
+            elapsed = time.monotonic() - t0
             assert stolen
-            # waiter must have waited for its full 10 tokens *plus* the stolen 5
-            assert elapsed > 0.012
+            # the waiter needs its 10 tokens plus the stolen 2 = 12 tokens
+            # at 200/s from a drained bucket: it cannot finish before ~60 ms
+            # after the drain (without the steal it finishes at 50 ms)
+            assert elapsed > 0.055
 
         run(body())
 
