@@ -35,12 +35,12 @@ import numpy as np
 
 _SECTION_TIMEOUT_S = int(os.environ.get("DF_BENCH_SECTION_TIMEOUT", "420"))
 _PROBE_TIMEOUT_S = int(os.environ.get("DF_BENCH_PROBE_TIMEOUT", "240"))
-# The worker must outlive its own worst case: eight SIGALRM-bounded sections
+# The worker must outlive its own worst case: nine SIGALRM-bounded sections
 # plus backend init/compile margin — otherwise the supervisor would kill it
 # and discard sections that did complete.
 _WORKER_TIMEOUT_S = max(
     int(os.environ.get("DF_BENCH_WORKER_TIMEOUT", "1500")),
-    8 * _SECTION_TIMEOUT_S + _PROBE_TIMEOUT_S + 120,
+    9 * _SECTION_TIMEOUT_S + _PROBE_TIMEOUT_S + 120,
 )
 
 
@@ -873,6 +873,70 @@ def bench_piece_pipeline(total_mb: int = 192, piece_mb: int = 16) -> dict:
     return asyncio.run(run_all())
 
 
+def bench_dataset_build(
+    n_downloads: int = 100_000, n_probes: int = 20_000, n_hosts: int = 2048
+) -> dict:
+    """Telemetry→dataset ingest (the trainer's record plane):
+
+      dataset_build_rows_per_sec   vectorized build_dataset on ≥100k rows
+      rowloop_rows_per_sec         the per-row reference walk
+                                   (_build_dataset_rowloop) on the same data
+      speedup_vs_rowloop           A/B pairs INTERLEAVED, median of 3 — this
+                                   shared box drifts ±30% run-to-run
+      chunk_fold_rows_per_sec      DatasetAccumulator folding announcer-sized
+                                   chunks (the incremental train_chunk path)
+      ingest_to_train_start_ms     finalize() on the folded state — the
+                                   latency between train_close and the first
+                                   trainable Dataset
+    """
+    from dragonfly2_tpu.scheduler.announcer import CHUNK_ROWS
+    from dragonfly2_tpu.trainer import dataset as datasetlib
+    from dragonfly2_tpu.trainer.synthetic import synth_telemetry_records
+
+    # generated vectorized (appending 100k rows through ColumnarStore would
+    # time the generator, not the builder)
+    downloads, probes = synth_telemetry_records(n_downloads, n_probes, n_hosts, seed=7)
+    total = len(downloads) + len(probes)
+
+    row_t, vec_t = [], []
+    ds = None
+    for _ in range(3):
+        t0 = time.perf_counter()
+        ref = datasetlib._build_dataset_rowloop(downloads, probes)
+        row_t.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        ds = datasetlib.build_dataset(downloads, probes)
+        vec_t.append(time.perf_counter() - t0)
+    assert ds.num_pairs == ref.num_pairs and ds.num_nodes == ref.num_nodes
+
+    acc = datasetlib.DatasetAccumulator()
+    t0 = time.perf_counter()
+    for start in range(0, len(downloads), CHUNK_ROWS):
+        acc.add_downloads(downloads[start : start + CHUNK_ROWS])
+    for start in range(0, len(probes), CHUNK_ROWS):
+        acc.add_probes(probes[start : start + CHUNK_ROWS])
+    fold_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    acc.finalize()
+    finalize_s = time.perf_counter() - t0
+
+    row_s = float(np.median(row_t))
+    vec_s = float(np.median(vec_t))
+    return {
+        "rows": total,
+        "hosts": n_hosts,
+        "dataset_build_rows_per_sec": round(total / vec_s, 1),
+        "rowloop_rows_per_sec": round(total / row_s, 1),
+        "speedup_vs_rowloop": round(row_s / vec_s, 2),
+        "chunk_fold_rows_per_sec": round(total / fold_s, 1),
+        "chunk_rows": CHUNK_ROWS,
+        "ingest_to_train_start_ms": round(finalize_s * 1000, 2),
+        "num_nodes": ds.num_nodes,
+        "num_pairs": ds.num_pairs,
+        "num_edges": acc.num_edges,
+    }
+
+
 def main() -> None:
     import jax
 
@@ -909,6 +973,7 @@ def main() -> None:
     )
     fanout_mbps, disk_mbps = run_section("checkpoint_fanout", bench_checkpoint_fanout, (0.0, 0.0))
     piece_pipeline = run_section("piece_pipeline", bench_piece_pipeline, {})
+    dataset_build = run_section("dataset_build", bench_dataset_build, {})
     mlp_sps, mlp_mse = run_section("mlp_train", bench_mlp_train, (0.0, -1.0))
     serving = run_section("evaluator_serving", bench_evaluator_serving, {})
     # headline = the production serving path: native C++ scorer when the
@@ -949,6 +1014,11 @@ def main() -> None:
         ),
         "piece_pipeline_mb_per_s": piece_pipeline.get("pipelined_mb_per_s", 0.0),
         "piece_pipeline_stages": piece_pipeline,
+        # the trainer's record plane: vectorized telemetry→dataset ingest vs
+        # the rowloop reference (interleaved median-of-3), plus the
+        # incremental chunk-fold rate and the train_close→Dataset latency
+        "dataset_build_rows_per_sec": dataset_build.get("dataset_build_rows_per_sec", 0.0),
+        "dataset_build": dataset_build,
         "backend": backend,
         **serving,
     }

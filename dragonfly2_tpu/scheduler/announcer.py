@@ -46,9 +46,14 @@ class TrainerAnnouncer:
         self.uploads = 0
 
     async def upload_once(self) -> dict:
-        """One full cycle: open session, chunk both stores, close."""
-        downloads = self.telemetry.downloads.load_all()
-        probes = self.telemetry.probes.load_all()
+        """One full cycle: open session, chunk both stores, close.
+
+        The stores are cut with snapshot() before the first RPC: the upload
+        awaits many round trips, and telemetry appended meanwhile must NOT be
+        dropped by the post-upload clear — only the files actually uploaded
+        are discarded."""
+        downloads, dl_cut = self.telemetry.downloads.snapshot()
+        probes, pr_cut = self.telemetry.probes.snapshot()
         token = await self.trainer.train_open(self.hostname, self.scheduler_id)
         rows = 0
         for kind, arr in (("downloads", downloads), ("probes", probes)):
@@ -58,8 +63,10 @@ class TrainerAnnouncer:
                 )
         await self.trainer.train_close(token)
         if self.clear_after_upload:
-            # dataset handed off; rotation backups served their checkpoint role
-            self.telemetry.clear()
+            # dataset handed off; drop exactly the snapshot — rows that
+            # arrived mid-upload stay for the next cycle
+            self.telemetry.downloads.discard(dl_cut)
+            self.telemetry.probes.discard(pr_cut)
         self.uploads += 1
         logger.info("uploaded %d telemetry rows to trainer", rows)
         return {"rows": rows, "downloads": len(downloads), "probes": len(probes)}

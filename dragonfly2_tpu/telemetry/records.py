@@ -99,16 +99,17 @@ class ColumnarStore:
         if self._n >= self.rotate_rows:
             self.flush()
 
-    def flush(self) -> Path | None:
+    def flush(self, *, prune: bool = True) -> Path | None:
         if self._n == 0:
             return None
         path = self.dir / f"{self.prefix}-{self._seq}.npz"
         np.savez_compressed(path, records=self._buf[: self._n].copy())
         self._seq += 1
         self._n = 0
-        files = self._files()
-        for old in files[: max(0, len(files) - self.max_backups)]:
-            old.unlink(missing_ok=True)
+        if prune:
+            files = self._files()
+            for old in files[: max(0, len(files) - self.max_backups)]:
+                old.unlink(missing_ok=True)
         return path
 
     def load_all(self, *, include_buffer: bool = True) -> np.ndarray:
@@ -119,6 +120,25 @@ class ColumnarStore:
         if not parts:
             return np.zeros(0, dtype=self.dtype)
         return np.concatenate(parts)
+
+    def snapshot(self) -> tuple[np.ndarray, tuple[Path, ...]]:
+        """Consistent upload cut: flush the buffer, then return (records,
+        files) for exactly the rows present NOW. Rows appended afterwards
+        land in the fresh buffer / later files and are untouched by a
+        subsequent discard(files) — the clear-after-upload path that used to
+        silently drop anything appended while the upload's RPCs were in
+        flight. The cut flush skips max_backups pruning: at the cap, a
+        pruning flush would delete the oldest unuploaded file an instant
+        before the cut reads it; the upload's own discard() is what brings
+        the file count back down."""
+        self.flush(prune=False)
+        files = tuple(self._files())
+        return self.load_all(include_buffer=False), files
+
+    def discard(self, files: tuple[Path, ...]) -> None:
+        """Drop exactly the files a snapshot() returned (handed off upstream)."""
+        for p in files:
+            Path(p).unlink(missing_ok=True)
 
     def clear(self) -> None:
         for p in self._files():

@@ -9,15 +9,35 @@ metrics but no training loop, and the manager's CreateModel was a TODO stub
   train_open → train_chunk* → train_close   (the client-stream, unrolled over
   our unary RPC; chunks are npz-serialized columnar telemetry arrays)
 
-then a background task builds the dataset (trainer.dataset), trains the MLP
-bandwidth predictor (config 1) and — when probe records exist — the GraphSAGE
-topology scorer (config 2/3, sharded over whatever mesh is live), writes
-artifacts, and registers + activates versions in the manager's model registry.
+Ingest is incremental and the event loop stays free throughout:
+
+  - train_chunk folds each chunk straight into the session's
+    DatasetAccumulator (vectorized, sub-ms per announcer chunk) instead of
+    retaining raw record arrays; train_close commits the session's
+    aggregates into the shared rolling pool via merge_from — exactly-once,
+    so a failed-and-retried upload never double-counts. The pool
+    (pool_rows) is aggregated state + a bounded columnar pair pool, not a
+    list of per-session uploads, and rotates fresh past
+    pool_max_hosts/pool_max_edges.
+  - train_close never blocks the caller: the session joins a queue and one
+    background drainer serializes training runs (the scheduler's upload RPC
+    used to wait for a full prior train here).
+  - Dataset materialization and the MLP train run on worker threads; the GNN
+    runs through train_gnn.train_async, whose scan-step loop yields between
+    jitted calls — the heartbeat test pins status-RPC latency mid-train.
+  - Sessions opened but never closed are evicted past session_ttl; an
+    evicted (uncommitted) session contributes nothing to the pool.
+
+then each run trains the MLP bandwidth predictor (config 1) and — when probe
+records exist — the GraphSAGE topology scorer (config 2/3, sharded over
+whatever mesh is live), writes artifacts, and registers + activates versions
+in the manager's model registry.
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
 import io
 import logging
 import time
@@ -47,9 +67,14 @@ class TrainSession:
     token: str
     scheduler_hostname: str = ""
     scheduler_id: int = 0
-    downloads: list[np.ndarray] = field(default_factory=list)
-    probes: list[np.ndarray] = field(default_factory=list)
+    # every session folds into its OWN accumulator; train_close commits it
+    # into the shared pool (merge_from) — exactly-once, even across retries
+    acc: datasetlib.DatasetAccumulator = field(
+        default_factory=datasetlib.DatasetAccumulator
+    )
+    rows: int = 0  # running row count — O(1) per chunk, not a per-call re-sum
     opened_at: float = field(default_factory=time.time)
+    last_activity: float = field(default_factory=time.time)
 
 
 @dataclass
@@ -58,12 +83,24 @@ class TrainerConfig:
     mlp: train_mlp.MLPTrainConfig = field(default_factory=train_mlp.MLPTrainConfig)
     gnn: train_gnn.GNNTrainConfig = field(default_factory=train_gnn.GNNTrainConfig)
     gnn_steps: int = 300
+    gnn_steps_per_call: int = 10  # scan length per jitted call (loop yields between)
     min_pairs: int = 16        # skip training below this much signal
     min_probe_rows: int = 8
-    # Rolling dataset pool: sessions accumulate (newest kept up to the cap) so
-    # schedulers on short upload cadences still reach training mass; 0 = train
-    # strictly on each upload in isolation.
+    # Rolling dataset pool: uploads accumulate (newest pairs kept up to the
+    # cap) so schedulers on short upload cadences still reach training mass;
+    # 0 = train strictly on each upload in isolation.
     pool_rows: int = 500_000
+    # Host/edge aggregates can't be evicted row-wise (they're sums), so the
+    # pool is ROTATED — swapped for a fresh accumulator — once host churn
+    # pushes it past either cap. Bounds memory and per-train graph size on a
+    # long-lived trainer in a cluster with ephemeral host ids; queued
+    # sessions keep a reference to the pool they folded into, so a rotation
+    # never yanks data from an in-flight train. 0 disables.
+    pool_max_hosts: int = 65536
+    pool_max_edges: int = 1_000_000
+    # Sessions opened but never closed are dropped after this many seconds
+    # (checked at every open/close); 0 disables eviction.
+    session_ttl: float = 3600.0
 
 
 class TrainerService:
@@ -71,18 +108,22 @@ class TrainerService:
         """manager: RemoteManagerClient (or None to skip registry)."""
         self.cfg = config or TrainerConfig()
         self.manager = manager
-        self._pool_downloads: list[np.ndarray] = []
-        self._pool_probes: list[np.ndarray] = []
+        self._acc = datasetlib.DatasetAccumulator(max_pair_rows=self.cfg.pool_rows)
         self._sessions: dict[str, TrainSession] = {}
         self._next = 0
-        self._training: asyncio.Task | None = None
+        self._queue: collections.deque[TrainSession] = collections.deque()
+        self._drainer: asyncio.Task | None = None
         self.last_result: dict | None = None
         self.trains_started = 0
         self.trains_succeeded = 0
+        self.sessions_evicted = 0
+        self.pool_rotations = 0
+        self.trains_coalesced = 0
 
     # ---- RPC surface (adapter passes payload dicts straight through) ----
 
     async def train_open(self, p: dict) -> dict:
+        self._evict_stale()
         self._next += 1
         token = f"sess-{self._next}-{int(time.time())}"
         self._sessions[token] = TrainSession(
@@ -98,42 +139,112 @@ class TrainerService:
             raise KeyError(f"unknown train session {p['token']!r}")
         arr = unpack_records(p["data"])
         if p["kind"] == "downloads":
-            sess.downloads.append(arr)
+            sess.acc.add_downloads(arr)
         elif p["kind"] == "probes":
-            sess.probes.append(arr)
+            sess.acc.add_probes(arr)
         else:
             raise ValueError(f"unknown dataset kind {p['kind']!r}")
-        return {"rows": int(sum(len(a) for a in sess.downloads + sess.probes))}
+        sess.rows += len(arr)
+        sess.last_activity = time.time()
+        return {"rows": sess.rows}
 
     async def train_close(self, p: dict) -> dict:
         sess = self._sessions.pop(p["token"], None)
         if sess is None:
             raise KeyError(f"unknown train session {p['token']!r}")
-        if self._training is not None and not self._training.done():
-            # one training run at a time; a second upload queues behind it
-            await self._training
-        self.trains_started += 1
-        self._training = asyncio.ensure_future(self._train(sess))
-        return {"queued": True}
+        self._evict_stale()
+        if self.cfg.pool_rows > 0:
+            # commit the session's aggregates into the shared pool — the
+            # ONLY point session data becomes visible to training, so an
+            # upload that failed mid-stream (and will be retried in full)
+            # contributed nothing; the queued train keeps its reference to
+            # THIS pool even if a later close rotates in a fresh one
+            self._acc.merge_from(sess.acc)
+            sess.acc = self._acc
+        # never await the previous run here: queue the session and let the
+        # drainer serialize training (one run at a time) off this RPC's back
+        self._queue.append(sess)
+        if self._drainer is None or self._drainer.done():
+            self._drainer = asyncio.ensure_future(self._drain())
+        self._maybe_rotate_pool()
+        return {"queued": True, "queue_depth": len(self._queue)}
 
     async def status(self, p: Any = None) -> dict:
-        running = self._training is not None and not self._training.done()
+        running = self._drainer is not None and not self._drainer.done()
         return {
             "training": running,
+            "queue_depth": len(self._queue),
+            "open_sessions": len(self._sessions),
+            "pool_pairs": self._acc.pair_rows,
+            "pool_hosts": self._acc.num_hosts,
+            "pool_edges": self._acc.num_edges,
+            "pool_rotations": self.pool_rotations,
+            "trains_coalesced": self.trains_coalesced,
             "trains_started": self.trains_started,
             "trains_succeeded": self.trains_succeeded,
             "last_result": self.last_result,
         }
 
     async def wait_idle(self) -> None:
-        if self._training is not None:
-            await self._training
+        while self._drainer is not None and not self._drainer.done():
+            await self._drainer
+
+    # ---- session lifecycle ----
+
+    def _maybe_rotate_pool(self) -> None:
+        """Aggregates (host table, edge sums, node counters) only grow —
+        swap in a fresh pool once host churn blows past the caps. Sessions
+        already queued hold their own reference to the old pool."""
+        cfg = self.cfg
+        over_hosts = cfg.pool_max_hosts > 0 and self._acc.num_hosts > cfg.pool_max_hosts
+        over_edges = cfg.pool_max_edges > 0 and self._acc.num_edges > cfg.pool_max_edges
+        if over_hosts or over_edges:
+            logger.warning(
+                "rotating dataset pool (%d hosts, %d edges, %d pairs) — aggregate caps hit",
+                self._acc.num_hosts, self._acc.num_edges, self._acc.pair_rows,
+            )
+            self._acc = datasetlib.DatasetAccumulator(max_pair_rows=cfg.pool_rows)
+            self.pool_rotations += 1
+
+    def _evict_stale(self) -> None:
+        """Drop sessions with no traffic for session_ttl. Keyed on
+        last_activity, not opened_at — an upload legitimately streaming
+        chunks for longer than the TTL must not be yanked mid-stream."""
+        ttl = self.cfg.session_ttl
+        if ttl <= 0:
+            return
+        now = time.time()
+        stale = [t for t, s in self._sessions.items() if now - s.last_activity > ttl]
+        for token in stale:
+            sess = self._sessions.pop(token)
+            self.sessions_evicted += 1
+            logger.warning(
+                "evicting stale train session %s from %s (idle %.0fs, %d rows)",
+                token, sess.scheduler_hostname, now - sess.last_activity, sess.rows,
+            )
 
     # ---- training driver ----
 
+    async def _drain(self) -> None:
+        """Single background consumer: one training run at a time, in close
+        order. train_close re-creates the task if it ever finds it done.
+
+        Consecutive queued sessions that committed into the SAME pool are
+        coalesced into one run (the pool already aggregates all of them —
+        k closes landing during one slow train would otherwise trigger k
+        near-identical back-to-back trains); the surviving session's
+        scheduler identity is the one the registry rows carry."""
+        while self._queue:
+            sess = self._queue.popleft()
+            while self._queue and self._queue[0].acc is sess.acc:
+                sess = self._queue.popleft()
+                self.trains_coalesced += 1
+            self.trains_started += 1
+            await self._train(sess)
+
     async def _train(self, sess: TrainSession) -> None:
         try:
-            result = await asyncio.to_thread(self._train_sync, sess)
+            result = await self._run_training(sess)
             self.last_result = result
             self.trains_succeeded += 1
             if self.manager is not None:
@@ -142,62 +253,75 @@ class TrainerService:
             logger.exception("training run failed")
             self.last_result = {"error": "training failed"}
 
-    def _pool_add(self, pool: list[np.ndarray], arrays: list[np.ndarray]) -> np.ndarray:
-        pool.extend(a for a in arrays if len(a))
-        total = sum(len(a) for a in pool)
-        while len(pool) > 1 and total - len(pool[0]) >= self.cfg.pool_rows:
-            total -= len(pool.pop(0))  # evict oldest sessions beyond the cap
-        return np.concatenate(pool) if pool else np.zeros(0)
-
-    def _train_sync(self, sess: TrainSession) -> dict:
-        if self.cfg.pool_rows > 0:
-            downloads = self._pool_add(self._pool_downloads, sess.downloads)
-            probes = self._pool_add(self._pool_probes, sess.probes)
-        else:
-            downloads = np.concatenate(sess.downloads) if sess.downloads else np.zeros(0)
-            probes = np.concatenate(sess.probes) if sess.probes else np.zeros(0)
-        ds = datasetlib.build_dataset(downloads, probes)
-        version = f"v{int(time.time())}"
-        out: dict[str, Any] = {"version": version, "num_pairs": ds.num_pairs, "num_nodes": ds.num_nodes}
+    async def _run_training(self, sess: TrainSession) -> dict:
+        acc = sess.acc  # the pool it merged into at close; rotation-safe
+        t_build = time.perf_counter()
+        # freeze() is a cheap loop-side snapshot; the O(nodes+edges+pairs)
+        # materialization runs on a worker thread while chunks keep folding
+        frozen = acc.freeze()
+        ds = await asyncio.to_thread(frozen.finalize)
+        build_seconds = time.perf_counter() - t_build
+        # monotonic suffix: the drainer starts queued runs back-to-back, so
+        # two runs inside the same wall-clock second are the normal case and
+        # a bare timestamp would collide artifact dirs + registry versions
+        version = f"v{int(time.time())}-{self.trains_started}"
+        out: dict[str, Any] = {
+            "version": version,
+            "num_pairs": ds.num_pairs,
+            "num_nodes": ds.num_nodes,
+            "build_seconds": round(build_seconds, 4),
+        }
 
         if ds.num_pairs >= self.cfg.min_pairs:
             tr, ev = datasetlib.split_pairs(ds.pairs)
             t0 = time.perf_counter()
-            params, evaluation = train_mlp.train(self.cfg.mlp, tr, eval_pairs=ev, log=logger.info)
+            params, evaluation = await asyncio.to_thread(
+                train_mlp.train, self.cfg.mlp, tr, eval_pairs=ev, log=logger.info
+            )
             evaluation["train_seconds"] = round(time.perf_counter() - t0, 2)
-            path = artifacts.save_artifact(
+            path = await asyncio.to_thread(
+                artifacts.save_artifact,
                 Path(self.cfg.model_dir) / f"mlp-{version}",
                 model_type="mlp", version=version, params=params,
                 config={"hidden": list(self.cfg.mlp.hidden)},
             )
             out["mlp"] = {"artifact": str(path), "evaluation": evaluation}
 
-        if ds.num_pairs >= self.cfg.min_pairs and len(probes) >= self.cfg.min_probe_rows:
+        if ds.num_pairs >= self.cfg.min_pairs and acc.probe_rows >= self.cfg.min_probe_rows:
             cfg = self.cfg.gnn
             t0 = time.perf_counter()
-            state, losses = train_gnn.train(
-                cfg, ds.graph, ds.pairs, steps=self.cfg.gnn_steps, log=logger.info
+            state, losses = await train_gnn.train_async(
+                cfg, ds.graph, ds.pairs,
+                steps=self.cfg.gnn_steps,
+                steps_per_call=self.cfg.gnn_steps_per_call,
+                log=logger.info,
             )
+            train_seconds = time.perf_counter() - t0
             evaluation = {
                 "final_loss": losses[-1] if losses else float("nan"),
-                "steps": self.cfg.gnn_steps,
-                "train_seconds": round(time.perf_counter() - t0, 2),
-                "steps_per_sec": round(self.cfg.gnn_steps / max(1e-9, time.perf_counter() - t0), 2),
+                "steps": len(losses),
+                "train_seconds": round(train_seconds, 2),
+                "steps_per_sec": round(len(losses) / max(1e-9, train_seconds), 2),
             }
-            path = artifacts.save_artifact(
-                Path(self.cfg.model_dir) / f"gnn-{version}",
-                model_type="gnn", version=version, params=state.params,
-                config={
-                    "hidden": cfg.hidden, "embed_dim": cfg.embed_dim,
-                    "num_layers": cfg.num_layers,
-                },
-            )
-            artifacts.save_graph(path, ds.graph, ds.host_index)
-            try:
-                artifacts.save_native(path, train_gnn.make_model(cfg), state.params, ds.graph)
-            except Exception:
-                # native serving is an optimization; the flax artifact always works
-                logger.exception("native scorer export failed; flax artifact only")
+
+            def _save_gnn() -> Path:
+                path = artifacts.save_artifact(
+                    Path(self.cfg.model_dir) / f"gnn-{version}",
+                    model_type="gnn", version=version, params=state.params,
+                    config={
+                        "hidden": cfg.hidden, "embed_dim": cfg.embed_dim,
+                        "num_layers": cfg.num_layers,
+                    },
+                )
+                artifacts.save_graph(path, ds.graph, ds.host_index)
+                try:
+                    artifacts.save_native(path, train_gnn.make_model(cfg), state.params, ds.graph)
+                except Exception:
+                    # native serving is an optimization; the flax artifact always works
+                    logger.exception("native scorer export failed; flax artifact only")
+                return path
+
+            path = await asyncio.to_thread(_save_gnn)
             out["gnn"] = {"artifact": str(path), "evaluation": evaluation}
         return out
 

@@ -142,6 +142,47 @@ def make_cluster(
     return SyntheticCluster(graph, pairs, capacity, idc)
 
 
+def synth_telemetry_records(
+    n_downloads: int,
+    n_probes: int,
+    n_hosts: int,
+    seed: int = 0,
+    *,
+    frac_failed: float = 0.05,
+    frac_no_parent: float = 0.05,
+    rtt_grid: float | None = None,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Plausible raw telemetry (DOWNLOAD_DTYPE + PROBE_DTYPE structured
+    arrays), generated vectorized — the ingest bench and the equivalence
+    suite share this one generator so they can never drift apart. rtt_grid
+    quantizes RTTs to multiples of `rtt_grid`, making per-edge means exact
+    in float32 AND float64 (deterministic sort tie-breaks)."""
+    from dragonfly2_tpu.telemetry.records import DOWNLOAD_DTYPE, PROBE_DTYPE
+
+    rng = np.random.default_rng(seed)
+    hosts = np.array([f"host-{i:06d}".encode() for i in range(n_hosts)], dtype="S64")
+    d = np.zeros(n_downloads, DOWNLOAD_DTYPE)
+    if n_downloads:
+        d["child_host_id"] = hosts[rng.integers(0, n_hosts, n_downloads)]
+        d["parent_host_id"] = hosts[rng.integers(0, n_hosts, n_downloads)]
+        d["parent_host_id"][rng.random(n_downloads) < frac_no_parent] = b""
+        d["success"] = rng.random(n_downloads) > frac_failed
+        d["bandwidth_bps"] = rng.lognormal(19.0, 1.5, n_downloads).astype(np.float32)
+        d["pair_features"] = rng.random((n_downloads, 16)).astype(np.float32)
+    p = np.zeros(n_probes, PROBE_DTYPE)
+    if n_probes:
+        p["src_host_id"] = hosts[rng.integers(0, n_hosts, n_probes)]
+        p["dst_host_id"] = hosts[rng.integers(0, n_hosts, n_probes)]
+        rtts = rng.random(n_probes) * 50
+        if rtt_grid is not None:
+            rtts = np.round(rtts / rtt_grid) * rtt_grid
+        p["rtt_mean_ms"] = rtts.astype(np.float32)
+        p["rtt_std_ms"] = (rng.random(n_probes) * 5).astype(np.float32)
+        p["rtt_min_ms"] = (rng.random(n_probes) * 20).astype(np.float32)
+        p["probe_count"] = rng.integers(1, 40, n_probes)
+    return d, p
+
+
 def sample_batch(pairs: PairBatch, batch_size: int, rng: np.random.Generator) -> PairBatch:
     idx = rng.integers(0, len(pairs.child), size=batch_size)
     return PairBatch(pairs.child[idx], pairs.parent[idx], pairs.feats[idx], pairs.label[idx])

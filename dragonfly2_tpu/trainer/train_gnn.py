@@ -13,6 +13,7 @@ received CSV chunks and dropped them on the floor).
 
 from __future__ import annotations
 
+import asyncio
 import time
 from dataclasses import dataclass
 from functools import partial
@@ -209,6 +210,63 @@ def make_scan_step(
         out_shardings=(state_sh, NamedSharding(mesh, P())),
         donate_argnums=(0,),
     )
+
+
+async def train_async(
+    cfg: GNNTrainConfig,
+    graph: TopoGraph,
+    pairs: PairBatch,
+    *,
+    steps: int,
+    mesh: Mesh | None = None,
+    seed: int = 0,
+    steps_per_call: int = 10,
+    log_every: int = 100,
+    log: Callable[[str], None] = lambda s: None,
+) -> tuple[train_state.TrainState, list[float]]:
+    """Cooperative training driver for asyncio hosts (the trainer service).
+
+    Uses the device-resident scan path: each jitted `steps_per_call`-step
+    call runs in a worker thread (asyncio.to_thread) and the event loop
+    regains control between calls, so the host keeps answering RPCs
+    mid-train instead of stalling for the whole run. Setup (init + placement
+    + the compile triggered by the first call) runs in the worker too — the
+    loop never blocks on XLA. Returns (state, per-step losses); loss length
+    is steps rounded up to a whole number of calls.
+    """
+    mesh = mesh or meshlib.make_mesh()
+    steps_per_call = max(1, min(steps_per_call, steps))
+    calls = -(-steps // steps_per_call)
+
+    def _setup():
+        state = init_state(cfg, graph, seed)
+        return shard_for_training_scan(
+            state, graph, pairs, mesh,
+            batch_size=cfg.batch_size, steps_per_call=steps_per_call,
+        )
+
+    state, g, pool, multi_step = await asyncio.to_thread(_setup)
+    key = jax.random.PRNGKey(seed)
+
+    def _one_call(st, k):
+        k, sub = jax.random.split(k)
+        st, ls = multi_step(st, g, pool, sub)
+        # D2H pull materializes the whole call's chain before returning to
+        # the loop — the same sync discipline the bench windows use
+        return st, k, np.asarray(ls)
+
+    losses: list[float] = []
+    t0 = time.perf_counter()
+    for i in range(calls):
+        state, key, ls = await asyncio.to_thread(_one_call, state, key)
+        losses.extend(float(x) for x in ls)
+        done = len(losses)
+        if done % log_every < steps_per_call or i == calls - 1:
+            log(
+                f"step {done}/{calls * steps_per_call} loss={losses[-1]:.5f} "
+                f"({done / (time.perf_counter() - t0):.2f} steps/s)"
+            )
+    return state, losses
 
 
 def train(

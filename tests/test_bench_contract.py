@@ -27,6 +27,23 @@ def test_gnn_train_measured_contract():
     assert conv != 0
 
 
+def test_dataset_build_contract():
+    # tiny shapes: the contract is the key set and the A/B wiring, not the
+    # (tier-1-hostile) 100k-row default the real bench runs
+    out = bench.bench_dataset_build(n_downloads=2000, n_probes=500, n_hosts=64)
+    for key in (
+        "dataset_build_rows_per_sec", "rowloop_rows_per_sec",
+        "speedup_vs_rowloop", "chunk_fold_rows_per_sec",
+        "ingest_to_train_start_ms", "num_nodes", "num_pairs",
+    ):
+        assert key in out, key
+    assert out["rows"] == 2500
+    assert out["dataset_build_rows_per_sec"] > 0
+    assert out["rowloop_rows_per_sec"] > 0
+    assert out["speedup_vs_rowloop"] > 0
+    assert out["num_nodes"] >= 64
+
+
 def test_payload_schema():
     line = bench._payload(1234.5, {"backend": "cpu"})
     d = json.loads(line)

@@ -578,6 +578,94 @@ def test_df032_silent_on_none_and_immutable_defaults():
 
 
 # ---------------------------------------------------------------------------
+# DF033 per-row numpy construction in a loop
+
+
+def test_df033_fires_on_per_row_construction():
+    src = """
+    import numpy as np
+
+    def f(rows):
+        out = []
+        for row in rows:
+            out.append(np.asarray(row["pair_features"], np.float32))
+        return np.stack(out)
+    """
+    vs = dflint.lint_source(textwrap.dedent(src), "m.py")
+    assert [v.check for v in vs] == ["DF033"]
+    assert vs[0].line == 7
+
+
+def test_df033_fires_on_array_stack_and_tuple_targets():
+    src = """
+    import numpy as np
+
+    def f(probes, groups):
+        for (s, d), stats in groups.items():
+            agg = np.stack(stats)
+        for row in probes:
+            v = np.array([row["a"], row["b"]], np.float32)
+    """
+    vs = dflint.lint_source(textwrap.dedent(src), "m.py")
+    assert [v.check for v in vs] == ["DF033", "DF033"]
+
+
+def test_df033_sees_from_import_alias():
+    src = """
+    from numpy import asarray
+
+    def f(rows):
+        for row in rows:
+            x = asarray(row)
+    """
+    assert ids(src) == ["DF033"]
+
+
+def test_df033_silent_without_loop_var_or_loop():
+    src = """
+    import numpy as np
+
+    SCALE = np.array([1.0, 2.0])
+
+    def f(rows, template):
+        hoisted = np.asarray(template, np.float32)  # loop-invariant, hoistable
+        for row in rows:
+            total = np.array(template)  # not derived from the row
+            consume(row, total)
+        i = 0
+        while i < 10:
+            i += 1
+        return np.stack([hoisted])
+    """
+    assert ids(src) == []
+
+
+def test_df033_silent_in_for_else_block():
+    src = """
+    import numpy as np
+
+    def f(rows):
+        for row in rows:
+            consume(row)
+        else:
+            summary = np.array(row)  # runs once after the loop, not per row
+        return summary
+    """
+    assert ids(src) == []
+
+
+def test_df033_suppression_with_reason():
+    src = """
+    import numpy as np
+
+    def f(rows):
+        for row in rows:
+            x = np.asarray(row)  # dflint: disable=DF033 rowloop reference
+    """
+    assert ids(src) == []
+
+
+# ---------------------------------------------------------------------------
 # suppression handling
 
 

@@ -36,6 +36,10 @@ Checks (see README.md "Static analysis" for the catalog):
   DF031  silent exception swallow: bare/overbroad except whose body is only
          pass/continue/... (no log, no narrowing)
   DF032  mutable default argument (list/dict/set literal or constructor)
+  DF033  np.array/np.asarray/np.stack of loop-variable-derived data inside a
+         for loop — the numpy twin of DF012: one tiny allocation per row
+         turns a columnar pass into O(rows) Python (vectorize with field
+         slicing, unique/bincount/reduceat instead)
 
 Suppression:
   - same line:   <code>  # dflint: disable=DF023 <reason>   (comma-separate ids;
@@ -70,7 +74,13 @@ CHECKS: dict[str, str] = {
     "DF024": "raw asyncio.sleep retry loop outside the resilience module",
     "DF031": "bare/overbroad except silently swallowing the error",
     "DF032": "mutable default argument",
+    "DF033": "per-row numpy array construction inside a for loop (vectorize)",
 }
+
+# numpy constructors whose per-row use inside a loop marks an unvectorized
+# pass (DF033). Canonical dotted names; `import numpy as np` and from-imports
+# resolve through import_aliases.
+NP_ROW_CTORS = {"numpy.array", "numpy.asarray", "numpy.stack"}
 
 # Packages where Python-loop-over-jnp is an unrolled-graph hazard (DF012).
 JNP_LOOP_DIRS = {"ops", "models", "parallel"}
@@ -728,6 +738,49 @@ def check_silent_swallow(tree: ast.Module, path: str) -> Iterator[Violation]:
             )
 
 
+def check_np_ctor_in_row_loop(tree: ast.Module, path: str) -> Iterator[Violation]:
+    """DF033: numpy array construction from per-row data inside a for loop.
+
+    Fires when np.array/np.asarray/np.stack is called inside a for loop with
+    an argument that references the loop's induction variable — the
+    `np.asarray(row[...])`-per-row shape that made build_dataset O(rows) in
+    Python. Calls whose arguments don't involve the loop variable (hoistable
+    constants, accumulators) are not flagged, nor are while loops (no row
+    variable to derive from), comprehensions, or the for-else block (it runs
+    once after the loop, not per iteration)."""
+    aliases = import_aliases(tree)
+    seen: set[tuple[int, int]] = set()  # nested loops walk shared bodies
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor)):
+            continue
+        induction = {n.id for n in ast.walk(loop.target) if isinstance(n, ast.Name)}
+        if not induction:
+            continue
+        for stmt in loop.body:
+            for node in walk_pruned(stmt):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _resolved_call_name(node, aliases)
+                if name not in NP_ROW_CTORS:
+                    continue
+                arg_names: set[str] = set()
+                for a in list(node.args) + [kw.value for kw in node.keywords]:
+                    arg_names |= {n.id for n in ast.walk(a) if isinstance(n, ast.Name)}
+                if not (induction & arg_names):
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield Violation(
+                    path, node.lineno, node.col_offset, "DF033",
+                    f"{_call_name(node)}() builds an array from loop variable "
+                    f"{sorted(induction & arg_names)[0]!r} every iteration — "
+                    "vectorize the pass (field slicing, np.unique/bincount/"
+                    "reduceat) instead of per-row construction",
+                )
+
+
 _MUTABLE_CTORS = {
     "list", "dict", "set", "bytearray", "collections.defaultdict",
     "defaultdict", "collections.deque", "deque", "collections.OrderedDict",
@@ -767,6 +820,7 @@ ALL_CHECKS = (
     check_raw_retry_sleep,
     check_silent_swallow,
     check_mutable_defaults,
+    check_np_ctor_in_row_loop,
 )
 
 
