@@ -58,7 +58,7 @@ async def run_stress(args: argparse.Namespace) -> dict:
                 return
             t0 = time.monotonic()
             try:
-                await client.call(
+                await client.call(  # dflint: disable=DF025 load generator: one RPC per iteration IS the workload being measured
                     "download", {"url": url, "output": None}, timeout=args.timeout
                 )
                 latencies.append(time.monotonic() - t0)

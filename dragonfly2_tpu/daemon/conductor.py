@@ -63,10 +63,117 @@ class SchedulerClient(Protocol):
                                    direct_piece: bytes = b"") -> None: ...
     async def report_piece_result(self, peer_id: str, piece_index: int, *, success: bool,
                                   cost_ms: float = 0.0, parent_id: str = "") -> None: ...
+    async def report_pieces(self, peer_id: str, reports) -> int: ...
     async def report_peer_result(self, peer_id: str, *, success: bool,
                                  bandwidth_bps: float = 0.0) -> None: ...
     async def reschedule(self, peer_id: str) -> RegisterResult: ...
     async def leave_peer(self, peer_id: str) -> None: ...
+
+
+class PieceReportBuffer:
+    """Per-conductor buffer of SUCCESSFUL piece reports, flushed through the
+    report_pieces batch RPC — the control-plane fast path that replaces one
+    awaited report_piece_result round trip per piece in the piece-worker
+    path. Failed pieces never enter the buffer: they drive rescheduling and
+    are reported individually and promptly by the caller.
+
+    Flush triggers: buffer reaches max_batch (spawned task), first add into
+    an empty buffer arms a flush_interval timer (bounds report staleness for
+    long rounds), the conductor flushes at dispatch-round end, and close()
+    flushes at task completion (before report_peer_result, so the
+    scheduler's telemetry sees the full finished set).
+
+    Exactly-once under rpc.write faults: flush() atomically takes the
+    buffered triples and awaits ONE report_pieces call; the rpc client
+    retries connection-level failures (an injected rpc.write fault raises
+    before the frame leaves, so a retry cannot double-deliver — and a
+    timeout AFTER a server-side apply re-applies as a no-op because the
+    scheduler's apply is idempotent per piece index). If the call fails past
+    the client's retry budget the triples are merged back for the next flush
+    — piece accounting is never dropped, matching the at-least-once goal the
+    chaos suite pins."""
+
+    def __init__(self, scheduler, peer_id: str, *, max_batch: int = 64,
+                 flush_interval: float = 0.25, log=None):
+        self._sched = scheduler
+        self.peer_id = peer_id
+        self.max_batch = max_batch
+        self.flush_interval = flush_interval
+        self.log = log or logger
+        self._buf: list[tuple[int, float, str]] = []
+        self._timer: asyncio.Task | None = None
+        self._lock = asyncio.Lock()  # serializes flushes (ordering + no double-take)
+        self._size_flushes: set[asyncio.Task] = set()
+        self.rpcs = 0  # report_pieces calls that completed (bench/test counter)
+        self.buffered = 0  # pieces that rode a batch instead of a unary RPC
+
+    def add(self, piece_index: int, cost_ms: float = 0.0, parent_id: str = "") -> None:
+        """Enqueue one successful piece report. Sync — the piece worker goes
+        straight back to its queue; no RPC await on the piece path."""
+        self._buf.append((piece_index, cost_ms, parent_id))  # dflint: disable=DF023 loop-thread append, no await around it; the lock serializes FLUSHES, not enqueues
+        self.buffered += 1
+        if len(self._buf) >= self.max_batch:
+            t = asyncio.ensure_future(self.flush())
+            self._size_flushes.add(t)
+            t.add_done_callback(self._size_flushes.discard)
+        elif self._timer is None or self._timer.done():
+            self._timer = asyncio.ensure_future(self._timer_flush())
+
+    async def _timer_flush(self) -> None:
+        await asyncio.sleep(self.flush_interval)
+        await self.flush()
+
+    async def flush(self) -> None:
+        """Drain the buffer in one report_pieces RPC (or a few, if adds land
+        while a flush is in flight). Never raises on RPC failure: a flush
+        that fails past the rpc client's retries re-merges its batch and
+        leaves recovery to the next trigger. Cancellation (aclose cancelling
+        the staleness timer mid-flush) also re-merges before propagating —
+        the taken batch must never ride out of scope with the exception, or
+        the close flush would snapshot an incomplete finished set."""
+        async with self._lock:
+            while self._buf:
+                batch, self._buf = self._buf, []
+                try:
+                    await self._sched.report_pieces(self.peer_id, batch)  # dflint: disable=DF025 this IS the batch flush; the loop only drains reports that arrived during the awaited call
+                    self.rpcs += 1
+                except Exception as e:  # noqa: BLE001 — advisory accounting:
+                    # keep the pieces for the next flush trigger; the download
+                    # itself must never fail on a report (same contract as the
+                    # unbatched path's debug-logged best-effort reports)
+                    self._buf = batch + self._buf
+                    self.log.debug("piece-report flush of %d failed: %r", len(batch), e)
+                    return
+                except BaseException:
+                    # CancelledError is a BaseException since 3.8: without this
+                    # re-merge a timer task cancelled at the awaited RPC would
+                    # lose its taken batch silently (a server-side apply that
+                    # already landed re-applies as a no-op — idempotent).
+                    self._buf = batch + self._buf
+                    raise
+
+    async def aclose(self) -> None:
+        """Task-completion flush; cancels the staleness timer.
+
+        Unlike mid-round flushes (which can leave failures to the next
+        trigger), this is the LAST trigger: a flush that fails past the rpc
+        client's retries gets a few more backed-off attempts here, because
+        dropping the residue would lose piece accounting at exactly the
+        moment report_peer_result snapshots the finished set into telemetry
+        (the chaos suite pins no-loss under rpc.write faults)."""
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        backoff = BackoffPolicy(base=0.05, max_delay=1.0)
+        for attempt in range(4):
+            if attempt:
+                await backoff.sleep(attempt - 1)
+            await self.flush()
+            if not self._buf:
+                return
+        self.log.warning(
+            "dropping %d unreported piece results at task close", len(self._buf)
+        )
 
 
 @dataclass
@@ -162,6 +269,14 @@ class ConductorConfig:
     # Ranged back-to-source: per-piece fetch retries before the whole task
     # fails (origin blips must not kill a 95%-done download).
     source_piece_retries: int = 3
+    # Successful piece reports batch through the report_pieces RPC (one
+    # flush per dispatch round / flush interval instead of one awaited
+    # round trip per piece); failed pieces always report individually and
+    # immediately (they drive rescheduling). Disable to get the r05 unary
+    # path (the chaos suite's equivalence baseline).
+    batch_piece_reports: bool = True
+    report_batch_size: int = 64
+    report_flush_interval: float = 0.25
     # Hand filled piece buffers to writer tasks WITHOUT awaiting them, so one
     # worker pipelines recv of piece N+1 into the store write of piece N.
     # Default OFF: on the 2-core CI image the piece-worker pool already
@@ -250,6 +365,17 @@ class PeerTaskConductor:
             jitter=0.5,
         )
         self._piece_errors: dict[int, int] = {}  # index -> worker-level failures
+        # Successful piece reports ride a per-conductor batch buffer when the
+        # client speaks report_pieces (all shipped clients do; test fakes may
+        # not — they get the unary path).
+        self._reports: PieceReportBuffer | None = None
+        if self.cfg.batch_piece_reports and hasattr(scheduler, "report_pieces"):
+            self._reports = PieceReportBuffer(
+                scheduler, peer_id,
+                max_batch=self.cfg.report_batch_size,
+                flush_interval=self.cfg.report_flush_interval,
+                log=self.log,
+            )
 
     # ---- entry ----
 
@@ -445,14 +571,7 @@ class PeerTaskConductor:
             # to sum to the task's total
             metrics.PIECE_DOWNLOAD_TOTAL.inc(source="back_to_source")
             metrics.DOWNLOAD_BYTES.inc(r.length)
-            try:
-                await self.scheduler.report_piece_result(
-                    self.peer_id, idx, success=True, cost_ms=(time.monotonic() - t0) * 1000
-                )
-            except Exception as e:  # noqa: BLE001 — the piece IS on disk; a
-                # failed advisory report must neither re-download it (double
-                # counting) nor fail a nearly-done task
-                self.log.debug("piece %d source report failed: %r", idx, e)
+            await self._report_piece_success(idx, (time.monotonic() - t0) * 1000)
 
         async def fetch(idx: int) -> None:
             # Pieces retry independently with exponential backoff: an origin
@@ -508,9 +627,7 @@ class PeerTaskConductor:
         self.bytes_from_source += len(data)
         metrics.PIECE_DOWNLOAD_TOTAL.inc(source="back_to_source")
         metrics.DOWNLOAD_BYTES.inc(len(data))
-        await self.scheduler.report_piece_result(
-            self.peer_id, idx, success=True, cost_ms=(time.monotonic() - t0) * 1000
-        )
+        await self._report_piece_success(idx, (time.monotonic() - t0) * 1000)
 
     async def _download_source_unknown_length(self, info) -> None:
         """Origin without Content-Length: stream whole body, then size pieces."""
@@ -557,7 +674,7 @@ class PeerTaskConductor:
                         if reschedules > self.cfg.reschedule_limit:
                             await self._download_back_to_source()
                             return
-                        reg = await self.scheduler.reschedule(self.peer_id)
+                        reg = await self.scheduler.reschedule(self.peer_id)  # dflint: disable=DF025 one budget-bounded reschedule per empty dispatch round, not per-item chatter
                         if reg.back_to_source:
                             await self._download_back_to_source()
                             return
@@ -587,7 +704,7 @@ class PeerTaskConductor:
                         await self._download_back_to_source()
                         return
                     reschedules += 1
-                    reg = await self.scheduler.reschedule(self.peer_id)
+                    reg = await self.scheduler.reschedule(self.peer_id)  # dflint: disable=DF025 one budget-bounded reschedule per no-progress window, not per-item chatter
                     if reg.back_to_source:
                         await self._download_back_to_source()
                         return
@@ -611,6 +728,11 @@ class PeerTaskConductor:
                 # re-reads the bitset, or still-in-flight pieces would look
                 # missing and be refetched
                 await self._drain_writes()
+                # dispatch-round-end flush: the scheduler learns this round's
+                # pieces in ONE report_pieces RPC (≤1 flush per round unless
+                # the size/interval triggers fired mid-round)
+                if self._reports is not None:
+                    await self._reports.flush()
                 last_update = time.monotonic()
         finally:
             await self._drain_writes()
@@ -762,7 +884,7 @@ class PeerTaskConductor:
                         "piece %d failed past the re-enqueue budget", idx, exc_info=True
                     )
                     try:
-                        await self.scheduler.report_piece_result(
+                        await self.scheduler.report_piece_result(  # dflint: disable=DF025 failed pieces report individually BY DESIGN (they drive rescheduling promptly); successes batch via PieceReportBuffer
                             self.peer_id, idx, success=False
                         )
                     except Exception as report_err:  # noqa: BLE001 — the report is
@@ -936,13 +1058,21 @@ class PeerTaskConductor:
 
         metrics.PIECE_DOWNLOAD_TOTAL.inc(source="parent")
         metrics.DOWNLOAD_BYTES.inc(length)
+        await self._report_piece_success(idx, cost, state.info.peer_id)
+
+    async def _report_piece_success(self, idx: int, cost_ms: float, parent_id: str = "") -> None:
+        """Success-report fast path: enqueue into the batch buffer (sync, no
+        RPC on the piece path) or fall back to the unary best-effort report.
+        Either way a landed piece is never failed by its report (the
+        worker-level catch would re-enqueue a piece that needs no refetch)."""
+        if self._reports is not None:
+            self._reports.add(idx, cost_ms, parent_id)
+            return
         try:
             await self.scheduler.report_piece_result(
-                self.peer_id, idx, success=True, cost_ms=cost, parent_id=state.info.peer_id
+                self.peer_id, idx, success=True, cost_ms=cost_ms, parent_id=parent_id
             )
-        except Exception as e:  # noqa: BLE001 — the piece IS on disk; a failed
-            # advisory report must not fail a landed piece (the worker-level
-            # catch would re-enqueue a piece that needs no refetch)
+        except Exception as e:  # noqa: BLE001 — advisory report; the piece IS on disk
             self.log.debug("piece %d success report failed: %r", idx, e)
 
     async def _drain_writes(self) -> None:
@@ -984,6 +1114,11 @@ class PeerTaskConductor:
         if self._peer_reported:  # failure paths raise after reporting: once only
             return
         self._peer_reported = True
+        if self._reports is not None:
+            # task-completion flush BEFORE the peer result: report_peer_result
+            # snapshots the peer's finished set into telemetry, so buffered
+            # pieces must land first
+            await self._reports.aclose()
         elapsed = max(1e-6, time.monotonic() - self._t0)
         bw = (self.bytes_from_parents + self.bytes_from_source) / elapsed
         try:

@@ -52,8 +52,8 @@ class InProcessSchedulerClient:
             peer_id, piece_index, success=success, cost_ms=cost_ms, parent_id=parent_id
         )
 
-    async def report_pieces(self, peer_id, piece_indices, *, cost_ms=0.0):
-        self._svc.report_pieces(peer_id, list(piece_indices), cost_ms=cost_ms)
+    async def report_pieces(self, peer_id, reports):
+        return self._svc.report_pieces(peer_id, list(reports))
 
     async def announce_task(self, peer_id, meta, host, *, content_length, piece_size, piece_indices, digest=""):
         self._svc.announce_task(

@@ -373,7 +373,7 @@ async def run_daemon(
         AnnounceHost to scheduler + keepalive to manager)."""
         while True:
             try:
-                await scheduler.announce_host(engine.host_info(), _host_stats())
+                await scheduler.announce_host(engine.host_info(), _host_stats())  # dflint: disable=DF025 periodic keepalive schedule (one announce per interval), not per-item fan-out
             except Exception:
                 logger.warning("announce failed", exc_info=True)
             if manager is not None:

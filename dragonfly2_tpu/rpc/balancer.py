@@ -216,8 +216,8 @@ class BalancedSchedulerClient:
     async def report_piece_result(self, peer_id, piece_index, **kw):
         await self._for_peer(peer_id).report_piece_result(peer_id, piece_index, **kw)
 
-    async def report_pieces(self, peer_id, piece_indices, **kw):
-        await self._for_peer(peer_id).report_pieces(peer_id, piece_indices, **kw)
+    async def report_pieces(self, peer_id, reports):
+        return await self._for_peer(peer_id).report_pieces(peer_id, reports)
 
     async def announce_task(self, peer_id, meta, host, **kw):
         addr = self._owner_for_task(meta.task_id)

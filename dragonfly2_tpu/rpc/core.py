@@ -79,18 +79,74 @@ async def _read_frame(reader: asyncio.StreamReader) -> dict:
     return msgpack.unpackb(body, raw=False)
 
 
-def _write_frame(writer: asyncio.StreamWriter, msg: dict) -> None:
-    if faultline.ACTIVE is not None:
-        faultline.ACTIVE.check("rpc.write")
-    body = msgpack.packb(msg, use_bin_type=True)
-    header = _LEN.pack(len(body))
-    if len(body) >= _BIG_FRAME:
-        # two buffered writes: skips concatenating a multi-MB body with its
-        # 4-byte header (a full-frame copy per direct-piece/piece-body frame)
-        writer.write(header)
-        writer.write(body)
-    else:
-        writer.write(header + body)
+class WriteCoalescer:
+    """Per-connection outbound frame queue: control-plane frames coalesce
+    into one writer.write + ONE drain per event-loop batch instead of a
+    write+drain round trip per call.
+
+    send() packs and enqueues synchronously — the faultline `rpc.write`
+    injection point fires HERE, per frame, so chaos semantics are unchanged
+    (an injected fault raises to the caller before the frame is queued, and
+    the rpc client's retry path owns recovery exactly as before). A single
+    flusher task drains the queue: consecutive small frames are joined into
+    one write, frames at/above _BIG_FRAME keep their two-buffer zero-concat
+    write, and ordering is queue order. Every frame enqueued while a drain()
+    is parked rides the next batch — under concurrent request load (piece
+    workers, batched report flushes, server responses) that turns N
+    write+drain pairs per loop iteration into one.
+
+    Nobody holds a lock across drain() anymore: enqueue is synchronous on
+    the loop thread, and backpressure is the flusher awaiting drain before
+    taking the next batch (the transport's high-water mark parks exactly the
+    writes that need parking, not every caller)."""
+
+    __slots__ = ("_writer", "_chunks", "_task")
+
+    def __init__(self, writer: asyncio.StreamWriter):
+        self._writer = writer
+        self._chunks: list[bytes] = []
+        self._task: asyncio.Task | None = None
+
+    def send(self, msg: dict) -> None:
+        if faultline.ACTIVE is not None:
+            faultline.ACTIVE.check("rpc.write")
+        body = msgpack.packb(msg, use_bin_type=True)
+        header = _LEN.pack(len(body))
+        if len(body) >= _BIG_FRAME:
+            # kept as separate chunks: the flusher writes them without the
+            # header+body concatenation copy (a full-frame copy per
+            # direct-piece/piece-body frame otherwise)
+            self._chunks.append(header)
+            self._chunks.append(body)
+        else:
+            self._chunks.append(header + body)
+        if self._task is None or self._task.done():
+            self._task = asyncio.ensure_future(self._drain_loop())
+
+    async def _drain_loop(self) -> None:
+        try:
+            while self._chunks:
+                chunks, self._chunks = self._chunks, []
+                if self._writer.is_closing():
+                    return
+                run: list[bytes] = []  # consecutive small frames to join
+                for c in chunks:
+                    if len(c) >= _BIG_FRAME:
+                        if run:
+                            self._writer.write(run[0] if len(run) == 1 else b"".join(run))
+                            run.clear()
+                        self._writer.write(c)
+                    else:
+                        run.append(c)
+                if run:
+                    self._writer.write(run[0] if len(run) == 1 else b"".join(run))
+                await self._writer.drain()
+        except (ConnectionError, OSError) as e:
+            # peer gone mid-write (reset/broken pipe): close the transport so
+            # the recv side fails pending calls NOW; retry paths own recovery
+            logger.debug("coalesced write failed: %r", e)
+            self._chunks.clear()
+            self._writer.close()
 
 
 Handler = Callable[[Any], Awaitable[Any]]
@@ -188,10 +244,12 @@ class RpcServer:
 
     async def _on_conn(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter) -> None:
         tasks: set[asyncio.Task] = set()
-        # Created inside the connection coroutine, so it binds to the serving
-        # loop (dflint DF021 audit: per-connection scope is the correct place;
-        # a module/class-scope lock would bind to whichever loop imported us).
-        write_lock = asyncio.Lock()
+        # One coalescer per connection (created inside the connection
+        # coroutine, so its flusher binds to the serving loop). Concurrent
+        # handler responses enqueue synchronously and ride one write+drain
+        # per loop batch — the old per-connection write lock held across
+        # drain() serialized every responder behind the slowest flush.
+        wq = WriteCoalescer(writer)
         self._conns.add(writer)
         try:
             while True:
@@ -205,7 +263,7 @@ class RpcServer:
                 if not isinstance(msg, dict):
                     logger.warning("malformed frame (%s), closing connection", type(msg).__name__)
                     break
-                t = asyncio.ensure_future(self._dispatch(msg, writer, write_lock))
+                t = asyncio.ensure_future(self._dispatch(msg, wq))
                 tasks.add(t)
                 t.add_done_callback(tasks.discard)
         finally:
@@ -218,9 +276,7 @@ class RpcServer:
             except (ConnectionResetError, BrokenPipeError, OSError):
                 pass
 
-    async def _dispatch(
-        self, msg: dict, writer: asyncio.StreamWriter, write_lock: asyncio.Lock
-    ) -> None:
+    async def _dispatch(self, msg: dict, wq: WriteCoalescer) -> None:
         rid = msg.get("i")
         method = msg.get("m", "")
         handler = self._handlers.get(method)
@@ -237,14 +293,12 @@ class RpcServer:
             except Exception as e:
                 logger.exception("rpc handler %s failed", method)
                 out = {"i": rid, "e": {"code": "internal", "message": f"{type(e).__name__}: {e}"}}
-        async with write_lock:
-            try:
-                _write_frame(writer, out)
-                await writer.drain()
-            except OSError as e:
-                # peer gone mid-response (reset/broken pipe) or an injected
-                # rpc.write fault: the client's retry path owns recovery
-                logger.debug("response write for %s failed: %r", method, e)
+        try:
+            wq.send(out)
+        except OSError as e:
+            # an injected rpc.write fault (or a dead transport caught at
+            # enqueue): the client's retry path owns recovery
+            logger.debug("response write for %s failed: %r", method, e)
 
 
 class RpcClient:
@@ -274,6 +328,7 @@ class RpcClient:
         self.ssl = ssl  # ssl.SSLContext (security.ca.client_ssl_context)
         self._reader: asyncio.StreamReader | None = None
         self._writer: asyncio.StreamWriter | None = None
+        self._wq: WriteCoalescer | None = None
         self._pending: dict[int, asyncio.Future] = {}
         self._next_id = 0
         self._recv_task: asyncio.Task | None = None
@@ -302,6 +357,7 @@ class RpcClient:
                 self._reader, self._writer = await asyncio.open_connection(
                     host, int(port), ssl=self.ssl
                 )
+            self._wq = WriteCoalescer(self._writer)
             self._recv_task = asyncio.ensure_future(self._recv_loop(self._reader))
 
     async def _recv_loop(self, reader: asyncio.StreamReader) -> None:
@@ -336,6 +392,7 @@ class RpcClient:
                 # so they cannot interleave with a _connect() holding the lock
                 # — and the `is reader` guard above pins the incarnation.
                 self._reader = self._writer = None  # dflint: disable=DF023 loop-thread reset, no await around it
+                self._wq = None  # dflint: disable=DF023 loop-thread reset, no await around it
                 self._recv_task = None  # dflint: disable=DF023 loop-thread reset, no await around it
 
     def _effective_timeout(self, timeout: float | None, method: str) -> float:
@@ -400,8 +457,10 @@ class RpcClient:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
         try:
-            _write_frame(self._writer, {"i": rid, "m": method, "p": payload})
-            await self._writer.drain()
+            # enqueue is synchronous (injected rpc.write faults raise HERE and
+            # feed the retry path); the coalescer's flusher owns the drain, so
+            # concurrent calls in one loop batch share a single write+drain
+            self._wq.send({"i": rid, "m": method, "p": payload})
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
             self._pending.pop(rid, None)
@@ -418,6 +477,7 @@ class RpcClient:
         if self._writer is not None:
             self._writer.close()
         self._reader = self._writer = None  # dflint: disable=DF023 sync method, atomic on the loop thread
+        self._wq = None  # dflint: disable=DF023 sync method, atomic on the loop thread
 
     async def close(self) -> None:
         writer = self._writer

@@ -77,8 +77,17 @@ class SchedulerRpcAdapter:
             parent_id=p.get("parent_id", ""),
         )
 
-    async def report_pieces(self, p: dict) -> None:
-        self.svc.report_pieces(p["peer_id"], p["piece_indices"], cost_ms=p.get("cost_ms", 0.0))
+    async def report_pieces(self, p: dict) -> int:
+        # triples arrive as msgpack lists; the applied count rides back so
+        # callers (and tests) can observe idempotent re-applies
+        if "reports" in p:
+            reports = p["reports"]
+        else:
+            # r05 wire shape (flat index list + one shared cost): accept it —
+            # a rolling upgrade must not silently zero an old daemon's batch
+            # (a payload with NEITHER key is malformed: KeyError -> rpc error)
+            reports = [(i, p.get("cost_ms", 0.0), "") for i in p["piece_indices"]]
+        return self.svc.report_pieces(p["peer_id"], reports)
 
     async def announce_task(self, p: dict) -> None:
         self.svc.announce_task(
@@ -154,10 +163,18 @@ class RemoteSchedulerClient:
              "cost_ms": cost_ms, "parent_id": parent_id},
         )
 
-    async def report_pieces(self, peer_id, piece_indices, *, cost_ms=0.0):
-        await self._rpc.call(
+    async def report_pieces(self, peer_id, reports):
+        triples = [list(r) for r in reports]
+        # both wire shapes ride every flush during a mixed-version rollout:
+        # an r05 adapter reads the flat piece_indices + one shared cost
+        # (per-piece costs degrade to the mean for that window), a current
+        # adapter prefers the full triples — either way a batch never
+        # vanishes into a KeyError-and-drop on the far side
+        return await self._rpc.call(
             "report_pieces",
-            {"peer_id": peer_id, "piece_indices": list(piece_indices), "cost_ms": cost_ms},
+            {"peer_id": peer_id, "reports": triples,
+             "piece_indices": [t[0] for t in triples],
+             "cost_ms": (sum(t[1] for t in triples) / len(triples)) if triples else 0.0},
         )
 
     async def announce_task(self, peer_id, meta, host, *, content_length, piece_size, piece_indices, digest=""):

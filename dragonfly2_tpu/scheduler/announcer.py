@@ -58,7 +58,7 @@ class TrainerAnnouncer:
         rows = 0
         for kind, arr in (("downloads", downloads), ("probes", probes)):
             for start in range(0, len(arr), CHUNK_ROWS):
-                rows = await self.trainer.train_chunk(
+                rows = await self.trainer.train_chunk(  # dflint: disable=DF025 already batched: each call ships CHUNK_ROWS rows (one frame-budget-sized chunk per trip)
                     token, kind, arr[start : start + CHUNK_ROWS]
                 )
         await self.trainer.train_close(token)

@@ -82,6 +82,57 @@ def _parent_static_row(p: Peer, h) -> np.ndarray:
     return row
 
 
+# A parent's pair-row cache is bounded by the hosts that ever scheduled
+# against it; past this many distinct child hosts the dict is cleared whole
+# (rows rebuild on demand — eviction bookkeeping would cost more than the
+# rebuild at these row sizes).
+_PAIR_ROW_CACHE_MAX = 4096
+
+
+def _build_pair_features_rowwise(
+    child: Peer, parents: Sequence[Peer], topology=None, bandwidth=None
+) -> np.ndarray:
+    """Reference-shaped feature assembly (the r05 hot path): version-cached
+    static rows stacked, then the four child-dependent columns recomputed via
+    per-column comprehensions EVERY round. Kept as the equivalence baseline
+    and the bench's same-run A/B leg — `build_pair_features` below must stay
+    bit-identical to this on any pool state."""
+    n = len(parents)
+    if n == 0:
+        return np.zeros((0, FEATURE_DIM), dtype=np.float32)
+    child_host = child.host
+    child_host_id = child_host.id
+    child_idc = child_host.idc
+    child_loc = child_host.location
+    avg_rtt = topology.avg_rtt_ms if topology is not None else None
+    bw_norm = bandwidth.normalized if bandwidth is not None else None
+
+    hs = [p.host for p in parents]
+    f = np.stack([_parent_static_row(p, h) for p, h in zip(parents, hs)])
+    f[:, 4] = [1.0 if h.idc and h.idc == child_idc else 0.0 for h in hs]
+    f[:, 5] = [_location_affinity_cached(h.location, child_loc) for h in hs]
+    if avg_rtt is not None:
+        f[:, 6] = [
+            min(rtt, 1000.0) / 1000.0 if (rtt := avg_rtt(child_host_id, h.id)) is not None else 0.0
+            for h in hs
+        ]
+    if bw_norm is not None:
+        f[:, 8] = [bw_norm(h.id, child_host_id) for h in hs]
+    _fill_round_columns(f, child)
+    return f
+
+
+def _fill_round_columns(f: np.ndarray, child: Peer) -> None:
+    """Round-constant columns (child progress / task size / retry count) —
+    scalar broadcasts onto the stacked matrix, shared by both assembly paths."""
+    task = child.task
+    f[:, 10] = child.finished_piece_ratio()
+    f[:, 11] = (
+        float(np.log1p(task.content_length)) / _LOG_1TIB if task.content_length else 0.0
+    )
+    f[:, 13] = min(child.schedule_rounds, 10) / 10.0
+
+
 def build_pair_features(
     child: Peer, parents: Sequence[Peer], topology=None, bandwidth=None
 ) -> np.ndarray:
@@ -92,42 +143,53 @@ def build_pair_features(
     None) — fills bandwidth_norm from observed transfer history.
 
     Hot path: runs once per scheduling round, 40 candidates each, against a
-    10k-rounds/s serving budget. Child-independent columns come from
-    version-cached per-parent rows (see _parent_static_row); only the four
-    child-dependent columns and five round constants are computed here, so a
-    steady-state round costs one np.stack plus ~6 lookups per candidate
-    instead of ~30 attribute reads and two DAG walks."""
+    10k-rounds/s serving budget. The FULL per-pair row (static columns AND
+    the child-dependent idc/location/rtt/bandwidth columns) is cached on the
+    parent peer keyed by (parent peer, parent host, child host, topology,
+    bandwidth) versions — every mutation of an input bumps one of those
+    counters (resource.Host/Peer.bump_feat, NetworkTopology.version,
+    BandwidthHistory.version). A steady-state round is therefore one dict
+    lookup + version compare per candidate and one np.stack: the rtt/bw/
+    affinity recomputes (~2/3 of r05's 129.5 µs prepare leg, dominated by
+    statistics.fmean inside avg_rtt_ms) drop out entirely. Only the three
+    round-constant columns (10/11/13) are written per call — onto the
+    stacked COPY, so cached rows stay pristine."""
     n = len(parents)
     if n == 0:
         return np.zeros((0, FEATURE_DIM), dtype=np.float32)
-    task = child.task
     child_host = child.host
     child_host_id = child_host.id
     child_idc = child_host.idc
     child_loc = child_host.location
-    avg_rtt = topology.avg_rtt_ms if topology is not None else None
-    bw_norm = bandwidth.normalized if bandwidth is not None else None
+    topo_ver = topology.version if topology is not None else -1
+    bw_ver = bandwidth.version if bandwidth is not None else -1
 
-    hs = [p.host for p in parents]
-    f = np.stack([_parent_static_row(p, h) for p, h in zip(parents, hs)])
-    # copies: cached rows stay pristine. Separate comprehensions per column
-    # beat one loop with four appends (~20% on the 10k-rounds/s hot path),
-    # and the rtt/bw columns skip Python entirely when no source is attached
-    # (the static rows already carry 0 there).
-    f[:, 4] = [1.0 if h.idc and h.idc == child_idc else 0.0 for h in hs]
-    f[:, 5] = [_location_affinity_cached(h.location, child_loc) for h in hs]
-    if avg_rtt is not None:
-        f[:, 6] = [
-            min(rtt, 1000.0) / 1000.0 if (rtt := avg_rtt(child_host_id, h.id)) is not None else 0.0
-            for h in hs
-        ]
-    if bw_norm is not None:
-        f[:, 8] = [bw_norm(h.id, child_host_id) for h in hs]
-    f[:, 10] = child.finished_piece_ratio()
-    f[:, 11] = (
-        float(np.log1p(task.content_length)) / _LOG_1TIB if task.content_length else 0.0
-    )
-    f[:, 13] = min(child.schedule_rounds, 10) / 10.0
+    # preallocate + per-row memcpy instead of np.stack: stack's dispatcher
+    # (asanyarray per row, shape set, concat) was the largest single item
+    # left after the caching landed (~25% of the assembled round)
+    f = np.empty((n, FEATURE_DIM), dtype=np.float32)
+    child_feat_ver = child_host.feat_version
+    for i, p in enumerate(parents):
+        h = p.host
+        key = (p.feat_version, h.feat_version, child_feat_ver, topo_ver, bw_ver)
+        hit = p._pair_rows.get(child_host_id)
+        if hit is not None and hit[0] == key:
+            f[i] = hit[1]
+            continue
+        row = _parent_static_row(p, h).copy()
+        row[4] = 1.0 if h.idc and h.idc == child_idc else 0.0
+        row[5] = _location_affinity_cached(h.location, child_loc)
+        if topology is not None:
+            rtt = topology.avg_rtt_ms(child_host_id, h.id)
+            if rtt is not None:
+                row[6] = min(rtt, 1000.0) / 1000.0
+        if bandwidth is not None:
+            row[8] = bandwidth.normalized(h.id, child_host_id)
+        if len(p._pair_rows) >= _PAIR_ROW_CACHE_MAX:
+            p._pair_rows.clear()
+        p._pair_rows[child_host_id] = (key, row)
+        f[i] = row
+    _fill_round_columns(f, child)
     return f
 
 
@@ -137,11 +199,15 @@ class Evaluator:
     name = "base"
     topology = None  # NetworkTopology, attached by the scheduler service
     bandwidth = None  # telemetry.BandwidthHistory, attached by the service
+    # Assembly seam: the bench's control_plane A/B swaps in
+    # _build_pair_features_rowwise on a baseline instance; production always
+    # serves the cached path.
+    feature_builder = staticmethod(build_pair_features)
 
     def evaluate(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:
         if not parents:
             return np.zeros(0, dtype=np.float32)
-        feats = build_pair_features(child, parents, self.topology, self.bandwidth)
+        feats = self.feature_builder(child, parents, self.topology, self.bandwidth)
         return feats @ BASE_WEIGHTS
 
     async def evaluate_async(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:
@@ -258,7 +324,7 @@ class MLEvaluator(Evaluator):
         (the base matmul was ~10% of the serving round at 10k-rounds/s).
         known is None when every host is known (the steady-state fast path:
         no mask array, no np.where on return)."""
-        feats = build_pair_features(child, parents, self.topology, self.bandwidth)
+        feats = self.feature_builder(child, parents, self.topology, self.bandwidth)
         child_idx = self._node_index.get(child.host.id)
         if child_idx is None:
             return feats, None, None, None

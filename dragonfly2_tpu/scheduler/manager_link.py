@@ -77,7 +77,7 @@ class SeedPeerConnector:
         last_err: Exception | None = None
         for addr in candidates:
             try:
-                return await self._client(addr).call(
+                return await self._client(addr).call(  # dflint: disable=DF025 failover walk: returns on the first healthy candidate, not per-item fan-out
                     "trigger_seed",
                     {"url": url, "tag": tag, "application": application,
                      "digest": digest, "filters": list(filters),

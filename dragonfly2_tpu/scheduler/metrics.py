@@ -23,6 +23,16 @@ SCHEDULE_DURATION = _r.histogram(
 PIECE_RESULT_TOTAL = _r.counter(
     "piece_result_total", "Piece results reported", subsystem="scheduler", labels=("success",)
 )
+PIECE_REPORT_BATCH_TOTAL = _r.counter(
+    "piece_report_batch_total",
+    "Batched piece-report flushes received (report_pieces RPCs)",
+    subsystem="scheduler",
+)
+PIECE_REPORT_DUPLICATE_TOTAL = _r.counter(
+    "piece_report_duplicate_total",
+    "Batched piece reports skipped as already applied (idempotent re-apply)",
+    subsystem="scheduler",
+)
 PEER_RESULT_TOTAL = _r.counter(
     "peer_result_total", "Peer download completions", subsystem="scheduler", labels=("success",)
 )

@@ -41,31 +41,31 @@ class ProbeTarget:
 
 
 class EdgeProbes:
-    """Bounded FIFO of RTT samples for one (src, dst) edge (ref probes.go)."""
+    """Bounded FIFO of RTT samples for one (src, dst) edge (ref probes.go).
 
-    __slots__ = ("rtts_ms", "probed_count", "updated_at")
+    avg/std/min are computed ONCE per enqueue, not per read: the evaluator
+    queries avg_rtt_ms 40×/round at multi-kHz round rates while probes land at
+    most a few per second per edge — recomputing fmean over the deque on every
+    query was ~40% of the feature-assembly cost (measured, see
+    evaluator.build_pair_features)."""
+
+    __slots__ = ("rtts_ms", "probed_count", "updated_at", "avg_ms", "std_ms", "min_ms")
 
     def __init__(self, maxlen: int = DEFAULT_QUEUE_LENGTH):
         self.rtts_ms: deque[float] = deque(maxlen=maxlen)
         self.probed_count = 0
         self.updated_at = 0.0
+        self.avg_ms = 0.0
+        self.std_ms = 0.0
+        self.min_ms = 0.0
 
     def enqueue(self, rtt_ms: float) -> None:
         self.rtts_ms.append(rtt_ms)
         self.probed_count += 1
         self.updated_at = time.time()
-
-    @property
-    def avg_ms(self) -> float:
-        return statistics.fmean(self.rtts_ms) if self.rtts_ms else 0.0
-
-    @property
-    def std_ms(self) -> float:
-        return statistics.pstdev(self.rtts_ms) if len(self.rtts_ms) > 1 else 0.0
-
-    @property
-    def min_ms(self) -> float:
-        return min(self.rtts_ms) if self.rtts_ms else 0.0
+        self.avg_ms = statistics.fmean(self.rtts_ms)
+        self.std_ms = statistics.pstdev(self.rtts_ms) if len(self.rtts_ms) > 1 else 0.0
+        self.min_ms = min(self.rtts_ms)
 
 
 class NetworkTopology:
@@ -82,6 +82,11 @@ class NetworkTopology:
         self.probe_count = probe_count
         self._edges: dict[tuple[str, str], EdgeProbes] = {}
         self._rng = rng or random.Random()
+        # Bumped on every mutation that can change avg_rtt_ms for ANY pair;
+        # the evaluator's pair-feature cache keys on it (coarse on purpose:
+        # probe rounds are orders of magnitude rarer than scheduling rounds,
+        # so a cluster-wide invalidation per probe costs one re-assembly).
+        self.version = 0
 
     # ---- store ----
 
@@ -91,6 +96,7 @@ class NetworkTopology:
         if edge is None:
             edge = self._edges[key] = EdgeProbes(self.queue_length)
         edge.enqueue(rtt_ms)
+        self.version += 1
         if self.telemetry is not None:
             self.telemetry.probes.append(
                 src_host_id=src_host_id.encode()[:64],
@@ -117,6 +123,8 @@ class NetworkTopology:
         dead = [k for k in self._edges if host_id in k]
         for k in dead:
             del self._edges[k]
+        if dead:
+            self.version += 1
         return len(dead)
 
     # ---- sync protocol ----

@@ -175,6 +175,11 @@ class Peer:
         self.feat_version = 0
         self._feat_row = None  # evaluator-owned cached row (np.ndarray)
         self._feat_row_ver = (-1, -1)
+        # evaluator-owned per-child-host FULL pair rows (static + idc/loc/
+        # rtt/bw columns), keyed child_host_id -> (version_key, row); the
+        # version key spans this peer, both hosts, and the topology/bandwidth
+        # sources, so a hit is a pure row gather (evaluator.build_pair_features)
+        self._pair_rows: dict[str, tuple[tuple, Any]] = {}
         # per-version memos for the per-round hot checks (depth walk /
         # bad-node statistics) — invalidated by the same bump_feat sweep;
         # the depth memo also carries its timestamp (TTL, see depth())

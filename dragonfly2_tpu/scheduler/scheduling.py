@@ -21,7 +21,6 @@ from __future__ import annotations
 import logging
 import random
 from dataclasses import dataclass, field
-from typing import Callable
 
 import numpy as np
 
@@ -75,99 +74,59 @@ class Scheduling:
         )
 
     # ---- filters (ref filterCandidateParents' 8 conditions) ----
+    #
+    # The reference builds a fresh closure per condition per call; the r05
+    # port kept that shape (`_filters` returned 8 closures) for the
+    # SMALL-scope path while the NORMAL path inlined the checks. Both now
+    # share ONE flattened predicate over a per-round context tuple: the
+    # context (blocklist union, lineage walk) is computed once per scheduling
+    # call, and each candidate costs one short-circuit boolean chain — no
+    # closure list, no generator machinery (the `all(f(p) for f in filters)`
+    # form measured ~60% of round cost in call overhead at 40 candidates).
 
-    def _filters(self, child: Peer, blocklist: set[str]) -> list[Callable[[Peer], bool]]:
-        task = child.task
-        lineage: set[str] = set()
+    _OK_PARENT_STATES = (PEER_RUNNING, PEER_BACK_TO_SOURCE, PEER_SUCCEEDED)
+
+    def _filter_ctx(self, child: Peer, blocklist: set[str]) -> tuple:
+        """Per-round filter inputs: (child_id, child_host_id, block, lineage).
+        One DAG lineage walk and one set union per scheduling call — hoisted
+        out of the per-candidate pass."""
         try:
-            lineage = task.dag.lineage(child.id)
+            lineage = child.task.dag.lineage(child.id)
         except DAGError:
-            pass  # child not registered yet — empty lineage filters nothing
+            lineage = set()  # child not registered yet — nothing to exclude
+        return child.id, child.host.id, set(blocklist) | child.block_parents, lineage
 
-        def not_blocked(p: Peer) -> bool:
-            return p.id not in blocklist and p.id not in child.block_parents
+    def _passes(self, p: Peer, ctx: tuple) -> bool:
+        """The 8 filter conditions, cheapest first, as one flattened pass.
 
-        def not_self(p: Peer) -> bool:
-            return p.id != child.id
-
-        def different_host(p: Peer) -> bool:
-            return p.host.id != child.host.id
-
-        def parent_state_ok(p: Peer) -> bool:
-            return p.fsm.current in (PEER_RUNNING, PEER_BACK_TO_SOURCE, PEER_SUCCEEDED)
-
-        def not_bad_node(p: Peer) -> bool:
-            return not self.evaluator.is_bad_node(p)
-
-        def has_upload_slot(p: Peer) -> bool:
-            return p.host.free_upload_slots > 0
-
-        def no_cycle(p: Peer) -> bool:
-            # adding p -> child must not create a cycle (p in child's
-            # descendant lineage would); also p must not already be the child's
-            # parent (re-pick wastes a slot)
-            return p.id not in lineage and task.can_add_edge(p.id, child.id)
-
-        def depth_ok(p: Peer) -> bool:
-            return p.depth() < self.config.max_tree_depth
-
-        return [
-            not_blocked,
-            not_self,
-            different_host,
-            parent_state_ok,
-            not_bad_node,
-            has_upload_slot,
-            no_cycle,
-            depth_ok,
-        ]
+        ONE permitted divergence from the reference's filter list: no
+        per-candidate can_add_edge reachability walk — a p->child cycle
+        requires p reachable FROM child, and every such p is in `lineage`
+        (descendants), as is an existing parent (ancestors); the commit path
+        re-validates via add_edge's CycleError for anything that changed
+        during the scoring await. The SMALL-scope path re-adds the edge
+        check explicitly (find_success_parent)."""
+        child_id, child_host_id, block, lineage = ctx
+        pid = p.id
+        return not (
+            pid == child_id
+            or pid in block
+            or pid in lineage
+            or p.host.id == child_host_id
+            or p.fsm.current not in self._OK_PARENT_STATES
+            or p.host.free_upload_slots <= 0
+            or p.depth() >= self.config.max_tree_depth
+            or self.evaluator.is_bad_node(p)
+        )
 
     def _sample_candidates(self, child: Peer, blocklist: set[str]) -> list[Peer]:
-        """Sample ≤40 random DAG peers and run the 8 filter conditions.
-
-        Hot path (one call per scheduling round): the conditions are inlined
-        in ONE loop, cheapest first — the closure-list form (`all(f(p) for f
-        in filters)`) spent more time in generator/call machinery than in the
-        checks themselves (measured ~60% of round cost at 40 candidates).
-        `_filters` remains the reference-shaped form for the SMALL-scope path
-        and tests. ONE permitted divergence: `_filters.no_cycle` also runs a
-        per-candidate can_add_edge reachability walk, omitted here because
-        lineage already covers cycle-formers and the commit path re-validates
-        (see the NOTE in the loop)."""
+        """Sample ≤40 random DAG peers and keep those passing the flattened
+        filter pass (one predicate call per candidate, context hoisted)."""
         task = child.task
         sample = task.dag.random_vertices(self.config.filter_parent_limit, self._rng)
-        try:
-            lineage = task.dag.lineage(child.id)
-        except Exception:
-            lineage = set()
-        block = set(blocklist) | child.block_parents
-        child_id = child.id
-        child_host_id = child.host.id
-        ok_states = (PEER_RUNNING, PEER_BACK_TO_SOURCE, PEER_SUCCEEDED)
-        max_depth = self.config.max_tree_depth
-        is_bad = self.evaluator.is_bad_node
-        out = []
-        for v in sample:
-            p = v.value
-            pid = p.id
-            if (
-                pid == child_id
-                or pid in block
-                or pid in lineage
-                or p.host.id == child_host_id
-                or p.fsm.current not in ok_states
-                or p.host.free_upload_slots <= 0
-                or p.depth() >= max_depth
-                or is_bad(p)
-            ):
-                continue
-            # NOTE: no per-candidate can_add_edge reachability walk here — a
-            # p->child cycle requires p reachable FROM child, and every such
-            # p is in `lineage` (descendants), as is an existing parent
-            # (ancestors); the commit path still re-validates via add_edge's
-            # CycleError for anything that changed during the scoring await
-            out.append(p)
-        return out
+        ctx = self._filter_ctx(child, blocklist)
+        passes = self._passes
+        return [v.value for v in sample if passes(v.value, ctx)]
 
     def _top_parents(self, child: Peer, candidates: list[Peer], scores) -> list[Peer]:
         order = np.argsort(-np.asarray(scores), kind="stable")
@@ -201,13 +160,17 @@ class Scheduling:
         return self._top_parents(child, candidates, scores)
 
     def find_success_parent(self, child: Peer, blocklist: set[str] = frozenset()) -> Peer | None:
-        """SMALL-scope path: a single finished parent (ref FindSuccessParent)."""
+        """SMALL-scope path: a single finished parent (ref FindSuccessParent).
+        Shares the flattened predicate with the NORMAL path plus the explicit
+        can_add_edge check the sampler omits (see _passes)."""
         task = child.task
-        filters = self._filters(child, set(blocklist))
+        ctx = self._filter_ctx(child, set(blocklist))
         done = [
             p
             for p in task.peers()
-            if p.fsm.is_(PEER_SUCCEEDED) and all(f(p) for f in filters)
+            if p.fsm.is_(PEER_SUCCEEDED)
+            and self._passes(p, ctx)
+            and task.can_add_edge(p.id, child.id)
         ]
         if not done:
             return None

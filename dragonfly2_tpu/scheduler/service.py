@@ -256,6 +256,39 @@ class SchedulerService:
 
     # ---- piece + peer results (ref handleDownloadPiece*Request) ----
 
+    def _apply_piece_success(
+        self, peer: Peer, piece_index: int, cost_ms: float, parent_id: str, *, dedupe: bool
+    ) -> bool:
+        """One successful piece's full accounting — shared by the unary and
+        batched report paths so they cannot diverge. With dedupe=True an
+        already-finished index is skipped WHOLE (no metrics, no cost sample,
+        no parent credit): that is what makes a retried batch flush an exact
+        no-op (exactly-once accounting under at-least-once delivery)."""
+        task = peer.task
+        newly_set = peer.finished_pieces.set(piece_index)
+        if dedupe and not newly_set:
+            metrics.PIECE_REPORT_DUPLICATE_TOTAL.inc()
+            return False
+        metrics.PIECE_RESULT_TOTAL.inc(success="true")
+        if task.piece_size:
+            if task.content_length:
+                # final piece is usually partial
+                nbytes = min(task.piece_size, task.content_length - piece_index * task.piece_size)
+            else:
+                nbytes = task.piece_size
+            if nbytes > 0:
+                metrics.DOWNLOAD_TRAFFIC_BYTES.inc(nbytes)
+        if peer.fsm.can("download"):
+            peer.fsm.fire("download")
+        peer.add_piece_cost(cost_ms)  # bumps the peer's feature version
+        if parent_id:
+            parent = self.pool.peer(parent_id)
+            if parent is not None:
+                parent.host.upload_count += 1
+                parent.host.bump_feat()
+                parent.touch()
+        return True
+
     def report_piece_result(
         self,
         peer_id: str,
@@ -269,34 +302,16 @@ class SchedulerService:
         if peer is None:
             return
         peer.touch()
-        metrics.PIECE_RESULT_TOTAL.inc(success=str(success).lower())
-        task = peer.task
-        if success and task.piece_size:
-            if task.content_length:
-                # final piece is usually partial
-                nbytes = min(task.piece_size, task.content_length - piece_index * task.piece_size)
-            else:
-                nbytes = task.piece_size
-            if nbytes > 0:
-                metrics.DOWNLOAD_TRAFFIC_BYTES.inc(nbytes)
         if success:
-            if peer.fsm.can("download"):
-                peer.fsm.fire("download")
-            peer.finished_pieces.set(piece_index)
-            peer.add_piece_cost(cost_ms)  # bumps the peer's feature version
-            if parent_id:
-                parent = self.pool.peer(parent_id)
-                if parent is not None:
-                    parent.host.upload_count += 1
-                    parent.host.bump_feat()
-                    parent.touch()
-        else:
-            if parent_id:
-                parent = self.pool.peer(parent_id)
-                if parent is not None:
-                    parent.host.upload_failed_count += 1
-                    parent.host.bump_feat()
-                peer.block_parents.add(parent_id)
+            self._apply_piece_success(peer, piece_index, cost_ms, parent_id, dedupe=False)
+            return
+        metrics.PIECE_RESULT_TOTAL.inc(success="false")
+        if parent_id:
+            parent = self.pool.peer(parent_id)
+            if parent is not None:
+                parent.host.upload_failed_count += 1
+                parent.host.bump_feat()
+            peer.block_parents.add(parent_id)
 
     def announce_task(
         self,
@@ -341,20 +356,30 @@ class SchedulerService:
         if task.fsm.can("succeed"):
             task.fsm.fire("succeed")
 
-    def report_pieces(self, peer_id: str, piece_indices: list[int], *, cost_ms: float = 0.0) -> None:
-        """Bulk success report: one call for N pieces (import/announce-task
-        path — O(pieces) RPC round trips otherwise)."""
+    def report_pieces(self, peer_id: str, reports) -> int:
+        """Batched success report: one RPC for N pieces (the conductor's
+        piece-report buffer flush — the hot-path replacement for one
+        report_piece_result round trip per piece).
+
+        `reports` is a sequence of (piece_index, cost_ms, parent_id) triples
+        (lists over the wire). Each entry gets the SAME accounting as a unary
+        report_piece_result(success=True) — shared _apply_piece_success —
+        except that an index already in the peer's finished set is skipped
+        whole, duplicate-counted in PIECE_REPORT_DUPLICATE_TOTAL: a flush
+        retried by the rpc client (injected rpc.write fault, timeout after a
+        server-side apply) re-applies as an exact no-op. Returns the number
+        of newly applied pieces."""
         peer = self.pool.peer(peer_id)
         if peer is None:
-            return
+            return 0
         peer.touch()
-        if piece_indices and peer.fsm.can("download"):
-            peer.fsm.fire("download")
-        for idx in piece_indices:
-            peer.finished_pieces.set(idx)
-        peer.bump_feat()
-        if cost_ms:
-            peer.add_piece_cost(cost_ms)
+        metrics.PIECE_REPORT_BATCH_TOTAL.inc()
+        applied = 0
+        for rep in reports:
+            idx, cost_ms, parent_id = rep[0], rep[1], rep[2]
+            if self._apply_piece_success(peer, idx, cost_ms, parent_id, dedupe=True):
+                applied += 1
+        return applied
 
     async def reschedule(self, peer_id: str) -> RegisterResult:
         """Child lost its parents; run another round (ref reschedule path)."""

@@ -35,10 +35,16 @@ class BandwidthHistory:
         self.alpha = alpha
         self._pair: dict[tuple[str, str], float] = {}
         self._parent: dict[str, float] = {}
+        # Bumped on every mutation that can change normalized() for ANY pair;
+        # the evaluator's pair-feature cache keys on it (peer results arrive
+        # orders of magnitude slower than scheduling rounds, so the coarse
+        # invalidation is cheap — see evaluator.build_pair_features).
+        self.version = 0
 
     def observe(self, parent_host_id: str, child_host_id: str, bps: float) -> None:
         if not parent_host_id or not np.isfinite(bps) or bps <= 0:
             return
+        self.version += 1
         a = self.alpha
         key = (parent_host_id, child_host_id)
         prev = self._pair.get(key)
@@ -65,6 +71,7 @@ class BandwidthHistory:
         self._parent.pop(host_id, None)
         for key in [k for k in self._pair if host_id in k]:
             del self._pair[key]
+        self.version += 1
 
     def load_from(self, telemetry) -> int:
         """Warm-start from persisted download records (oldest first, so the

@@ -44,6 +44,27 @@ def test_dataset_build_contract():
     assert out["num_nodes"] >= 64
 
 
+def test_control_plane_contract():
+    # tiny shapes again: pins the key set and the A/B + wire-leg wiring the
+    # driver's control_plane JSON consumers depend on, not the real rates
+    out = bench.bench_control_plane(
+        rounds=50, candidates=8, hosts=24, pieces_per_round=4
+    )
+    for key in (
+        "full_round_rps", "full_round_rps_rowwise_baseline", "full_round_speedup",
+        "evaluator_prepare_us_per_round", "evaluator_prepare_us_rowwise",
+        "prepare_speedup", "score_us_per_round", "piece_report_rpcs_per_round",
+        "report_wire_us_per_piece_batched", "report_wire_us_per_piece_unary",
+    ):
+        assert key in out, key
+    assert out["full_round_rps"] > 0
+    assert out["full_round_rps_rowwise_baseline"] > 0
+    assert out["evaluator_prepare_us_per_round"] > 0
+    # the batched path's structural contract: ONE flush per dispatch round
+    assert out["piece_report_rpcs_per_round"] == 1
+    assert out["report_wire_us_per_piece_batched"] > 0
+
+
 def test_payload_schema():
     line = bench._payload(1234.5, {"backend": "cpu"})
     d = json.loads(line)

@@ -1,0 +1,514 @@
+"""Control-plane fast path tests (PR 5).
+
+Covers the three scheduler-side layers the fast path touches:
+
+1. `PieceReportBuffer` — successful piece reports batch into `report_pieces`
+   flushes (size / staleness-interval / round-end / task-close triggers),
+   failed flushes re-merge without loss, and the conductor's piece path
+   makes ZERO unary success RPCs (counter-asserted end-to-end).
+2. `SchedulerService.report_pieces` idempotent apply — a retried flush
+   containing already-applied indices changes no scheduler state and emits
+   no duplicate metrics; the batched path's accounting is equivalent to the
+   unary `report_piece_result` path applied piece by piece.
+3. Flattened candidate filtering — `Scheduling._passes` over a hoisted
+   per-round context admits exactly the candidate set the r05
+   closure-per-condition `_filters` list admitted, on randomized pools
+   exercising every exclusion class (the permitted `can_add_edge`
+   divergence included).
+
+The rpc.write chaos proof for batched flushes lives in test_chaos.py
+(`TestBatchedReportFaults`) with the rest of the faultline suite.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+import pytest
+from test_e2e import Origin, make_engine
+
+from dragonfly2_tpu.daemon.conductor import ConductorConfig, PieceReportBuffer
+from dragonfly2_tpu.daemon.engine import InProcessSchedulerClient, PeerEngine
+from dragonfly2_tpu.scheduler import metrics
+from dragonfly2_tpu.scheduler import resource as res
+from dragonfly2_tpu.scheduler.evaluator import new_evaluator
+from dragonfly2_tpu.scheduler.scheduling import Scheduling, SchedulingConfig
+from dragonfly2_tpu.scheduler.service import HostInfo, SchedulerService, TaskMeta
+from dragonfly2_tpu.utils.dag import DAGError
+
+
+# ---------------------------------------------------------------------------
+# PieceReportBuffer unit behavior (fake scheduler, no wire)
+
+
+class _FakeSched:
+    """report_pieces sink with a scriptable failure schedule."""
+
+    def __init__(self, fail_first: int = 0):
+        self.batches: list[list[tuple[int, float, str]]] = []
+        self.calls = 0
+        self.fail_first = fail_first
+
+    async def report_pieces(self, peer_id, reports):
+        self.calls += 1
+        if self.calls <= self.fail_first:
+            raise ConnectionError("injected")
+        self.batches.append(list(reports))
+        return len(reports)
+
+
+class TestPieceReportBuffer:
+    def test_size_trigger_flushes_one_batch(self, run):
+        async def body():
+            sched = _FakeSched()
+            buf = PieceReportBuffer(sched, "p1", max_batch=4, flush_interval=60.0)
+            for i in range(4):
+                buf.add(i, cost_ms=float(i))
+            await asyncio.sleep(0.01)  # let the spawned size-flush run
+            assert [r[0] for r in sched.batches[0]] == [0, 1, 2, 3]
+            assert buf.rpcs == 1 and buf.buffered == 4
+            assert not buf._buf
+
+        run(body())
+
+    def test_interval_trigger_bounds_staleness(self, run):
+        async def body():
+            sched = _FakeSched()
+            buf = PieceReportBuffer(sched, "p1", max_batch=64, flush_interval=0.02)
+            buf.add(7)
+            assert sched.calls == 0  # below max_batch: nothing flushed yet
+            await asyncio.sleep(0.08)
+            assert sched.batches == [[(7, 0.0, "")]]
+
+        run(body())
+
+    def test_failed_flush_remerges_in_order(self, run):
+        async def body():
+            sched = _FakeSched(fail_first=1)
+            buf = PieceReportBuffer(sched, "p1", max_batch=64, flush_interval=60.0)
+            buf.add(0)
+            buf.add(1)
+            await buf.flush()  # fails: batch must survive for the next trigger
+            assert sched.batches == [] and buf._buf == [(0, 0.0, ""), (1, 0.0, "")]
+            buf.add(2)
+            await buf.flush()
+            # one recovery flush, original order, nothing duplicated or lost
+            assert sched.batches == [[(0, 0.0, ""), (1, 0.0, ""), (2, 0.0, "")]]
+
+        run(body())
+
+    def test_aclose_retries_final_flush(self, run):
+        async def body():
+            sched = _FakeSched(fail_first=2)
+            buf = PieceReportBuffer(sched, "p1", max_batch=64, flush_interval=60.0)
+            buf.add(0)
+            await buf.aclose()
+            # two failed attempts, then the backed-off retry lands the batch:
+            # task-close accounting is never dropped on a transient fault
+            assert sched.batches == [[(0, 0.0, "")]]
+            assert not buf._buf
+
+        run(body())
+
+    def test_cancelled_flush_remerges_for_aclose(self, run):
+        """aclose() cancelling the staleness timer mid-RPC must not lose the
+        batch the in-flight flush already took: CancelledError is a
+        BaseException, so the re-merge has to catch it explicitly — without
+        that, the close flush snapshots an incomplete finished set."""
+
+        async def body():
+            parked = asyncio.Event()
+
+            class _Hang(_FakeSched):
+                async def report_pieces(self, peer_id, reports):
+                    parked.set()
+                    await asyncio.sleep(3600)  # parks until cancelled
+
+            buf = PieceReportBuffer(_Hang(), "p1", max_batch=64, flush_interval=0.01)
+            buf.add(1)
+            buf.add(2)
+            await parked.wait()  # the timer flush took the batch and parked
+            delivered = _FakeSched()
+            buf._sched = delivered  # close-time flush goes to a healthy sink
+            await buf.aclose()  # cancels the parked timer task, then flushes
+            assert [[r[0] for r in b] for b in delivered.batches] == [[1, 2]]
+            assert not buf._buf
+
+        run(body())
+
+    def test_flush_drains_adds_landed_during_rpc(self, run):
+        async def body():
+            gate = asyncio.Event()
+
+            class _Slow(_FakeSched):
+                async def report_pieces(self, peer_id, reports):
+                    await gate.wait()
+                    return await super().report_pieces(peer_id, reports)
+
+            sched = _Slow()
+            buf = PieceReportBuffer(sched, "p1", max_batch=64, flush_interval=60.0)
+            buf.add(0)
+            t = asyncio.ensure_future(buf.flush())
+            await asyncio.sleep(0)  # flush takes [0] and parks in the RPC
+            buf.add(1)
+            gate.set()
+            await t
+            assert [[r[0] for r in b] for b in sched.batches] == [[0], [1]]
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# Conductor end-to-end: success reports batch, failures stay unary
+
+
+class _CountingClient:
+    """InProcessSchedulerClient wrapper counting the report split."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.unary_success = 0
+        self.unary_failure = 0
+        self.batches: list[list] = []
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    async def report_piece_result(self, peer_id, piece_index, *, success, **kw):
+        if success:
+            self.unary_success += 1
+        else:
+            self.unary_failure += 1
+        return await self._inner.report_piece_result(
+            peer_id, piece_index, success=success, **kw
+        )
+
+    async def report_pieces(self, peer_id, reports):
+        self.batches.append(list(reports))
+        return await self._inner.report_pieces(peer_id, reports)
+
+
+def _engine(tmp_path, client, name, **cfg_kw):
+    # long flush interval: only the deterministic round-end / task-close
+    # triggers may fire, so the flush count is exact
+    cfg = ConductorConfig(
+        metadata_poll_interval=0.02, piece_timeout=10.0,
+        report_flush_interval=30.0, **cfg_kw,
+    )
+    return PeerEngine(
+        storage_root=tmp_path / name, scheduler=client, hostname=name,
+        conductor_config=cfg,
+    )
+
+
+class TestConductorBatching:
+    def test_success_reports_batch_failed_stay_unary(self, run, tmp_path):
+        """The acceptance counters: a multi-piece download makes ZERO unary
+        success RPCs and at most one flush per dispatch round (here: the
+        round-end flush, plus nothing at close because the buffer is already
+        empty — asserted as flushes <= 2 per engine for this 1-round task)."""
+        payload = bytes(range(256)) * (40 * 1024)  # 10 MiB -> 3 pieces
+
+        async def body():
+            svc = SchedulerService()
+            parent_client = _CountingClient(InProcessSchedulerClient(svc))
+            child_client = _CountingClient(InProcessSchedulerClient(svc))
+            async with Origin({"f.bin": payload}) as origin:
+                e1 = _engine(tmp_path, parent_client, "parent1")
+                e2 = _engine(tmp_path, child_client, "child1")
+                await e1.start()
+                await e2.start()
+                try:
+                    await e1.download_task(origin.url("f.bin"))
+                    out = tmp_path / "out.bin"
+                    await e2.download_task(origin.url("f.bin"), output=out)
+                    assert out.read_bytes() == payload
+                    for c in (parent_client, child_client):
+                        assert c.unary_success == 0, "success rode a unary RPC"
+                        assert 1 <= len(c.batches) <= 2
+                        assert sorted(
+                            idx for b in c.batches for idx, _, _ in b
+                        ) == [0, 1, 2]
+                    # scheduler accounting identical to what the unary path
+                    # would have produced: every piece finished, once
+                    for peer in svc.pool.tasks[next(iter(svc.pool.tasks))].peers():
+                        assert list(peer.finished_pieces.indices()) == [0, 1, 2]
+                finally:
+                    await e1.stop()
+                    await e2.stop()
+
+        run(body())
+
+    def test_unbatched_fallback_for_legacy_clients(self, run, tmp_path):
+        """A scheduler client without report_pieces (out-of-tree/fake) gets
+        the r05 unary path — same accounting, no AttributeError."""
+        payload = bytes(range(256)) * (20 * 1024)  # 5 MiB -> 2 pieces
+
+        class _NoBatch:
+            def __init__(self, inner, counts):
+                self._inner = inner
+                self._counts = counts
+
+            def __getattr__(self, name):
+                if name == "report_pieces":
+                    raise AttributeError(name)
+                if name == "report_piece_result":
+                    return self._count_and_forward
+                return getattr(self._inner, name)
+
+            async def _count_and_forward(self, peer_id, piece_index, **kw):
+                self._counts.append(piece_index)
+                return await self._inner.report_piece_result(
+                    peer_id, piece_index, **kw
+                )
+
+        async def body():
+            svc = SchedulerService()
+            counts: list[int] = []
+            client = _NoBatch(InProcessSchedulerClient(svc), counts)
+            async with Origin({"f.bin": payload}) as origin:
+                e1 = _engine(tmp_path, client, "peer1")
+                await e1.start()
+                try:
+                    await e1.download_task(origin.url("f.bin"))
+                    assert sorted(counts) == [0, 1]  # unary per piece, as before
+                finally:
+                    await e1.stop()
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# report_pieces idempotent apply (scheduler side of exactly-once)
+
+
+def _svc_with_parent_child(n_pieces=8):
+    svc = SchedulerService()
+    pool = svc.pool
+    task = pool.load_or_create_task("t1", "http://o/f")
+    task.set_metadata(n_pieces * (4 << 20))
+    hp = pool.load_or_create_host("hp", "10.0.0.1", "hostp", download_port=8001)
+    hc = pool.load_or_create_host("hc", "10.0.0.2", "hostc", download_port=8002)
+    parent = pool.create_peer("parent", task, hp)
+    child = pool.create_peer("child", task, hc)
+    for p in (parent, child):
+        p.fsm.fire("register")
+        p.fsm.fire("download")
+    return svc, parent, child
+
+
+def _state_snapshot(svc, parent, child):
+    return {
+        "finished": child.finished_pieces.to_int(),
+        "uploads": parent.host.upload_count,
+        "success_total": metrics.PIECE_RESULT_TOTAL.labels(success="true").value,
+        "traffic": metrics.DOWNLOAD_TRAFFIC_BYTES.value,
+        "costs": list(child.piece_costs_ms),
+    }
+
+
+class TestReportPiecesIdempotent:
+    def test_retried_flush_is_exact_noop(self):
+        svc, parent, child = _svc_with_parent_child()
+        batch = [(0, 5.0, "parent"), (1, 6.0, "parent"), (2, 7.0, "")]
+        assert svc.report_pieces("child", batch) == 3
+        before = _state_snapshot(svc, parent, child)
+        dups_before = metrics.PIECE_REPORT_DUPLICATE_TOTAL.value
+        # the rpc client re-delivers the SAME flush (write fault after a
+        # server-side apply): nothing may change but the duplicate counter
+        assert svc.report_pieces("child", batch) == 0
+        assert _state_snapshot(svc, parent, child) == before
+        assert metrics.PIECE_REPORT_DUPLICATE_TOTAL.value == dups_before + 3
+
+    def test_partial_overlap_applies_only_new(self):
+        svc, parent, child = _svc_with_parent_child()
+        svc.report_pieces("child", [(0, 5.0, "parent")])
+        uploads = parent.host.upload_count
+        assert svc.report_pieces("child", [(0, 5.0, "parent"), (1, 5.0, "parent")]) == 1
+        assert child.finished_pieces.to_int() == 0b11
+        assert parent.host.upload_count == uploads + 1  # piece 1 only
+
+    def test_batched_equals_unary_accounting(self):
+        """The shared _apply_piece_success makes both report paths produce
+        identical scheduler state for the same piece results."""
+        reports = [(i, 4.0 + i, "parent" if i % 2 else "") for i in range(6)]
+
+        svc_b, parent_b, child_b = _svc_with_parent_child()
+        t0 = metrics.DOWNLOAD_TRAFFIC_BYTES.value
+        svc_b.report_pieces("child", reports)
+        batched_traffic = metrics.DOWNLOAD_TRAFFIC_BYTES.value - t0
+
+        svc_u, parent_u, child_u = _svc_with_parent_child()
+        t0 = metrics.DOWNLOAD_TRAFFIC_BYTES.value
+        for idx, cost, pid in reports:
+            svc_u.report_piece_result(
+                "child", idx, success=True, cost_ms=cost, parent_id=pid
+            )
+        unary_traffic = metrics.DOWNLOAD_TRAFFIC_BYTES.value - t0
+
+        assert child_b.finished_pieces.to_int() == child_u.finished_pieces.to_int()
+        assert parent_b.host.upload_count == parent_u.host.upload_count
+        assert list(child_b.piece_costs_ms) == list(child_u.piece_costs_ms)
+        assert child_b.fsm.current == child_u.fsm.current
+        assert batched_traffic == unary_traffic
+
+    def test_unknown_peer_is_noop(self):
+        svc, _, _ = _svc_with_parent_child()
+        assert svc.report_pieces("ghost", [(0, 1.0, "")]) == 0
+
+    def test_wire_adapter_accepts_legacy_piece_indices(self, run):
+        """An r05-shape payload (flat `piece_indices` + one shared cost)
+        from a not-yet-upgraded daemon must apply, not silently zero out;
+        a payload with NEITHER key is malformed and raises."""
+        from dragonfly2_tpu.rpc.scheduler import SchedulerRpcAdapter
+
+        svc, parent, child = _svc_with_parent_child()
+        adapter = SchedulerRpcAdapter(svc)
+        applied = run(adapter.report_pieces(
+            {"peer_id": "child", "piece_indices": [0, 1, 2], "cost_ms": 7.0}
+        ))
+        assert applied == 3
+        assert child.finished_pieces.to_int() == 0b111
+        assert list(child.piece_costs_ms)[-3:] == [7.0, 7.0, 7.0]
+        with pytest.raises(KeyError):
+            run(adapter.report_pieces({"peer_id": "child"}))
+
+
+# ---------------------------------------------------------------------------
+# Flattened filter pass ≡ the r05 closure-list reference
+
+
+def _reference_filters(s: Scheduling, child, blocklist):
+    """The r05 `Scheduling._filters` closure list, verbatim — the behavior
+    contract the flattened `_passes` must match condition for condition."""
+    task = child.task
+    lineage: set[str] = set()
+    try:
+        lineage = task.dag.lineage(child.id)
+    except DAGError:
+        pass
+
+    return [
+        lambda p: p.id not in blocklist and p.id not in child.block_parents,
+        lambda p: p.id != child.id,
+        lambda p: p.host.id != child.host.id,
+        lambda p: p.fsm.current
+        in (res.PEER_RUNNING, res.PEER_BACK_TO_SOURCE, res.PEER_SUCCEEDED),
+        lambda p: not s.evaluator.is_bad_node(p),
+        lambda p: p.host.free_upload_slots > 0,
+        lambda p: p.id not in lineage and task.can_add_edge(p.id, child.id),
+        lambda p: p.depth() < s.config.max_tree_depth,
+    ]
+
+
+def _random_pool(seed: int):
+    """A pool exercising every exclusion class: same-host peers, pending and
+    failed states, exhausted upload slots, bad nodes, a parent chain at the
+    depth limit, block lists, and DAG lineage in both directions."""
+    rng = random.Random(seed)
+    pool = res.ResourcePool()
+    task = pool.load_or_create_task("t1", "http://o/f")
+    task.set_metadata(512 << 20)
+    hosts = [
+        pool.load_or_create_host(f"h{i}", f"10.0.0.{i}", f"host{i}", download_port=8000)
+        for i in range(10)
+    ]
+    peers = []
+    for i in range(24):
+        host = rng.choice(hosts)
+        p = pool.create_peer(f"p{i}", task, host)
+        for ev in ("register", "download"):
+            if rng.random() < 0.85 and p.fsm.can(ev):
+                p.fsm.fire(ev)
+        if rng.random() < 0.3 and p.fsm.can("succeed"):
+            p.fsm.fire("succeed")
+        for _ in range(rng.randrange(0, 6)):
+            p.add_piece_cost(rng.uniform(3, 10))
+        if rng.random() < 0.15:
+            p.add_piece_cost(500.0)  # bad node: >20x the sample mean
+        if rng.random() < 0.2:
+            p.host.upload_limit = 0
+        peers.append(p)
+    # chains deep enough to trip max_tree_depth=4 plus cross edges for lineage
+    for _ in range(12):
+        a, b = rng.sample(peers, 2)
+        if task.can_add_edge(a.id, b.id):
+            task.add_edge(a.id, b.id)
+    return pool, task, peers
+
+
+class TestFlattenedFilters:
+    @pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+    def test_passes_matches_closure_reference(self, seed):
+        pool, task, peers = _random_pool(seed)
+        s = Scheduling(new_evaluator("base"), SchedulingConfig())
+        rng = random.Random(seed * 31)
+        for child in rng.sample(peers, 8):
+            blocklist = {p.id for p in rng.sample(peers, 3)}
+            child.block_parents.add(rng.choice(peers).id)
+            ref = _reference_filters(s, child, blocklist)
+            expected = {p.id for p in peers if all(f(p) for f in ref)}
+            ctx = s._filter_ctx(child, blocklist)
+            got = {p.id for p in peers if s._passes(p, ctx)}
+            # _passes omits the can_add_edge walk (lineage subsumes it for
+            # in-DAG candidates — see the _passes docstring); on these pools
+            # the sets must be identical, proving the omission sound
+            assert got == expected, f"child={child.id} seed={seed}"
+
+    @pytest.mark.parametrize("seed", [11, 12, 13])
+    def test_find_success_parent_matches_reference(self, seed):
+        pool, task, peers = _random_pool(seed)
+        s = Scheduling(new_evaluator("base"), SchedulingConfig())
+        rng = random.Random(seed)
+        for child in rng.sample(peers, 6):
+            ref = _reference_filters(s, child, set())
+            expected = {
+                p.id
+                for p in task.peers()
+                if p.fsm.is_(res.PEER_SUCCEEDED) and all(f(p) for f in ref)
+            }
+            got = s.find_success_parent(child)
+            if expected:
+                assert got is not None and got.id in expected
+            else:
+                assert got is None
+
+    def test_unregistered_child_filters_nothing_by_lineage(self):
+        """DAGError path: a child not in the DAG yet gets an empty lineage
+        (the r05 closure builder's behavior), not an exception. The
+        reference's can_add_edge closure rejected EVERY candidate for such a
+        child (to_id missing from the DAG returns False) — a state the
+        service flow never schedules from (register_peer adds the child
+        before any round), so the flattened pass matches the reference on
+        the other seven conditions and stays permissive on that one."""
+        pool, task, peers = _random_pool(99)
+        s = Scheduling(new_evaluator("base"))
+        host = pool.load_or_create_host("hx", "10.0.1.1", "hostx", download_port=9000)
+        ghost = res.Peer("ghost", task, host)  # never create_peer'd: not in DAG
+        ctx = s._filter_ctx(ghost, set())
+        assert ctx[3] == set()
+        ref_no_cycle = _reference_filters(s, ghost, set())
+        del ref_no_cycle[6]  # the can_add_edge closure (see docstring)
+        assert {p.id for p in peers if s._passes(p, ctx)} == {
+            p.id for p in peers if all(f(p) for f in ref_no_cycle)
+        }
+
+
+class TestServiceRegisterUsesBatchablePath:
+    def test_register_second_peer_still_schedules(self, run):
+        """Smoke: the service's scheduling entry (filter ctx + flattened
+        pass) serves a register_peer round end to end."""
+
+        async def body():
+            svc = SchedulerService()
+            meta = TaskMeta("t1", "http://o/f")
+            await svc.register_peer("p1", meta, HostInfo("h1", "10.0.0.1", "host1", download_port=8001))
+            svc.report_task_metadata("t1", content_length=100 << 20)
+            svc.report_pieces("p1", [(i, 4.0, "") for i in range(10)])
+            out = await svc.register_peer(
+                "p2", meta, HostInfo("h2", "10.0.0.2", "host2", download_port=8002)
+            )
+            assert [p.peer_id for p in out.parents] == ["p1"]
+
+        run(body())

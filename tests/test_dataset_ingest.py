@@ -308,7 +308,7 @@ def test_drainer_coalesces_same_pool_closes(run, tmp_path):
         await svc.train_close({"token": tokens[0]})
         await asyncio.sleep(0.01)  # drainer enters run #1 and blocks
         for t in tokens[1:]:
-            await svc.train_close({"token": t})
+            await svc.train_close({"token": t})  # dflint: disable=DF025 test drives N sequential closes to pin drainer coalescing
         release.set()
         await svc.wait_idle()
         # the 3 closes that landed mid-train share the pool: ONE run covers

@@ -507,6 +507,76 @@ def test_df024_silent_on_policy_sleep():
 
 
 # ---------------------------------------------------------------------------
+# DF025 awaited per-item RPC call in a loop
+
+
+def test_df025_fires_on_per_item_report_in_for_loop():
+    src = """
+    async def report_all(scheduler, peer_id, indices):
+        for idx in indices:
+            await scheduler.report_piece_result(peer_id, idx, success=True)
+    """
+    vs = dflint.lint_source(textwrap.dedent(src), "dragonfly2_tpu/daemon/mod.py")
+    assert [v.check for v in vs] == ["DF025"]
+    assert vs[0].line == 4
+
+
+def test_df025_fires_on_raw_call_in_while_loop():
+    src = """
+    async def drive(client):
+        while True:
+            await client.call("download", {"url": "u"})
+    """
+    assert ids(src) == ["DF025"]
+
+
+def test_df025_silent_outside_loops_and_in_else_block():
+    src = """
+    async def once(scheduler, peer_id):
+        await scheduler.report_piece_result(peer_id, 0, success=True)
+
+    async def scan(scheduler, peer_id, xs):
+        for x in xs:
+            check(x)
+        else:
+            await scheduler.report_peer_result(peer_id, success=True)
+    """
+    assert ids(src) == []
+
+
+def test_df025_silent_on_non_rpc_methods_in_loop():
+    src = """
+    async def drain(queue, store):
+        for item in queue:
+            await store.write_piece(0, item)
+            await queue.join()
+    """
+    assert ids(src) == []
+
+
+def test_df025_silent_inside_rpc_package():
+    # the transport's own retry loop around one call is not per-item chatter
+    src = """
+    async def call(self, method, payload):
+        for attempt in range(self.retries):
+            return await self._inner.call(method, payload)
+    """
+    assert ids(src, path="dragonfly2_tpu/rpc/core.py") == []
+
+
+def test_df025_not_hidden_by_nested_def():
+    # code in a nested def runs later, not per iteration of this loop
+    src = """
+    async def outer(client, xs):
+        for x in xs:
+            async def later():
+                await client.call("m", x)
+            register(later)
+    """
+    assert ids(src) == []
+
+
+# ---------------------------------------------------------------------------
 # DF031 silent swallow
 
 

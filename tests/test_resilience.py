@@ -415,7 +415,7 @@ def test_rpc_write_and_read_faults_are_injected(run):
         try:
             fl = faultline.enable("rpc.read:drop:0.15,seed=5")
             for _ in range(10):
-                assert await client.call("_ping") == "pong"  # retries absorb drops
+                assert await client.call("_ping") == "pong"  # retries absorb drops  # dflint: disable=DF025 chaos probe: N sequential pings ARE the scenario
             assert fl.injected_total("rpc.read") > 0
         finally:
             faultline.disable()

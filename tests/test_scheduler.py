@@ -447,7 +447,7 @@ class TestService:
             meta = TaskMeta("t1", "http://o/f")
             # two seed peers on distinct hosts
             for i in (1, 2):
-                await svc.register_peer(f"p{i}", meta, self._host(i))
+                await svc.register_peer(f"p{i}", meta, self._host(i))  # dflint: disable=DF025 fixture setup: two peers registered sequentially on purpose
                 if i == 1:
                     svc.report_task_metadata("t1", content_length=100 << 20)
                 for j in range(5):

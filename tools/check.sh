@@ -77,5 +77,16 @@ run_stage "chaos-smoke" env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.
     -m 'chaos and not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+# control-plane smoke: the bench section at tiny shapes — catches a broken
+# batched-report / cached-feature / coalesced-write path without paying for
+# a full bench run (the real numbers come from bench.py's control_plane key)
+run_stage "control-plane-smoke" env JAX_PLATFORMS=cpu python -c "
+import bench
+out = bench.bench_control_plane(rounds=50, candidates=8, hosts=24, pieces_per_round=4)
+assert out['full_round_rps'] > 0 and out['evaluator_prepare_us_per_round'] > 0, out
+assert out['piece_report_rpcs_per_round'] == 1, out
+print('control_plane smoke ok:', {k: out[k] for k in ('full_round_rps', 'evaluator_prepare_us_per_round', 'report_wire_us_per_piece_batched')})
+"
+
 summarize
 echo "check.sh: all stages passed"

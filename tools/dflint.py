@@ -33,6 +33,10 @@ Checks (see README.md "Static analysis" for the catalog):
          handler in a loop, or with a delay computed from the loop's attempt
          variable — outside dragonfly2_tpu/resilience/, retries must use the
          shared BackoffPolicy (exponential + seeded jitter) instead
+  DF025  awaited per-item RPC call inside a for/while loop outside rpc/ —
+         the control-plane twin of DF024: one round trip per item serializes
+         the loop on network latency; batch into one call (report_pieces,
+         train_chunk batching) or hoist the RPC out of the loop
   DF031  silent exception swallow: bare/overbroad except whose body is only
          pass/continue/... (no log, no narrowing)
   DF032  mutable default argument (list/dict/set literal or constructor)
@@ -72,6 +76,7 @@ CHECKS: dict[str, str] = {
     "DF022": "time.sleep inside async def (blocks the event loop)",
     "DF023": "lock-guarded attribute also mutated outside the lock",
     "DF024": "raw asyncio.sleep retry loop outside the resilience module",
+    "DF025": "awaited per-item RPC call inside a loop outside rpc/ (batch it)",
     "DF031": "bare/overbroad except silently swallowing the error",
     "DF032": "mutable default argument",
     "DF033": "per-row numpy array construction inside a for loop (vectorize)",
@@ -695,6 +700,61 @@ def check_raw_retry_sleep(tree: ast.Module, path: str) -> Iterator[Violation]:
                                     )
 
 
+# RPC-client verbs whose awaited per-item use inside a loop marks an
+# unbatched control-plane chatter path (DF025). `call` is the raw RpcClient
+# entry; the rest are the scheduler/trainer client protocol verbs. The
+# receiver type is invisible to an AST pass (transports hide behind
+# protocols), so the verb set IS the signal.
+RPC_LOOP_METHODS = {
+    "call",
+    "register_peer", "report_task_metadata", "report_piece_result",
+    "report_pieces", "report_peer_result", "announce_task", "announce_host",
+    "reschedule", "leave_peer", "leave_host", "stat_task", "sync_probes",
+    "train_open", "train_chunk", "train_close",
+}
+
+
+def check_rpc_in_loop(tree: ast.Module, path: str) -> Iterator[Violation]:
+    """DF025: awaited per-item RPC call inside a for/while loop outside rpc/.
+
+    The control-plane twin of DF024: a loop that awaits one RPC round trip
+    per item serializes the loop on the network and multiplies control-plane
+    chatter by the item count — the shape that held a full
+    report_piece_result round trip inline in the piece-worker path until the
+    batched report buffer landed. Detected shape: `await <recv>.<verb>(...)`
+    lexically inside a for/while body (the else block is excluded — it runs
+    once after the loop) where <verb> is an RPC-client verb
+    (RPC_LOOP_METHODS). Retry-of-one-call loops look identical to per-item
+    loops statically; sites that genuinely retry a single call suppress with
+    that reason. The rpc package itself is exempt — its retry/balancer
+    internals are the transport, not per-item chatter."""
+    if "rpc" in Path(path).parts:
+        return
+    seen: set[tuple[int, int]] = set()  # nested loops share bodies
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        for stmt in loop.body:
+            for node in walk_pruned(stmt):
+                if not (
+                    isinstance(node, ast.Await)
+                    and isinstance(node.value, ast.Call)
+                    and isinstance(node.value.func, ast.Attribute)
+                    and node.value.func.attr in RPC_LOOP_METHODS
+                ):
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield Violation(
+                    path, node.lineno, node.col_offset, "DF025",
+                    f"awaited RPC {node.value.func.attr}() once per loop "
+                    "iteration — batch the items into one call (report_pieces "
+                    "/ chunked upload) or hoist the round trip out of the loop",
+                )
+
+
 _BROAD = {"Exception", "BaseException"}
 
 
@@ -818,6 +878,7 @@ ALL_CHECKS = (
     check_sleep_in_async,
     check_lock_discipline,
     check_raw_retry_sleep,
+    check_rpc_in_loop,
     check_silent_swallow,
     check_mutable_defaults,
     check_np_ctor_in_row_loop,
