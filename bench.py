@@ -219,12 +219,13 @@ def bench_native_scoring(
     the single-round call (p50 latency) and the multi-round amortized call
     (df_scorer_score_rounds, `rounds_per_call` queued rounds per FFI hop —
     the 10k-calls/s path). Returns (amortized rounds/s, single-round p50 ms,
-    single-round rounds/s, multi-round call p50 ms); zeros when no C++
-    toolchain is available."""
+    single-round rounds/s, multi-round call p50 ms); all-None when no C++
+    toolchain is available (skipped ≠ measured-zero, VERDICT #8)."""
     import shutil
 
     if shutil.which("g++") is None:
-        return 0.0, 0.0, 0.0, 0.0
+        print("bench: native_scoring skipped (no g++ toolchain)", file=sys.stderr, flush=True)
+        return None, None, None, None
     import jax
     import jax.numpy as jnp
 
@@ -453,7 +454,7 @@ def bench_gnn_train_scaled(calls: int = 3, steps_per_call: int = 10) -> tuple[fl
         # ~0.4 TFLOP/step exists to exercise the MXU; on the CPU fallback it
         # would only burn the section budget
         print("bench: gnn_train_scaled skipped on cpu backend", file=sys.stderr, flush=True)
-        return 0.0, 0.0, 0.0, 0.0, -1
+        return None, None, None, None, None
     return _gnn_train_measured(
         num_nodes=16384, hidden=512, batch_size=16384,
         calls=calls, steps_per_call=steps_per_call,
@@ -1147,7 +1148,10 @@ def main() -> None:
 
     def run_section(name: str, fn, default):
         """Each section is independently timed out and error-trapped: one
-        broken path must not cost the round its entire perf evidence."""
+        broken path must not cost the round its entire perf evidence.
+        `default` is None-shaped (never zeros): a section that failed or
+        skipped emits null in the JSON, so a broken path can never read as a
+        measured 0.0 regression (VERDICT #8 bench hygiene)."""
         try:
             with _deadline(_SECTION_TIMEOUT_S):
                 return fn()
@@ -1156,56 +1160,68 @@ def main() -> None:
             print(f"bench: section {name} failed: {errors[name]}", file=sys.stderr, flush=True)
             return default
 
+    def _r(x, nd=1):
+        """null-safe round: skipped sections carry None through to the JSON."""
+        return None if x is None else round(x, nd)
+
     jax_calls_per_sec, jax_p50_ms, jax_multi_rps = run_section(
-        "jax_scoring", bench_scoring, (0.0, 0.0, 0.0)
+        "jax_scoring", bench_scoring, (None, None, None)
     )
     (
         native_calls_per_sec,
         native_p50_ms,
         native_single_rps,
         native_multi_call_p50_ms,
-    ) = run_section("native_scoring", bench_native_scoring, (0.0, 0.0, 0.0, 0.0))
+    ) = run_section("native_scoring", bench_native_scoring, (None, None, None, None))
     steps_per_sec, steps_median, flops_per_step, bytes_per_step, conv_steps = run_section(
-        "gnn_train", bench_gnn_train, (0.0, 0.0, 0.0, 0.0, -1)
+        "gnn_train", bench_gnn_train, (None, None, None, None, None)
     )
     scaled_sps, scaled_median, scaled_flops, scaled_bytes, _ = run_section(
-        "gnn_train_scaled", bench_gnn_train_scaled, (0.0, 0.0, 0.0, 0.0, -1)
+        "gnn_train_scaled", bench_gnn_train_scaled, (None, None, None, None, None)
     )
-    fanout_mbps, disk_mbps = run_section("checkpoint_fanout", bench_checkpoint_fanout, (0.0, 0.0))
+    fanout_mbps, disk_mbps = run_section("checkpoint_fanout", bench_checkpoint_fanout, (None, None))
     piece_pipeline = run_section("piece_pipeline", bench_piece_pipeline, {})
     dataset_build = run_section("dataset_build", bench_dataset_build, {})
     control_plane = run_section("control_plane", bench_control_plane, {})
-    mlp_sps, mlp_mse = run_section("mlp_train", bench_mlp_train, (0.0, -1.0))
+    mlp_sps, mlp_mse = run_section("mlp_train", bench_mlp_train, (None, None))
     serving = run_section("evaluator_serving", bench_evaluator_serving, {})
     # headline = the production serving path: native C++ scorer when the
     # toolchain exists (config 5 "no GPU"), else the jitted JAX fallback
-    calls_per_sec = max(jax_calls_per_sec, native_calls_per_sec)
+    # (the headline `value` stays numeric — the driver parses it — but the
+    # per-section keys below are null when their section skipped)
+    calls_per_sec = max(jax_calls_per_sec or 0.0, native_calls_per_sec or 0.0)
+    skipped = sorted(
+        name for name, probe in (
+            ("native_scoring", native_calls_per_sec),
+            ("gnn_train_scaled", scaled_median),
+        ) if probe is None and name not in errors
+    )
     extra = {
-        "native_scoring_calls_per_sec": round(native_calls_per_sec, 1),
-        "native_scoring_p50_ms": round(native_p50_ms, 4),
-        "native_single_round_calls_per_sec": round(native_single_rps, 1),
+        "native_scoring_calls_per_sec": _r(native_calls_per_sec, 1),
+        "native_scoring_p50_ms": _r(native_p50_ms, 4),
+        "native_single_round_calls_per_sec": _r(native_single_rps, 1),
         "native_rounds_per_ffi_call": _ROUNDS_PER_FFI_CALL,
-        "native_multi_call_p50_ms": round(native_multi_call_p50_ms, 4),
-        "jax_scoring_calls_per_sec": round(jax_calls_per_sec, 1),
-        "jax_scoring_p50_ms": round(jax_p50_ms, 3),
-        "jax_scoring_multi_calls_per_sec": round(jax_multi_rps, 1),
+        "native_multi_call_p50_ms": _r(native_multi_call_p50_ms, 4),
+        "jax_scoring_calls_per_sec": _r(jax_calls_per_sec, 1),
+        "jax_scoring_p50_ms": _r(jax_p50_ms, 3),
+        "jax_scoring_multi_calls_per_sec": _r(jax_multi_rps, 1),
         # headline pinned to the MEDIAN window (ADVICE r05 #3: r05 silently
         # switched this key to best-of-window, making round-over-round diffs
         # apples-to-oranges; the best window — the machine's stall-free
         # capability — now lives under its own explicit key)
-        "gnn_train_steps_per_sec": round(steps_median, 2),
-        "gnn_train_steps_per_sec_best_window": round(steps_per_sec, 2),
-        "gnn_train_steps_per_sec_median_window": round(steps_median, 2),
+        "gnn_train_steps_per_sec": _r(steps_median, 2),
+        "gnn_train_steps_per_sec_best_window": _r(steps_per_sec, 2),
+        "gnn_train_steps_per_sec_median_window": _r(steps_median, 2),
         "gnn_timing_method": "median_of_4_windows",
         # north-star config 1: MLP bandwidth predictor on the scheduler host
         # CPU (its own deployment hardware)
-        "mlp_train_steps_per_sec_cpu": round(mlp_sps, 2),
-        "mlp_train_mse": round(mlp_mse, 5),
-        "checkpoint_fanout_mb_per_s": round(fanout_mbps, 1),
+        "mlp_train_steps_per_sec_cpu": _r(mlp_sps, 2),
+        "mlp_train_mse": _r(mlp_mse, 5),
+        "checkpoint_fanout_mb_per_s": _r(fanout_mbps, 1),
         # the fetch side writes every byte to its piece store, so raw disk
         # write throughput on the same filesystem is its hard ceiling — when
         # the two are close, the remaining fan-out bottleneck is the disk
-        "checkpoint_fanout_disk_write_ceiling_mb_per_s": round(disk_mbps, 1),
+        "checkpoint_fanout_disk_write_ceiling_mb_per_s": _r(disk_mbps, 1),
         "checkpoint_fanout_note": (
             "store on tmpfs (container disk throttling is 8-4000 MB/s "
             "run-to-run noise); big pieces ride the zero-copy pipeline "
@@ -1213,18 +1229,18 @@ def main() -> None:
             "hash-on-receive on a second core, writer-thread store writes "
             "— the piece_pipeline_* keys decompose the per-stage budget"
         ),
-        "piece_pipeline_mb_per_s": piece_pipeline.get("pipelined_mb_per_s", 0.0),
-        "piece_pipeline_stages": piece_pipeline,
+        "piece_pipeline_mb_per_s": piece_pipeline.get("pipelined_mb_per_s"),
+        "piece_pipeline_stages": piece_pipeline or "skipped",
         # the trainer's record plane: vectorized telemetry→dataset ingest vs
         # the rowloop reference (interleaved median-of-3), plus the
         # incremental chunk-fold rate and the train_close→Dataset latency
-        "dataset_build_rows_per_sec": dataset_build.get("dataset_build_rows_per_sec", 0.0),
-        "dataset_build": dataset_build,
+        "dataset_build_rows_per_sec": dataset_build.get("dataset_build_rows_per_sec"),
+        "dataset_build": dataset_build or "skipped",
         # the scheduler control plane decomposed (prepare/score/report legs,
         # interleaved same-run A/B vs the r05 shapes) — distinct from the
         # native-FFI serving section below, which needs the C++ toolchain
-        "control_plane_full_round_rps": control_plane.get("full_round_rps", 0.0),
-        "control_plane": control_plane,
+        "control_plane_full_round_rps": control_plane.get("full_round_rps"),
+        "control_plane": control_plane or "skipped",
         "backend": backend,
         **serving,
     }
@@ -1237,8 +1253,8 @@ def main() -> None:
     peak_hbm_gbps = 819.0  # v5e HBM bandwidth GB/s
     ridge = peak_tflops * 1e12 / (peak_hbm_gbps * 1e9)
 
-    def utilization(prefix: str, sps: float, flops: float, nbytes: float) -> None:
-        if flops <= 0 or sps <= 0:
+    def utilization(prefix: str, sps, flops, nbytes) -> None:
+        if not flops or not sps:  # skipped (None) or measured-zero: no keys
             return
         achieved_tflops = flops * sps / 1e12
         extra[f"{prefix}_flops_per_step"] = round(flops)
@@ -1256,15 +1272,16 @@ def main() -> None:
                 )
 
     utilization("gnn", steps_per_sec, flops_per_step, bytes_per_step)
-    # same median-headline discipline as the config-2 number (ADVICE r05 #3)
-    extra["gnn_train_scaled_steps_per_sec"] = round(scaled_median, 2)
-    extra["gnn_train_scaled_steps_per_sec_best_window"] = round(scaled_sps, 2)
-    extra["gnn_train_scaled_steps_per_sec_median_window"] = round(scaled_median, 2)
+    # same median-headline discipline as the config-2 number (ADVICE r05 #3);
+    # null (not 0.0) when the scaled section skipped on the cpu backend
+    extra["gnn_train_scaled_steps_per_sec"] = _r(scaled_median, 2)
+    extra["gnn_train_scaled_steps_per_sec_best_window"] = _r(scaled_sps, 2)
+    extra["gnn_train_scaled_steps_per_sec_median_window"] = _r(scaled_median, 2)
     utilization("gnn_scaled", scaled_sps, scaled_flops, scaled_bytes)
     if backend == "tpu":
         extra["gnn_mfu_peak_tflops_assumed"] = peak_tflops
         extra["gnn_hbm_peak_gbps_assumed"] = peak_hbm_gbps
-    if steps_per_sec > 0 and conv_steps >= 0:
+    if steps_per_sec and conv_steps is not None and conv_steps >= 0:
         # MEASURED steps to the halved-loss-window criterion on the config-2
         # synthetic (same criterion the sharded-convergence test pins); the
         # v5e-16 number extrapolates the measured single-chip time with
@@ -1285,6 +1302,8 @@ def main() -> None:
                 "loss window did not halve within 3000 steps — convergence "
                 "regression"
             )
+    if skipped:
+        extra["skipped"] = skipped
     if errors:
         extra["errors"] = errors
     print(_payload(calls_per_sec, extra), flush=True)

@@ -36,6 +36,7 @@ from dragonfly2_tpu.daemon.storage import StorageManager, TaskStorage
 from dragonfly2_tpu.resilience import deadline as dl
 from dragonfly2_tpu.resilience import faultline
 from dragonfly2_tpu.resilience.backoff import BackoffPolicy
+from dragonfly2_tpu.rpc.core import RpcError
 from dragonfly2_tpu.scheduler.service import HostInfo, ParentInfo, RegisterResult, TaskMeta
 from dragonfly2_tpu.utils import digest as digestlib
 from dragonfly2_tpu.utils.aio import gather_all_cancel_on_error
@@ -344,6 +345,15 @@ class PeerTaskConductor:
         self.ts: TaskStorage | None = None
         self.bytes_from_parents = 0
         self.bytes_from_source = 0
+        # refetch accounting for crash-safe resume: pieces already on disk
+        # when this conductor started (recovered from a previous run) vs
+        # pieces it NEWLY LANDED — the restart suite pins
+        # preexisting + fetched == total (recovered pieces never ride again
+        # on ranged/p2p paths; a close-delimited full-body fallback re-carries
+        # their bytes — visible in bytes_from_source — but still never
+        # re-lands or re-reports them)
+        self.pieces_preexisting = 0
+        self.pieces_fetched = 0
         self._piece_digests: dict[str, str] = {}  # learned from parent metadata
         # Whether the final full-content re-hash can be skipped: true only if
         # EVERY byte of the task was landed by THIS conductor with each piece
@@ -419,7 +429,8 @@ class PeerTaskConductor:
             application=self.meta.application,
         )
         self.ts.pin()  # immune to storage reclaim while this download runs
-        self._had_preexisting_pieces = self.ts.finished_count() > 0
+        self.pieces_preexisting = self.ts.finished_count()
+        self._had_preexisting_pieces = self.pieces_preexisting > 0
 
         if reg.scope == "empty":
             self.ts.set_task_info(content_length=0, piece_size=1, total_pieces=0)
@@ -474,7 +485,9 @@ class PeerTaskConductor:
         self.ts.set_task_info(
             content_length=len(data), piece_size=max(1, len(data)), total_pieces=1
         )
-        await self.ts.write_piece(0, data)
+        if not self.ts.has_piece(0):
+            await self.ts.write_piece(0, data)
+            self.pieces_fetched += 1
         self.ts.mark_done()
         await self._safe_report_peer(success=True)
 
@@ -623,8 +636,14 @@ class PeerTaskConductor:
     async def _write_source_piece(self, idx: int, data: bytes, t0: float) -> None:
         from dragonfly2_tpu.daemon import metrics
 
-        await self.ts.write_piece(idx, data)
         self.bytes_from_source += len(data)
+        if self.ts.has_piece(idx):
+            # recovered piece on a resumed task: the close-delimited stream
+            # re-carried its bytes (no Range support — unavoidable), but it
+            # is already landed and reported; re-landing would re-hash and
+            # re-count it, and a re-report would double piece accounting
+            return
+        await self.ts.write_piece(idx, data)
         metrics.PIECE_DOWNLOAD_TOTAL.inc(source="back_to_source")
         metrics.DOWNLOAD_BYTES.inc(len(data))
         await self._report_piece_success(idx, (time.monotonic() - t0) * 1000)
@@ -644,8 +663,11 @@ class PeerTaskConductor:
             digest=self.meta.digest,
         )
         for idx in range(self.ts.meta.total_pieces):
+            if self.ts.has_piece(idx):
+                continue  # recovered piece: already landed, never re-land
             r = piece_range(idx, psize, len(data))
             await self.ts.write_piece(idx, data[r.start : r.start + r.length])
+            self.pieces_fetched += 1
         self.bytes_from_source += len(data)
         await self.scheduler.report_task_metadata(
             self.meta.task_id,
@@ -674,7 +696,7 @@ class PeerTaskConductor:
                         if reschedules > self.cfg.reschedule_limit:
                             await self._download_back_to_source()
                             return
-                        reg = await self.scheduler.reschedule(self.peer_id)  # dflint: disable=DF025 one budget-bounded reschedule per empty dispatch round, not per-item chatter
+                        reg = await self._reschedule()  # dflint: disable=DF025 one budget-bounded reschedule per empty dispatch round, not per-item chatter
                         if reg.back_to_source:
                             await self._download_back_to_source()
                             return
@@ -704,7 +726,7 @@ class PeerTaskConductor:
                         await self._download_back_to_source()
                         return
                     reschedules += 1
-                    reg = await self.scheduler.reschedule(self.peer_id)  # dflint: disable=DF025 one budget-bounded reschedule per no-progress window, not per-item chatter
+                    reg = await self._reschedule()  # dflint: disable=DF025 one budget-bounded reschedule per no-progress window, not per-item chatter
                     if reg.back_to_source:
                         await self._download_back_to_source()
                         return
@@ -740,6 +762,48 @@ class PeerTaskConductor:
                 t.cancel()
             await asyncio.gather(*self._sync_tasks.values(), return_exceptions=True)
             self._sync_tasks.clear()
+
+    async def _reschedule(self) -> RegisterResult:
+        """reschedule with scheduler-restart recovery: a scheduler that lost
+        this peer (process restart wiped its resource pool, or GC evicted
+        us) answers not_found — re-register instead of failing the task, and
+        push back what the fresh scheduler is missing (task metadata + the
+        pieces this peer already holds) so it rebuilds its view from
+        announces alone. The daemons' existing backoff+breaker path already
+        covers the reconnect; this covers the state."""
+        try:
+            return await self.scheduler.reschedule(self.peer_id)
+        except KeyError:
+            pass  # in-process client surfaces the raw lookup failure
+        except RpcError as e:
+            if e.code != "not_found":
+                raise
+        self.log.info("scheduler lost peer %s: re-registering", self.peer_id)
+        reg = await self.scheduler.register_peer(self.peer_id, self.meta, self.host)
+        if getattr(reg, "error", ""):
+            raise IOError(
+                f"task {self.meta.task_id}: re-registration refused: {reg.error}"
+            )
+        if self.ts is not None and self.ts.meta.content_length >= 0:
+            try:
+                # announce_task, not report_pieces: possession is declared
+                # metrics-free (a success report would re-count
+                # DOWNLOAD_TRAFFIC_BYTES for bytes the old incarnation of
+                # this scheduler may already have counted, and feed 0.0 cost
+                # samples into the peer's parent-selection feature). The
+                # announce adopts the row just re-registered (same peer_id),
+                # sets task metadata, and marks the held pieces.
+                await self.scheduler.announce_task(
+                    self.peer_id, self.meta, self.host,
+                    content_length=self.ts.meta.content_length,
+                    piece_size=self.ts.meta.piece_size,
+                    piece_indices=sorted(self.ts.finished.indices()),
+                    digest=self.ts.meta.digest,
+                )
+            except Exception as e:  # noqa: BLE001 — advisory rebuild; the
+                # download itself only needs the registration to stand
+                self.log.debug("post-re-register state push failed: %r", e)
+        return reg
 
     async def _wait_update(self) -> bool:
         """Park until any parent sync loop reports progress (piece landed,
@@ -1065,6 +1129,7 @@ class PeerTaskConductor:
         RPC on the piece path) or fall back to the unary best-effort report.
         Either way a landed piece is never failed by its report (the
         worker-level catch would re-enqueue a piece that needs no refetch)."""
+        self.pieces_fetched += 1
         if self._reports is not None:
             self._reports.add(idx, cost_ms, parent_id)
             return

@@ -145,6 +145,21 @@ class PeerEngine:
         self.gc.add("raw-pool-prune", 120.0, self._prune_raw_pool)
         self._raw_client = None
         self._piece_pipeline = None
+        # Live conductor tasks: a caller cancelling its download_task future
+        # does NOT cancel the conductor (awaiting a task never owns it) — the
+        # engine owns them, so stop() (and the test crash harness) can
+        # terminate in-flight downloads instead of leaving orphan tasks
+        # writing into storage after the engine is gone.
+        self._conductors: set[asyncio.Task] = set()
+        # Stable per-task peer id for possession announces: announce_task
+        # supersedes every OTHER same-host row, and create_peer returns an
+        # existing row unchanged — so reusing one id per task makes the
+        # periodic keepalive announce an exact no-op on the scheduler
+        # (a fresh random id per announce would delete the live seed row,
+        # severing children's DAG edges every interval). A conductor's
+        # download registers its own id here so later announces adopt the
+        # very row children are already attached to.
+        self._announce_peer_ids: dict[str, str] = {}
         self._started = False
 
     async def _run_reclaim(self, **kw) -> None:
@@ -174,9 +189,86 @@ class PeerEngine:
 
     async def start(self) -> None:
         if not self._started:
+            # Crash recovery BEFORE the upload server opens: the audit
+            # digest-verifies every claimed piece of restored incomplete
+            # tasks (a metadata snapshot can claim bits over torn data after
+            # a machine crash), so a torn piece is never servable even
+            # briefly. Disk-heavy → worker thread.
+            recovered = await asyncio.to_thread(self.storage.recover)
             await self.upload.start()
             self.gc.start()
             self._started = True
+            await self._announce_recovered(recovered)
+
+    async def _announce_recovered(self, recovered) -> None:
+        """Re-announce every restored task's surviving pieces so this peer
+        rejoins the swarm as a (possibly partial) seed — the reference daemon
+        reloads data+metadata and resumes serving (local_storage.go), but a
+        rejoin the scheduler never hears about serves nobody. Best-effort:
+        a scheduler that is down at boot is retried by the daemon's periodic
+        announce loop (announce_tasks)."""
+        from dragonfly2_tpu.daemon import metrics
+
+        for ts, kept, dropped in recovered:
+            if dropped:
+                metrics.PIECE_DROPPED_RECOVERY_TOTAL.inc(len(dropped))
+            if kept == 0:
+                continue  # fully-torn task: drops counted, nothing to announce
+            metrics.PIECE_RECOVERED_TOTAL.inc(kept)
+            state = "done" if ts.meta.done else "partial"
+            if await self._announce_possession(ts):
+                metrics.TASK_RECOVERED_TOTAL.inc(state=state)
+                logger.info(
+                    "task %s: recovered %d piece(s) (%s), re-announced",
+                    ts.meta.task_id[:12], kept, state,
+                )
+
+    async def _announce_possession(self, ts: TaskStorage) -> bool:
+        """One announce_task RPC claiming this host's on-disk pieces; the
+        scheduler supersedes any ghost peer rows this host left behind."""
+        m = ts.meta
+        meta = TaskMeta(
+            task_id=m.task_id, url=m.url, digest=m.digest,
+            tag=m.tag, application=m.application,
+        )
+        peer_id = self._announce_peer_ids.setdefault(
+            m.task_id, idgen.peer_id(self.ip, self.hostname)
+        )
+        try:
+            await self.scheduler.announce_task(
+                peer_id, meta, self.host_info(),
+                content_length=m.content_length, piece_size=m.piece_size,
+                piece_indices=sorted(ts.finished.indices()), digest=m.digest,
+            )
+            return True
+        except Exception:  # noqa: BLE001 — boot/keepalive announce is advisory;
+            # the periodic loop retries and downloads still work unannounced
+            logger.warning("announce of task %s failed", m.task_id[:12], exc_info=True)
+            return False
+
+    async def announce_tasks(self, *, include_partial: bool = True) -> int:
+        """Re-announce possession of locally-held tasks (daemon announce
+        loop): after a scheduler restart its resource pool is empty, and the
+        existing backoff+breaker reconnect alone would leave this host's
+        content invisible — the scheduler rebuilds its view from these
+        announces alone. Stable per-task peer ids make this idempotent on a
+        scheduler that did NOT restart (the announce adopts the existing
+        row). Partial tasks are included by default — a recovered partial
+        seed must survive a scheduler restart that postdates the boot
+        announce — but a PINNED incomplete task is skipped: its running
+        conductor owns the scheduler-side peer row."""
+        n = 0
+        for ts in self.storage.tasks():
+            m = ts.meta
+            if m.total_pieces is None or m.total_pieces < 0 or ts.finished_count() == 0:
+                continue
+            if not m.done and not include_partial:
+                continue
+            if not m.done and ts.pins > 0:
+                continue  # a running conductor owns this task's peer row
+            if await self._announce_possession(ts):
+                n += 1
+        return n
 
     def _shared_raw_client(self):
         """One raw range client for ALL conductors: keep-alive connections to
@@ -205,8 +297,17 @@ class PeerEngine:
             if closed:
                 logger.debug("raw range pool: pruned %d idle sockets", closed)
 
+    async def cancel_conductors(self) -> None:
+        """Terminate in-flight downloads (shutdown / crash-harness path)."""
+        for t in list(self._conductors):
+            t.cancel()
+        if self._conductors:
+            await asyncio.gather(*list(self._conductors), return_exceptions=True)
+        self._conductors.clear()
+
     async def stop(self) -> None:
         if self._started:
+            await self.cancel_conductors()
             self.gc.stop()
             await self.upload.stop()
             await self.sources.close()
@@ -278,6 +379,9 @@ class PeerEngine:
             logger.warning("task %s: local copy corrupt, purging", meta.task_id[:12])
             self.storage.delete_task(meta.task_id)
         peer_id = idgen.peer_id(self.ip, self.hostname, seed=seed)
+        # later possession announces adopt this download's row (same id)
+        # instead of superseding it out from under attached children
+        self._announce_peer_ids[meta.task_id] = peer_id
         conductor = PeerTaskConductor(
             peer_id=peer_id,
             meta=meta,
@@ -292,6 +396,8 @@ class PeerEngine:
             pipeline=self._shared_pipeline(),
         )
         producer = asyncio.ensure_future(conductor.run())
+        self._conductors.add(producer)
+        producer.add_done_callback(self._conductors.discard)
         # Wait until the conductor registered storage + metadata. Polling:
         # the TaskStorage (and its progress event) does not exist until the
         # conductor registers with the scheduler, so there is nothing to

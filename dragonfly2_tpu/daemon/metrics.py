@@ -30,3 +30,18 @@ PROXY_REQUEST_TOTAL = _r.counter(
     "proxy_request_total", "Proxy requests", subsystem="dfdaemon", labels=("via",)
 )
 SEED_TASK_TOTAL = _r.counter("seed_task_total", "Seed tasks triggered", subsystem="dfdaemon")
+# crash-safe restart accounting: tasks re-announced at boot, pieces that
+# survived the recovery audit, and claimed pieces the audit dropped
+# (torn/unverifiable) — the suite's proof that recovered pieces never ride
+# the wire again hangs off these plus PIECE_DOWNLOAD_TOTAL deltas
+TASK_RECOVERED_TOTAL = _r.counter(
+    "task_recovered_total", "Tasks re-announced after restart",
+    subsystem="dfdaemon", labels=("state",),
+)
+PIECE_RECOVERED_TOTAL = _r.counter(
+    "piece_recovered_total", "Pieces verified back in at boot", subsystem="dfdaemon"
+)
+PIECE_DROPPED_RECOVERY_TOTAL = _r.counter(
+    "piece_dropped_recovery_total",
+    "Claimed pieces dropped by the recovery audit", subsystem="dfdaemon",
+)

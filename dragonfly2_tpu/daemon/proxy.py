@@ -209,6 +209,22 @@ class ProxyServer:
                 pass  # already closed by the peer
 
     @staticmethod
+    async def _respond_simple(
+        writer: asyncio.StreamWriter, status: int, body: bytes
+    ) -> None:
+        reason = {400: "Bad Request", 502: "Bad Gateway"}.get(status, "Error")
+        writer.write(
+            (
+                f"HTTP/1.1 {status} {reason}\r\n"
+                f"Content-Length: {len(body)}\r\n"
+                "Content-Type: text/plain\r\n"
+                "Connection: close\r\n\r\n"
+            ).encode("latin1")
+            + body
+        )
+        await writer.drain()
+
+    @staticmethod
     async def _read_request(reader: asyncio.StreamReader):
         """Parse request line + headers (body handling is per-route).
 
@@ -284,13 +300,19 @@ class ProxyServer:
             return
         writer.write(b"HTTP/1.1 200 Connection established\r\n\r\n")
         await writer.drain()
+        loop = asyncio.get_running_loop()
         try:
-            # server-side handshake: StreamWriter.start_tls infers server
-            # side from the server-connection protocol
-            await writer.start_tls(ctx)
+            # Server-side TLS upgrade on the accepted stream. 3.10 has no
+            # StreamWriter.start_tls (3.11+) — replicate it with the loop
+            # API + transport rewire, same idiom as SniProxy._handle_hijack.
+            transport = await loop.start_tls(
+                writer.transport, writer.transport.get_protocol(), ctx,
+                server_side=True,
+            )
         except (OSError, asyncio.IncompleteReadError) as e:
             logger.debug("MITM handshake with client failed for %s: %s", host, e)
             return
+        writer._transport = transport  # rewire like StreamWriter.start_tls does
         netloc = host if port == 443 else f"{host}:{port}"
         await self._serve_tunnel_requests(
             reader,

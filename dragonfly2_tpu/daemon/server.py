@@ -374,6 +374,10 @@ async def run_daemon(
         while True:
             try:
                 await scheduler.announce_host(engine.host_info(), _host_stats())  # dflint: disable=DF025 periodic keepalive schedule (one announce per interval), not per-item fan-out
+                # possession keepalive: a restarted scheduler has an empty
+                # resource pool — re-announcing held tasks every interval is
+                # what lets it rebuild its parent view from announces alone
+                await engine.announce_tasks()
             except Exception:
                 logger.warning("announce failed", exc_info=True)
             if manager is not None:

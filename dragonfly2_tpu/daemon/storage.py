@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import json
+import logging
 import os
 import time
 from dataclasses import asdict, dataclass, field
@@ -20,6 +21,8 @@ from dragonfly2_tpu.resilience import faultline
 from dragonfly2_tpu.utils import digest as digestlib
 from dragonfly2_tpu.utils.bitset import Bitset
 from dragonfly2_tpu.utils.pieces import Range, piece_range
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -132,6 +135,12 @@ class TaskStorage:
     _META_FLUSH_S = 1.0
 
     def save_metadata(self) -> None:
+        if faultline.ACTIVE is not None:
+            # `storage.meta`: injected metadata-flush errors/latency — makes
+            # the debounced-metadata loss window (pieces landed but not yet
+            # flushed at crash time) exercisable deterministically instead of
+            # only by kill timing. Errors propagate like a real disk failure.
+            faultline.ACTIVE.check("storage.meta", blocking_latency=True)
         self.meta.finished_pieces = self._bitset.to_int()
         self.meta.updated_at = time.time()
         tmp = self.dir / "metadata.json.tmp"
@@ -402,6 +411,68 @@ class TaskStorage:
             except asyncio.TimeoutError:
                 pass  # periodic re-check (covers producer death + lost wakeups)
 
+    def verify_recovered_pieces(self) -> tuple[int, list[int]]:
+        """Crash-recovery audit of the finished-piece bitset (sync — the boot
+        path runs it on a worker thread before the upload server opens).
+
+        The debounced-metadata design makes two crash windows possible:
+        pieces written but not yet flushed (bits LOST — they simply refetch,
+        never double-count), and — on a machine crash, where the metadata
+        rename can reach disk while data blocks don't — bits CLAIMED over
+        torn/zeroed data. This audit closes the second window: every claimed
+        piece of an incomplete task is digest-verified against its recorded
+        piece digest; a piece that is out of the data file's actual bounds,
+        has no recorded digest, or fails its hash is dropped from the bitset
+        so it refetches instead of being served or counted.
+
+        Done tasks take a length-only fast path: completion always flushed
+        metadata AFTER the last data write, and the reuse path's full verify()
+        still guards serving — re-hashing every seed task at boot would make
+        daemon restarts O(store size). A done task whose data length is wrong
+        is demoted to the full per-piece audit.
+
+        Returns (kept_count, dropped_indices); metadata is re-persisted when
+        anything was dropped (done is cleared if the task is no longer
+        complete)."""
+        m = self.meta
+        if m.total_pieces is None or m.total_pieces < 0 or m.piece_size <= 0:
+            return self._bitset.count(), []
+        try:
+            actual = self.data_path.stat().st_size
+        except OSError:
+            actual = 0
+        if m.done and actual == m.content_length:
+            return self._bitset.count(), []
+        import hashlib
+
+        dropped: list[int] = []
+        with open(self.data_path, "rb") as f:
+            for idx in sorted(self._bitset.indices()):
+                r = piece_range(idx, m.piece_size, m.content_length)
+                expected = m.piece_digests.get(str(idx), "")
+                ok = bool(expected) and r.start + r.length <= actual
+                if ok:
+                    f.seek(r.start)
+                    h = hashlib.sha256()
+                    remaining = r.length
+                    while remaining > 0:
+                        chunk = f.read(min(1 << 20, remaining))
+                        if not chunk:
+                            ok = False
+                            break
+                        h.update(chunk)
+                        remaining -= len(chunk)
+                    ok = ok and h.hexdigest() == expected
+                if not ok:
+                    dropped.append(idx)
+        if dropped:
+            for idx in dropped:
+                self._bitset.clear(idx)
+                m.piece_digests.pop(str(idx), None)
+            m.done = m.done and self.is_complete()
+            self.save_metadata()
+        return self._bitset.count(), dropped
+
     def verify(self) -> bool:
         """Full-content digest check against task digest (if known)."""
         if not self.meta.digest:
@@ -441,12 +512,72 @@ class StorageManager:
         self._load_existing()
 
     def _load_existing(self) -> None:
+        # Crash leftovers first: a metadata.json.tmp with no metadata.json is
+        # a crash between the tmp write and the atomic replace — promote it
+        # when it parses (it IS the newest durable snapshot); with a final
+        # file present the replace completed and the tmp is stale garbage.
+        for tmp in self.root.glob("*/metadata.json.tmp"):
+            final = tmp.with_name("metadata.json")
+            try:
+                if final.exists():
+                    tmp.unlink(missing_ok=True)
+                    continue
+                json.loads(tmp.read_text())  # promote only a parseable snapshot
+                tmp.replace(final)
+            except (json.JSONDecodeError, OSError, UnicodeDecodeError):
+                logger.warning("discarding unusable crash leftover %s", tmp)
+                tmp.unlink(missing_ok=True)
         for meta_path in self.root.glob("*/metadata.json"):
             try:
                 meta = TaskMetadata(**json.loads(meta_path.read_text()))
                 self._tasks[meta.task_id] = TaskStorage(self.root, meta)
-            except (json.JSONDecodeError, TypeError):
+            except (json.JSONDecodeError, TypeError, ValueError, KeyError,
+                    AttributeError, OSError, UnicodeDecodeError):
+                # corrupt/truncated metadata (or wrong-typed fields blowing up
+                # TaskStorage init): quarantine rather than retry every boot —
+                # the rename keeps the evidence, stops this dir from loading,
+                # and lets a future register_task start the task over fresh
+                logger.warning("quarantining corrupt task metadata %s", meta_path)
+                try:
+                    meta_path.replace(meta_path.with_name("metadata.json.corrupt"))
+                except OSError:
+                    logger.warning("quarantine rename failed for %s", meta_path)
                 continue
+
+    def recover(self) -> list[tuple[TaskStorage, int, list[int]]]:
+        """Audit every restored task's finished-piece bitset against its
+        on-disk bytes (TaskStorage.verify_recovered_pieces) — the boot-time
+        half of crash-safe restarts. Sync and disk-heavy: the engine runs it
+        on a worker thread BEFORE the upload server opens, so a claimed-but-
+        torn piece is never servable, even briefly. Returns
+        [(task, kept_count, dropped_indices)] for every audited task —
+        including kept == 0 (fully torn), so the engine's drop accounting
+        sees the worst-damage case too; it only re-announces kept > 0."""
+        out: list[tuple[TaskStorage, int, list[int]]] = []
+        for ts in list(self._tasks.values()):
+            try:
+                kept, dropped = ts.verify_recovered_pieces()
+            except OSError as e:
+                # data file unreadable: quarantine (unload + stop reloading)
+                # without deleting bytes — an operator can still inspect them
+                logger.warning(
+                    "recovery audit of task %s failed (%r): quarantining",
+                    ts.meta.task_id[:12], e,
+                )
+                self._tasks.pop(ts.meta.task_id, None)
+                try:
+                    (ts.dir / "metadata.json").replace(ts.dir / "metadata.json.corrupt")
+                except OSError:
+                    logger.warning("quarantine rename failed for %s", ts.dir)
+                continue
+            if dropped:
+                logger.warning(
+                    "task %s: dropped %d torn/unverifiable piece(s) at recovery",
+                    ts.meta.task_id[:12], len(dropped),
+                )
+            if kept > 0 or dropped:
+                out.append((ts, kept, dropped))
+        return out
 
     def register_task(self, task_id: str, **meta_kw) -> TaskStorage:
         ts = self._tasks.get(task_id)

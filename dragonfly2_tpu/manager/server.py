@@ -40,6 +40,7 @@ class ManagerServer:
         object_storage_dir: str | None = None,
         object_storage=None,
         searcher: str = "default",
+        ssl=None,
     ):
         self.db = Database(db_path)
         self.service = ManagerService(
@@ -62,7 +63,13 @@ class ManagerServer:
         if admin_password and not self.db.find("users", name="admin"):
             self.service.create_user("admin", admin_password, role="admin")
             logger.info("bootstrapped admin user")
-        self.rpc = RpcServer(host=host, port=port)
+        # `ssl`: an ssl.SSLContext (security.ca.server_ssl_context) puts the
+        # manager's control RPC on TLS too. Bootstrap order: construct the
+        # CertificateAuthority on ca_dir first, self-issue the manager's leaf,
+        # build the context, then pass BOTH ca_dir and ssl here — the CA class
+        # reloads the same ca.pem/ca.key, so issuance and serving share one
+        # trust root (the mTLS e2e test in tests/test_restart.py is the recipe).
+        self.rpc = RpcServer(host=host, port=port, ssl=ssl)
         adapter = ManagerRpcAdapter(self.service, self.jobs)
         adapter.ca = self.ca  # enables issue_certificate over RPC...
         adapter.cert_token = cert_token  # ...gated by the bootstrap token

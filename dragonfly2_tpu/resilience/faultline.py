@@ -12,6 +12,7 @@ registry injects faults behind named points threaded through the hot paths:
     source.read        origin source chunk reads       latency error drop
     source.body        origin source chunk payload           truncate corrupt
     storage.write      storage piece writes            latency error
+    storage.meta       metadata (save_metadata) flush  latency error
 
 Fault kinds:
     latency   sleep `param` seconds (default 0.05) before proceeding
@@ -119,11 +120,21 @@ class Faultline:
             else:  # drop
                 raise ConnectionResetError(f"faultline: injected drop at {point}")
 
-    def check(self, point: str) -> None:
+    def check(self, point: str, *, blocking_latency: bool = False) -> None:
         """Sync variant of fire() for non-async call sites (frame writes):
-        error/drop only — latency needs the loop, so it is skipped here."""
+        error/drop only by default — latency needs the loop, so it is skipped
+        unless `blocking_latency` is set. Blocking latency (time.sleep) is for
+        sync call sites that already run off the event loop or whose blocking
+        is the very behavior under test (storage.meta: a slow metadata flush
+        widens the debounce loss window deterministically)."""
         for rule in self._by_point.get(point, ()):
-            if rule.kind == "latency" or rule.kind not in _FIRE_KINDS:
+            if rule.kind not in _FIRE_KINDS:
+                continue
+            if rule.kind == "latency":
+                if blocking_latency and self._hit(rule):
+                    import time
+
+                    time.sleep(rule.param or 0.05)
                 continue
             if not self._hit(rule):
                 continue

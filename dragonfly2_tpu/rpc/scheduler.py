@@ -11,7 +11,7 @@ from __future__ import annotations
 from dataclasses import asdict
 from typing import Any
 
-from dragonfly2_tpu.rpc.core import RpcClient, RpcServer
+from dragonfly2_tpu.rpc.core import RpcClient, RpcError, RpcServer
 from dragonfly2_tpu.scheduler.service import (
     HostInfo,
     ParentInfo,
@@ -106,7 +106,13 @@ class SchedulerRpcAdapter:
         )
 
     async def reschedule(self, p: dict) -> dict:
-        return _result_to_wire(await self.svc.reschedule(p["peer_id"]))
+        try:
+            return _result_to_wire(await self.svc.reschedule(p["peer_id"]))
+        except KeyError:
+            # a restarted (or GC'd) scheduler does not know this peer; the
+            # typed code lets the conductor re-register and rebuild the
+            # scheduler's view instead of treating this as an internal fault
+            raise RpcError(f"unknown peer {p['peer_id']}", code="not_found")
 
     async def leave_peer(self, p: dict) -> None:
         self.svc.leave_peer(p["peer_id"])

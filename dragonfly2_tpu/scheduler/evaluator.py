@@ -145,15 +145,19 @@ def build_pair_features(
     Hot path: runs once per scheduling round, 40 candidates each, against a
     10k-rounds/s serving budget. The FULL per-pair row (static columns AND
     the child-dependent idc/location/rtt/bandwidth columns) is cached on the
-    parent peer keyed by (parent peer, parent host, child host, topology,
-    bandwidth) versions — every mutation of an input bumps one of those
-    counters (resource.Host/Peer.bump_feat, NetworkTopology.version,
-    BandwidthHistory.version). A steady-state round is therefore one dict
-    lookup + version compare per candidate and one np.stack: the rtt/bw/
-    affinity recomputes (~2/3 of r05's 129.5 µs prepare leg, dominated by
-    statistics.fmean inside avg_rtt_ms) drop out entirely. Only the three
-    round-constant columns (10/11/13) are written per call — onto the
-    stacked COPY, so cached rows stay pristine."""
+    parent peer keyed by (parent peer, parent host, child host, topology
+    pair, bandwidth parent) versions — every mutation of an input bumps one
+    of those counters (resource.Host/Peer.bump_feat,
+    NetworkTopology.pair_version, BandwidthHistory.parent_version). The
+    topology/bandwidth legs are PER-EDGE (PR 6): a probe landing on one
+    (src, dst) pair, or one parent's bandwidth observation, invalidates only
+    the rows it can actually change — unrelated edges stay warm instead of
+    the whole cluster re-assembling per probe. A steady-state round is
+    therefore a couple of dict lookups + a version compare per candidate and
+    one row memcpy: the rtt/bw/affinity recomputes (~2/3 of r05's 129.5 µs
+    prepare leg, dominated by statistics.fmean inside avg_rtt_ms) drop out
+    entirely. Only the three round-constant columns (10/11/13) are written
+    per call — onto the stacked COPY, so cached rows stay pristine."""
     n = len(parents)
     if n == 0:
         return np.zeros((0, FEATURE_DIM), dtype=np.float32)
@@ -161,8 +165,8 @@ def build_pair_features(
     child_host_id = child_host.id
     child_idc = child_host.idc
     child_loc = child_host.location
-    topo_ver = topology.version if topology is not None else -1
-    bw_ver = bandwidth.version if bandwidth is not None else -1
+    topo_pver = topology.pair_version if topology is not None else None
+    bw_pver = bandwidth.parent_version if bandwidth is not None else None
 
     # preallocate + per-row memcpy instead of np.stack: stack's dispatcher
     # (asanyarray per row, shape set, concat) was the largest single item
@@ -171,7 +175,11 @@ def build_pair_features(
     child_feat_ver = child_host.feat_version
     for i, p in enumerate(parents):
         h = p.host
-        key = (p.feat_version, h.feat_version, child_feat_ver, topo_ver, bw_ver)
+        key = (
+            p.feat_version, h.feat_version, child_feat_ver,
+            topo_pver(child_host_id, h.id) if topo_pver is not None else -1,
+            bw_pver(h.id) if bw_pver is not None else -1,
+        )
         hit = p._pair_rows.get(child_host_id)
         if hit is not None and hit[0] == key:
             f[i] = hit[1]

@@ -39,6 +39,12 @@ PEER_RESULT_TOTAL = _r.counter(
 BACK_TO_SOURCE_TOTAL = _r.counter(
     "back_to_source_total", "Peers escalated to back-to-source", subsystem="scheduler"
 )
+# resurrection accounting: ghost peer rows replaced when their host
+# re-announced/re-registered after a crash (no leave_host was ever sent)
+PEER_SUPERSEDED_TOTAL = _r.counter(
+    "peer_superseded_total", "Stale same-host peer rows replaced on rejoin",
+    subsystem="scheduler",
+)
 DOWNLOAD_TRAFFIC_BYTES = _r.counter(
     "download_traffic_bytes_total", "Bytes reported via piece results", subsystem="scheduler"
 )

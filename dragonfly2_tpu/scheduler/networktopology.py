@@ -82,13 +82,35 @@ class NetworkTopology:
         self.probe_count = probe_count
         self._edges: dict[tuple[str, str], EdgeProbes] = {}
         self._rng = rng or random.Random()
-        # Bumped on every mutation that can change avg_rtt_ms for ANY pair;
-        # the evaluator's pair-feature cache keys on it (coarse on purpose:
-        # probe rounds are orders of magnitude rarer than scheduling rounds,
-        # so a cluster-wide invalidation per probe costs one re-assembly).
+        # Coarse change counter (any mutation anywhere) kept for callers that
+        # want a cheap "did anything move" signal; the evaluator's pair-row
+        # cache keys on pair_version() below instead, so one probe no longer
+        # invalidates every cached pair row in the cluster.
         self.version = 0
+        # Per-undirected-pair change counters: avg_rtt_ms(a, b) falls back to
+        # the reverse edge, so either direction's enqueue can change the
+        # answer for the pair — one canonical (min, max) key covers both.
+        # Counters are monotonic and never deleted (forget_host bumps, not
+        # pops): a host id recycled after GC must not collide a fresh count
+        # with a stale cached row keyed on the same small number.
+        self._pair_vers: dict[tuple[str, str], int] = {}
 
     # ---- store ----
+
+    @staticmethod
+    def _pair_key(a: str, b: str) -> tuple[str, str]:
+        return (a, b) if a <= b else (b, a)
+
+    def pair_version(self, a: str, b: str) -> int:
+        """Change counter for the (a, b) host pair — the evaluator's
+        pair-feature cache keys on THIS (not the coarse `version`), so a
+        probe landing on one edge leaves every unrelated pair's cached row
+        warm (PR 5 carry-over)."""
+        return self._pair_vers.get(self._pair_key(a, b), 0)
+
+    def _bump_pair(self, a: str, b: str) -> None:
+        key = self._pair_key(a, b)
+        self._pair_vers[key] = self._pair_vers.get(key, 0) + 1
 
     def enqueue(self, src_host_id: str, dst_host_id: str, rtt_ms: float) -> None:
         key = (src_host_id, dst_host_id)
@@ -97,6 +119,7 @@ class NetworkTopology:
             edge = self._edges[key] = EdgeProbes(self.queue_length)
         edge.enqueue(rtt_ms)
         self.version += 1
+        self._bump_pair(src_host_id, dst_host_id)
         if self.telemetry is not None:
             self.telemetry.probes.append(
                 src_host_id=src_host_id.encode()[:64],
@@ -123,6 +146,7 @@ class NetworkTopology:
         dead = [k for k in self._edges if host_id in k]
         for k in dead:
             del self._edges[k]
+            self._bump_pair(*k)
         if dead:
             self.version += 1
         return len(dead)

@@ -118,6 +118,26 @@ class SchedulerService:
 
     # ---- registration (ref handleRegisterPeerRequest → schedule()) ----
 
+    def _supersede_host_peers(self, task: Task, host_id: str, keep_peer_id: str) -> int:
+        """Resurrection: a host (re)claiming a task owns its durable state,
+        so any OTHER peer row for the same (task, host) is a dead
+        incarnation's ghost — a crashed daemon never sent leave_host, and its
+        ghost still holds parent upload slots and DAG edges that would
+        collide with the returning host's announce/register. Dropping the
+        ghosts is atomic from the caller's view (no await): children of a
+        ghost lose their edge and reschedule; a superseded-but-actually-live
+        peer (pathological double-download on one host) self-heals through
+        the conductor's reschedule→not_found→re-register path. Returns the
+        number of ghosts removed."""
+        stale = [
+            p.id for p in task.peers() if p.host.id == host_id and p.id != keep_peer_id
+        ]
+        for pid in stale:
+            self.pool.delete_peer(pid)
+        if stale:
+            metrics.PEER_SUPERSEDED_TOTAL.inc(len(stale))
+        return len(stale)
+
     async def register_peer(
         self, peer_id: str, meta: TaskMeta, host_info: HostInfo
     ) -> RegisterResult:
@@ -139,6 +159,7 @@ class SchedulerService:
             application=meta.application,
             filters=tuple(meta.filters),
         )
+        self._supersede_host_peers(task, host.id, peer_id)
         peer = self.pool.create_peer(peer_id, task, host)
         if task.fsm.can("download"):
             task.fsm.fire("download")
@@ -325,16 +346,28 @@ class SchedulerService:
         digest: str = "",
     ) -> None:
         """A peer announces it already HOLDS task content (ref AnnounceTask,
-        scheduler/service/service_v1.go — the dfcache import path): create the
-        resource rows, set metadata, mark pieces finished, and drive the peer
-        FSM straight to Succeeded so it serves as a parent. One RPC, no
-        scheduling round."""
+        scheduler/service/service_v1.go — the dfcache import path, and the
+        crash-recovery rejoin): create the resource rows, set metadata, mark
+        pieces finished, and drive the peer FSM to Succeeded when the
+        announce covers the whole task — a PARTIAL announce (a daemon
+        restarting mid-download rejoins as a partial seed) stays Running, a
+        valid parent state whose real piece availability children learn from
+        the host's metadata long-poll. One RPC, no scheduling round. An
+        announce supersedes any ghost peer rows its host left behind
+        (host crashed without leave_host): the durable on-disk state it
+        claims IS the host's state for this task."""
         host = self.pool.load_or_create_host(
             host_info.id, host_info.ip, host_info.hostname,
             port=host_info.port, download_port=host_info.download_port,
             host_type=HostType(host_info.type), idc=host_info.idc,
             location=host_info.location,
         )
+        # ports move across restarts; the announce carries the live ones
+        if host_info.port:
+            host.port = host_info.port
+        if host_info.download_port and host.download_port != host_info.download_port:
+            host.download_port = host_info.download_port
+            host.bump_feat()
         task = self.pool.load_or_create_task(
             meta.task_id, meta.url, digest=meta.digest or digest,
             tag=meta.tag, application=meta.application, filters=tuple(meta.filters),
@@ -344,6 +377,7 @@ class SchedulerService:
             task.digest = digest
         if task.fsm.can("download"):
             task.fsm.fire("download")
+        self._supersede_host_peers(task, host.id, peer_id)
         peer = self.pool.create_peer(peer_id, task, host)
         for ev in ("register", "download"):
             if peer.fsm.can(ev):
@@ -351,10 +385,16 @@ class SchedulerService:
         for idx in piece_indices:
             peer.finished_pieces.set(idx)
         peer.bump_feat()
-        if peer.fsm.can("succeed"):
-            peer.fsm.fire("succeed")
-        if task.fsm.can("succeed"):
-            task.fsm.fire("succeed")
+        total = task.total_pieces or 0
+        complete = (
+            (total > 0 and peer.finished_pieces.count() >= total)
+            or content_length == 0  # empty objects have no pieces to hold
+        )
+        if complete:
+            if peer.fsm.can("succeed"):
+                peer.fsm.fire("succeed")
+            if task.fsm.can("succeed"):
+                task.fsm.fire("succeed")
 
     def report_pieces(self, peer_id: str, reports) -> int:
         """Batched success report: one RPC for N pieces (the conductor's

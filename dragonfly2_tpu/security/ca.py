@@ -4,26 +4,42 @@ NewDragonflyIssuer + manager-side security service).
 EC P-256 keys, CA persisted to a directory (ca.pem/ca.key), leaf certs issued
 with IP/DNS SANs and bounded validity. Services call the manager's
 issue_certificate RPC at boot and cache the result on disk (the reference
-uses certify's cache for the same reason: restart without re-issuance)."""
+uses certify's cache for the same reason: restart without re-issuance).
+
+Two interchangeable issuance backends behind one `CertificateAuthority`
+facade: the `cryptography` package when importable, else the `openssl` CLI
+(this image ships OpenSSL 1.1.1 but not the cryptography wheel, and the mTLS
+plane must not depend on an installable extra). Both persist the same
+ca.pem/ca.key PEM pair, so a directory created by one backend loads under the
+other."""
 
 from __future__ import annotations
 
-import datetime
 import ipaddress
 import logging
+import shutil
+import subprocess
+import tempfile
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Iterable, Optional
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
+try:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+
+    _HAVE_CRYPTOGRAPHY = True
+except ImportError:  # gated, not required: the CLI backend below covers it
+    _HAVE_CRYPTOGRAPHY = False
 
 logger = logging.getLogger(__name__)
 
 DEFAULT_CA_DAYS = 10 * 365
 DEFAULT_LEAF_DAYS = 30
+
+_ORG = "dragonfly2-tpu"
 
 
 @dataclass
@@ -41,42 +57,37 @@ class IssuedCert:
         }
 
 
-def _name(common_name: str, org: str = "dragonfly2-tpu") -> x509.Name:
-    return x509.Name(
-        [
-            x509.NameAttribute(NameOID.ORGANIZATION_NAME, org),
-            x509.NameAttribute(NameOID.COMMON_NAME, common_name),
-        ]
-    )
+def _split_sans(sans: Iterable[str]) -> tuple[list[str], list[str]]:
+    """(ips, dns_names) — entries auto-detected like the reference issuer."""
+    ips: list[str] = []
+    dns: list[str] = []
+    for s in sans:
+        try:
+            ipaddress.ip_address(s)
+            ips.append(s)
+        except ValueError:
+            dns.append(s)
+    return ips, dns
 
 
-def _key_pem(key: ec.EllipticCurvePrivateKey) -> bytes:
-    return key.private_bytes(
-        serialization.Encoding.PEM,
-        serialization.PrivateFormat.PKCS8,
-        serialization.NoEncryption(),
-    )
+class _CryptographyBackend:
+    """Issuance via the `cryptography` package (original implementation)."""
 
-
-class CertificateAuthority:
-    """Filesystem-backed CA: loads ca.pem/ca.key from `directory` or creates
-    a fresh self-signed pair on first use."""
-
-    def __init__(self, directory: str | Path, *, common_name: str = "dragonfly2-tpu-ca"):
-        self.dir = Path(directory)
-        self.dir.mkdir(parents=True, exist_ok=True)
-        self._cert_path = self.dir / "ca.pem"
-        self._key_path = self.dir / "ca.key"
-        if self._cert_path.exists() and self._key_path.exists():
-            self._cert = x509.load_pem_x509_certificate(self._cert_path.read_bytes())
+    def __init__(self, cert_path: Path, key_path: Path, common_name: str):
+        self._cert_path = cert_path
+        self._key_path = key_path
+        if cert_path.exists() and key_path.exists():
+            self._cert = x509.load_pem_x509_certificate(cert_path.read_bytes())
             self._key = serialization.load_pem_private_key(
-                self._key_path.read_bytes(), password=None
+                key_path.read_bytes(), password=None
             )
-            logger.info("loaded CA from %s", self.dir)
+            logger.info("loaded CA from %s", cert_path.parent)
         else:
+            import datetime
+
             self._key = ec.generate_private_key(ec.SECP256R1())
             now = datetime.datetime.now(datetime.timezone.utc)
-            name = _name(common_name)
+            name = self._name(common_name)
             self._cert = (
                 x509.CertificateBuilder()
                 .subject_name(name)
@@ -97,27 +108,38 @@ class CertificateAuthority:
                 )
                 .sign(self._key, hashes.SHA256())
             )
-            self._cert_path.write_bytes(self._cert.public_bytes(serialization.Encoding.PEM))
-            self._key_path.write_bytes(_key_pem(self._key))
-            self._key_path.chmod(0o600)
-            logger.info("created new CA at %s", self.dir)
+            cert_path.write_bytes(self._cert.public_bytes(serialization.Encoding.PEM))
+            key_path.write_bytes(self._key_pem(self._key))
+            key_path.chmod(0o600)
+            logger.info("created new CA at %s", cert_path.parent)
+
+    @staticmethod
+    def _name(common_name: str) -> "x509.Name":
+        return x509.Name(
+            [
+                x509.NameAttribute(NameOID.ORGANIZATION_NAME, _ORG),
+                x509.NameAttribute(NameOID.COMMON_NAME, common_name),
+            ]
+        )
+
+    @staticmethod
+    def _key_pem(key: "ec.EllipticCurvePrivateKey") -> bytes:
+        return key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.PKCS8,
+            serialization.NoEncryption(),
+        )
 
     @property
     def ca_pem(self) -> bytes:
         return self._cert.public_bytes(serialization.Encoding.PEM)
 
     def issue(
-        self,
-        common_name: str,
-        *,
-        sans: Iterable[str] = (),
-        days: int = DEFAULT_LEAF_DAYS,
-        server: bool = True,
-        client: bool = True,
+        self, common_name: str, *, sans: Iterable[str], days: int,
+        server: bool, client: bool,
     ) -> IssuedCert:
-        """Issue a leaf cert. sans entries are IPs or DNS names (auto-detected).
-        Both serverAuth and clientAuth by default — every service is both in a
-        mesh (ref issues one cert per service instance)."""
+        import datetime
+
         key = ec.generate_private_key(ec.SECP256R1())
         now = datetime.datetime.now(datetime.timezone.utc)
         san_objs: list[x509.GeneralName] = []
@@ -135,7 +157,7 @@ class CertificateAuthority:
             ekus.append(x509.oid.ExtendedKeyUsageOID.CLIENT_AUTH)
         cert = (
             x509.CertificateBuilder()
-            .subject_name(_name(common_name))
+            .subject_name(self._name(common_name))
             .issuer_name(self._cert.subject)
             .public_key(key.public_key())
             .serial_number(x509.random_serial_number())
@@ -148,8 +170,150 @@ class CertificateAuthority:
         )
         return IssuedCert(
             cert_pem=cert.public_bytes(serialization.Encoding.PEM),
-            key_pem=_key_pem(key),
+            key_pem=self._key_pem(key),
             ca_pem=self.ca_pem,
+        )
+
+
+class _OpensslCliBackend:
+    """Issuance by shelling out to the `openssl` binary (>= 1.1.1 for
+    `req -addext`). Same artifacts as the cryptography backend: P-256 PKCS8
+    keys, a pathlen:0 CA, leaf certs with SANs + EKUs. Issuance is a boot-time
+    RPC, not a hot path — three subprocesses per cert is fine."""
+
+    def __init__(self, cert_path: Path, key_path: Path, common_name: str):
+        self._cert_path = cert_path
+        self._key_path = key_path
+        if cert_path.exists() and key_path.exists():
+            logger.info("loaded CA from %s", cert_path.parent)
+            return
+        self._gen_key(key_path)
+        key_path.chmod(0o600)
+        with tempfile.TemporaryDirectory(prefix="df-ca-") as td:
+            # explicit config, not -addext: `req -x509` otherwise ALSO emits
+            # its default basicConstraints=CA:TRUE, and a certificate with
+            # duplicate extensions fails chain building (verify error 20)
+            cnf = Path(td) / "ca.cnf"
+            cnf.write_text(
+                "[req]\n"
+                "distinguished_name = dn\n"
+                "x509_extensions = v3_ca\n"
+                "prompt = no\n"
+                "[dn]\n"
+                f"O = {_ORG}\n"
+                f"CN = {common_name}\n"
+                "[v3_ca]\n"
+                "basicConstraints = critical,CA:TRUE,pathlen:0\n"
+                "keyUsage = critical,digitalSignature,keyCertSign,cRLSign\n"
+                "subjectKeyIdentifier = hash\n"
+            )
+            self._run(
+                "req", "-x509", "-new", "-key", str(key_path), "-sha256",
+                "-days", str(DEFAULT_CA_DAYS), "-config", str(cnf),
+                "-out", str(cert_path),
+            )
+        logger.info("created new CA at %s (openssl CLI backend)", cert_path.parent)
+
+    @staticmethod
+    def _run(*args: str) -> None:
+        proc = subprocess.run(
+            ["openssl", *args], capture_output=True, text=True
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"openssl {args[0]} failed ({proc.returncode}): {proc.stderr.strip()}"
+            )
+
+    @staticmethod
+    def _gen_key(out_path: Path) -> None:
+        _OpensslCliBackend._run(
+            "genpkey", "-algorithm", "EC",
+            "-pkeyopt", "ec_paramgen_curve:P-256", "-out", str(out_path),
+        )
+
+    @staticmethod
+    def _subj(common_name: str) -> str:
+        # '/' delimits RDNs in -subj; service names never legitimately carry it
+        return f"/O={_ORG}/CN={common_name.replace('/', '_')}"
+
+    @property
+    def ca_pem(self) -> bytes:
+        return self._cert_path.read_bytes()
+
+    def issue(
+        self, common_name: str, *, sans: Iterable[str], days: int,
+        server: bool, client: bool,
+    ) -> IssuedCert:
+        import secrets
+
+        ips, dns = _split_sans(sans)
+        if not ips and not dns:
+            dns = [common_name]
+        san_line = ",".join(
+            [f"IP:{ip}" for ip in ips] + [f"DNS:{d}" for d in dns]
+        )
+        ekus = [eku for eku, on in (("serverAuth", server), ("clientAuth", client)) if on]
+        with tempfile.TemporaryDirectory(prefix="df-issue-") as td:
+            t = Path(td)
+            key, csr, crt, ext = t / "leaf.key", t / "leaf.csr", t / "leaf.crt", t / "ext.cnf"
+            self._gen_key(key)
+            self._run(
+                "req", "-new", "-key", str(key),
+                "-subj", self._subj(common_name), "-out", str(csr),
+            )
+            lines = [f"basicConstraints = critical,CA:FALSE", f"subjectAltName = {san_line}"]
+            if ekus:
+                lines.append(f"extendedKeyUsage = {','.join(ekus)}")
+            ext.write_text("\n".join(lines) + "\n")
+            self._run(
+                "x509", "-req", "-in", str(csr), "-sha256", "-days", str(days),
+                "-CA", str(self._cert_path), "-CAkey", str(self._key_path),
+                # explicit random serial: no ca.srl state file in the CA dir
+                "-set_serial", str(secrets.randbits(63)),
+                "-extfile", str(ext), "-out", str(crt),
+            )
+            return IssuedCert(
+                cert_pem=crt.read_bytes(), key_pem=key.read_bytes(), ca_pem=self.ca_pem
+            )
+
+
+class CertificateAuthority:
+    """Filesystem-backed CA: loads ca.pem/ca.key from `directory` or creates
+    a fresh self-signed pair on first use."""
+
+    def __init__(self, directory: str | Path, *, common_name: str = "dragonfly2-tpu-ca"):
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self._cert_path = self.dir / "ca.pem"
+        self._key_path = self.dir / "ca.key"
+        if _HAVE_CRYPTOGRAPHY:
+            self._impl = _CryptographyBackend(self._cert_path, self._key_path, common_name)
+        elif shutil.which("openssl"):
+            self._impl = _OpensslCliBackend(self._cert_path, self._key_path, common_name)
+        else:
+            raise RuntimeError(
+                "certificate issuance needs either the `cryptography` package "
+                "or an `openssl` binary on PATH; neither is available"
+            )
+
+    @property
+    def ca_pem(self) -> bytes:
+        return self._impl.ca_pem
+
+    def issue(
+        self,
+        common_name: str,
+        *,
+        sans: Iterable[str] = (),
+        days: int = DEFAULT_LEAF_DAYS,
+        server: bool = True,
+        client: bool = True,
+    ) -> IssuedCert:
+        """Issue a leaf cert. sans entries are IPs or DNS names (auto-detected).
+        Both serverAuth and clientAuth by default — every service is both in a
+        mesh (ref issues one cert per service instance)."""
+        return self._impl.issue(
+            common_name, sans=sans, days=days, server=server, client=client
         )
 
 

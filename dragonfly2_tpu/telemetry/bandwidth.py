@@ -35,16 +35,31 @@ class BandwidthHistory:
         self.alpha = alpha
         self._pair: dict[tuple[str, str], float] = {}
         self._parent: dict[str, float] = {}
-        # Bumped on every mutation that can change normalized() for ANY pair;
-        # the evaluator's pair-feature cache keys on it (peer results arrive
-        # orders of magnitude slower than scheduling rounds, so the coarse
-        # invalidation is cheap — see evaluator.build_pair_features).
+        # Coarse change counter (any mutation) kept for cheap staleness
+        # checks; the evaluator's pair-row cache keys on parent_version()
+        # below — one observation invalidates only that PARENT's rows.
         self.version = 0
+        # Per-parent-host change counters: an observe(parent, child) updates
+        # the (parent, child) pair EWMA and the parent-aggregate fallback, so
+        # it can change normalized() for ANY child of that parent (children
+        # with no pair entry read the fallback) — but never for another
+        # parent. Monotonic, never deleted (see NetworkTopology._pair_vers
+        # for the id-recycling rationale).
+        self._parent_vers: dict[str, int] = {}
+
+    def parent_version(self, parent_host_id: str) -> int:
+        """Change counter covering every pair this parent serves (pair EWMA
+        or aggregate fallback) — the evaluator cache key's bandwidth leg."""
+        return self._parent_vers.get(parent_host_id, 0)
+
+    def _bump_parent(self, parent_host_id: str) -> None:
+        self._parent_vers[parent_host_id] = self._parent_vers.get(parent_host_id, 0) + 1
 
     def observe(self, parent_host_id: str, child_host_id: str, bps: float) -> None:
         if not parent_host_id or not np.isfinite(bps) or bps <= 0:
             return
         self.version += 1
+        self._bump_parent(parent_host_id)
         a = self.alpha
         key = (parent_host_id, child_host_id)
         prev = self._pair.get(key)
@@ -69,8 +84,14 @@ class BandwidthHistory:
 
     def forget_host(self, host_id: str) -> None:
         self._parent.pop(host_id, None)
+        self._bump_parent(host_id)
         for key in [k for k in self._pair if host_id in k]:
             del self._pair[key]
+            # dropping a (parent, child) pair changes normalized() for that
+            # PARENT (its children fall back to the aggregate) even when the
+            # forgotten host was the child side
+            if key[0] != host_id:
+                self._bump_parent(key[0])
         self.version += 1
 
     def load_from(self, telemetry) -> int:

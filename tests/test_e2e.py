@@ -45,6 +45,9 @@ class Origin:
         self.port = 0
         self.inflight = 0
         self.max_inflight = 0
+        # every 206's (start, length) — lets restart tests assert WHICH
+        # bytes rode the wire, not just how many
+        self.range_log: list[tuple[int, int]] = []
         self._runner = None
 
     async def __aenter__(self):
@@ -109,6 +112,7 @@ class Origin:
             shift = self.corrupt_range_shift
             src = data[r.start + shift : r.start + shift + r.length]
             body = src.ljust(r.length, b"\x00")[: r.length]
+            self.range_log.append((r.start, r.length))
             self.bytes_sent += len(body)
             return web.Response(
                 status=206,

@@ -92,6 +92,64 @@ def test_rtt_flows_into_pair_features():
     assert f_no[0, 6] == 0.0
 
 
+def test_per_edge_versions_keep_unrelated_cache_rows_warm():
+    """PR 6 satellite: the evaluator's pair-row cache keys on per-(src,dst)
+    topology versions and per-parent bandwidth versions — a probe landing on
+    one edge (or one parent's bandwidth observation) must NOT invalidate
+    cached rows for unrelated edges."""
+    from dragonfly2_tpu.scheduler.service import TaskMeta
+    from dragonfly2_tpu.telemetry.bandwidth import BandwidthHistory
+
+    svc = SchedulerService()
+    for name in ("child-h", "pa-h", "pb-h"):
+        _host(svc, name)
+    topo = svc.topology
+
+    # per-pair counters: one enqueue bumps exactly its (undirected) pair
+    topo.enqueue("child-h", "pa-h", 10.0)
+    va = topo.pair_version("child-h", "pa-h")
+    vb = topo.pair_version("child-h", "pb-h")
+    topo.enqueue("child-h", "pb-h", 20.0)
+    assert topo.pair_version("child-h", "pa-h") == va
+    assert topo.pair_version("child-h", "pb-h") == vb + 1
+    # reverse-direction enqueue bumps the same undirected pair (avg_rtt_ms
+    # falls back to the reverse edge, so either direction changes the answer)
+    topo.enqueue("pa-h", "child-h", 12.0)
+    assert topo.pair_version("child-h", "pa-h") == va + 1
+
+    async def setup():
+        await svc.register_peer(
+            "peer-c2", TaskMeta(task_id="u" * 64, url="http://o/g"),
+            HostInfo(id="child-h", ip="127.0.0.1", hostname="child-h"),
+        )
+        for pid, hid in (("peer-pa", "pa-h"), ("peer-pb", "pb-h")):
+            await svc.register_peer(  # dflint: disable=DF025 two-peer fixture setup, not control-plane fan-out
+                pid, TaskMeta(task_id="u" * 64, url="http://o/g"),
+                HostInfo(id=hid, ip="127.0.0.1", hostname=hid),
+            )
+
+    asyncio.run(setup())
+    child = svc.pool.peer("peer-c2")
+    pa = svc.pool.peer("peer-pa")
+    pb = svc.pool.peer("peer-pb")
+    bw = BandwidthHistory()
+    bw.observe("pa-h", "child-h", 1e8)
+    bw.observe("pb-h", "child-h", 2e8)
+
+    build_pair_features(child, [pa, pb], topo, bw)
+    row_a = pa._pair_rows["child-h"]
+    row_b = pb._pair_rows["child-h"]
+
+    # a probe on (child, pa) + a bandwidth observation on pa: pa's row
+    # rebuilds, pb's cached row survives UNTOUCHED (identity, not equality)
+    topo.enqueue("child-h", "pa-h", 50.0)
+    bw.observe("pa-h", "child-h", 3e8)
+    assert bw.parent_version("pb-h") == 1  # pa's observation left pb alone
+    build_pair_features(child, [pa, pb], topo, bw)
+    assert pa._pair_rows["child-h"] is not row_a
+    assert pb._pair_rows["child-h"] is row_b
+
+
 def test_measure_rtt_against_live_server(run):
     async def body():
         server = await asyncio.start_server(lambda r, w: w.close(), "127.0.0.1", 0)

@@ -527,9 +527,14 @@ class TestHttpsInterception:
                     assert b"200" in await reader.readline()
                     while (await reader.readline()) not in (b"\r\n", b"\n", b""):
                         pass
-                    await writer.start_tls(
-                        tls_world["trust_ctx"], server_hostname="localhost"
+                    # client-side TLS upgrade; 3.10 has no StreamWriter
+                    # .start_tls (3.11+) — use the loop API + transport rewire
+                    loop = asyncio.get_running_loop()
+                    transport = await loop.start_tls(
+                        writer.transport, writer.transport.get_protocol(),
+                        tls_world["trust_ctx"], server_hostname="localhost",
                     )
+                    writer._transport = transport
                     writer.write(b"GET /a.bin HTTP/1.1\r\nHost: localhost\r\n\r\n")
                     await writer.drain()
                     st, h, data = await read_response(reader)

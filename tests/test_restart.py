@@ -1,0 +1,952 @@
+"""Crash-safe restarts: the process-level chaos suite (PR 6).
+
+PR 2's faultline proves the plane under every *in-process* fault; this file
+proves whole-process death. The in-process crash harness (`crash_engine`)
+hard-kills a component mid-download — in-flight work cancelled, transports
+dropped, NO graceful close, NO metadata flush, NO leave_host — then a fresh
+engine boots on the same storage root exactly like a restarted daemon:
+StorageManager reloads data+metadata, the recovery audit digest-verifies the
+claimed bitset, and the engine re-announces surviving pieces so the peer
+rejoins as a (partial) seed. The suite pins:
+
+  - daemon killed at ~50% of a multi-piece download → restart → resume →
+    bit-exact, with byte accounting proving recovered pieces never ride the
+    wire again
+  - seed-peer crash while a child streams from it → restart supersedes the
+    scheduler-side ghost → child completes bit-exact
+  - scheduler crash mid-round → daemons re-register/re-announce and the
+    scheduler rebuilds its view from announces alone
+  - the debounced-metadata windows: an unflushed piece refetches (never
+    double-counts); a claimed-but-torn piece is dropped by the recovery
+    audit (never served, never counted)
+  - mTLS end to end: manager CA issues certs over RPC, all control RPC runs
+    over TLS, and a P2P download completes bit-exact with chaos faults on
+
+A real-SIGKILL subprocess variant is marked `slow`; everything else is
+tier-1-fast and doubles as tools/check.sh's restart-smoke leg.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import socket
+import time
+
+import pytest
+from test_e2e import Origin, fast_conductor, make_engine
+
+from dragonfly2_tpu.daemon import metrics as dmetrics
+from dragonfly2_tpu.daemon.conductor import ConductorConfig, PeerTaskConductor
+from dragonfly2_tpu.daemon.engine import InProcessSchedulerClient, PeerEngine
+from dragonfly2_tpu.daemon.source import SourceRegistry
+from dragonfly2_tpu.daemon.storage import StorageManager, TaskStorage
+from dragonfly2_tpu.resilience import faultline
+from dragonfly2_tpu.scheduler import metrics as smetrics
+from dragonfly2_tpu.scheduler.service import (
+    HostInfo,
+    ParentInfo,
+    RegisterResult,
+    SchedulerService,
+    TaskMeta,
+)
+from dragonfly2_tpu.utils.bitset import Bitset
+from dragonfly2_tpu.utils.pieces import Range, piece_range
+
+pytestmark = pytest.mark.restart
+
+PIECE = 4 << 20
+
+
+@pytest.fixture(autouse=True)
+def _faultline_cleanup():
+    """No restart test may leak an ACTIVE faultline into the rest of tier-1."""
+    yield
+    faultline.disable()
+
+
+@pytest.fixture
+def payload():
+    return bytes(range(256)) * (80 * 1024)  # 20 MiB -> 5 pieces of 4 MiB
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _engine(tmp_path, client, name, **kw) -> PeerEngine:
+    """Engine with sequential source fetches so a kill lands at a chosen
+    piece boundary instead of inside one 4-way wave."""
+    cfg = ConductorConfig(
+        metadata_poll_interval=0.02, piece_timeout=10.0, source_concurrency=1
+    )
+    return PeerEngine(
+        storage_root=tmp_path / name, scheduler=client, hostname=name,
+        conductor_config=cfg, **kw,
+    )
+
+
+async def crash_engine(engine: PeerEngine, *producers: asyncio.Task) -> None:
+    """In-process analogue of a process kill: cancel in-flight work, drop the
+    upload transport (in-flight piece serves die with it), release host
+    resources — and deliberately do NOT flush debounced storage metadata and
+    do NOT send leave_host. On-disk state is whatever the last debounce flush
+    persisted, and the scheduler keeps this incarnation's ghost rows, exactly
+    as after a real SIGKILL."""
+    for t in producers:
+        t.cancel()
+    if producers:
+        await asyncio.gather(*producers, return_exceptions=True)
+    await engine.upload.stop()
+    engine.gc.stop()
+    await engine.sources.close()
+    if engine._raw_client is not None:
+        await engine._raw_client.close()
+        engine._raw_client = None
+    if engine._piece_pipeline is not None:
+        engine._piece_pipeline.close()
+        engine._piece_pipeline = None
+
+
+def _disk_claims(tmp_path, name: str, task_id: str) -> set[int]:
+    meta_path = tmp_path / name / task_id / "metadata.json"
+    if not meta_path.exists():
+        return set()
+    return set(Bitset(json.loads(meta_path.read_text())["finished_pieces"]).indices())
+
+
+async def _wait_for_partial(
+    engine: PeerEngine, task_id: str, lo: int, hi: int, *, flushed: bool = False,
+    tmp_path=None, name: str = "", timeout: float = 30.0,
+) -> None:
+    """Park until the task holds [lo, hi] pieces in memory (and, with
+    flushed=True, at least one bit persisted to disk — the crash must have
+    something to recover)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        ts = engine.storage.get(task_id)
+        if ts is not None and lo <= ts.finished_count() <= hi:
+            if not flushed or _disk_claims(tmp_path, name, task_id):
+                return
+        await asyncio.sleep(0.02)
+    pytest.fail(f"task never reached a partial state in [{lo}, {hi}]")
+
+
+# ---------------------------------------------------------------------------
+# storage: boot survives every broken-metadata shape, the audit drops torn bits
+
+
+class TestStorageLoadEdgeCases:
+    PSIZE = 64 * 1024
+
+    async def _seed(self, root, pieces_written=2, total=2, tid="edge1"):
+        sm = StorageManager(root)
+        ts = sm.register_task(tid, url="http://x/f")
+        ts.set_task_info(
+            content_length=total * self.PSIZE, piece_size=self.PSIZE, total_pieces=total
+        )
+        chunks = []
+        for i in range(pieces_written):
+            chunk = bytes([i + 1]) * self.PSIZE
+            await ts.write_piece(i, chunk)
+            chunks.append(chunk)
+        ts.flush_metadata()
+        return sm, ts, chunks
+
+    def test_corrupt_metadata_quarantined(self, run, tmp_path):
+        async def body():
+            await self._seed(tmp_path)
+            (tmp_path / "edge1" / "metadata.json").write_text("{definitely not json")
+            sm2 = StorageManager(tmp_path)  # boot must not crash
+            assert sm2.get("edge1") is None
+            assert (tmp_path / "edge1" / "metadata.json.corrupt").exists()
+            # the task can start over fresh on the same dir
+            ts2 = sm2.register_task("edge1", url="http://x/f")
+            assert ts2.finished_count() == 0
+
+        run(body())
+
+    def test_truncated_metadata_quarantined(self, run, tmp_path):
+        async def body():
+            await self._seed(tmp_path)
+            p = tmp_path / "edge1" / "metadata.json"
+            p.write_text(p.read_text()[: len(p.read_text()) // 2])
+            sm2 = StorageManager(tmp_path)
+            assert sm2.get("edge1") is None
+            assert (tmp_path / "edge1" / "metadata.json.corrupt").exists()
+
+        run(body())
+
+    def test_wrong_typed_metadata_quarantined(self, run, tmp_path):
+        async def body():
+            await self._seed(tmp_path)
+            p = tmp_path / "edge1" / "metadata.json"
+            d = json.loads(p.read_text())
+            d["finished_pieces"] = "zzz"  # bitset int expected
+            p.write_text(json.dumps(d))
+            sm2 = StorageManager(tmp_path)
+            assert sm2.get("edge1") is None
+            assert (tmp_path / "edge1" / "metadata.json.corrupt").exists()
+
+        run(body())
+
+    def test_orphan_tmp_metadata_promoted(self, run, tmp_path):
+        """Crash between the tmp write and the atomic replace on a task's
+        FIRST flush: only metadata.json.tmp exists — boot promotes it."""
+
+        async def body():
+            await self._seed(tmp_path)
+            d = tmp_path / "edge1"
+            (d / "metadata.json").replace(d / "metadata.json.tmp")
+            sm2 = StorageManager(tmp_path)
+            ts2 = sm2.get("edge1")
+            assert ts2 is not None and ts2.finished_count() == 2
+            assert not (d / "metadata.json.tmp").exists()
+
+        run(body())
+
+    def test_stale_tmp_next_to_final_discarded(self, run, tmp_path):
+        async def body():
+            await self._seed(tmp_path)
+            d = tmp_path / "edge1"
+            stale = json.loads((d / "metadata.json").read_text())
+            stale["finished_pieces"] = 0  # an older snapshot
+            (d / "metadata.json.tmp").write_text(json.dumps(stale))
+            sm2 = StorageManager(tmp_path)
+            ts2 = sm2.get("edge1")
+            assert ts2 is not None and ts2.finished_count() == 2  # final wins
+            assert not (d / "metadata.json.tmp").exists()
+
+        run(body())
+
+    def test_unparseable_orphan_tmp_discarded(self, run, tmp_path):
+        async def body():
+            await self._seed(tmp_path)
+            d = tmp_path / "edge1"
+            (d / "metadata.json").unlink()
+            (d / "metadata.json.tmp").write_text("{half a snapsh")
+            sm2 = StorageManager(tmp_path)  # must not crash or promote garbage
+            assert sm2.get("edge1") is None
+            assert not (d / "metadata.json.tmp").exists()
+
+        run(body())
+
+    def test_short_data_file_drops_out_of_bounds_pieces(self, run, tmp_path):
+        async def body():
+            sm, ts, _ = await self._seed(tmp_path)
+            with open(ts.data_path, "r+b") as f:
+                f.truncate(self.PSIZE)  # piece 1's bytes are gone
+            sm2 = StorageManager(tmp_path)
+            recovered = sm2.recover()
+            ts2 = sm2.get("edge1")
+            assert ts2.has_piece(0) and not ts2.has_piece(1)
+            assert recovered == [(ts2, 1, [1])]
+            # the drop is persisted: a THIRD boot needs no audit to agree
+            assert _disk_claims(tmp_path, "", "edge1") == {0}
+
+        run(body())
+
+    def test_torn_claimed_piece_dropped_never_served_or_counted(self, run, tmp_path):
+        """The acceptance-pinned torn-piece rule, claimed-side: metadata
+        claims a bit whose data bytes are garbage (a machine crash can land
+        the metadata rename without the data blocks). The audit must drop it
+        — it is neither servable (has_piece False → the upload server 404s)
+        nor counted — and the refetch lands it exactly once."""
+
+        async def body():
+            sm, ts, chunks = await self._seed(tmp_path)
+            with open(ts.data_path, "r+b") as f:
+                f.seek(self.PSIZE)
+                f.write(b"\x00" * self.PSIZE)  # tear piece 1
+            sm2 = StorageManager(tmp_path)
+            sm2.recover()
+            ts2 = sm2.get("edge1")
+            assert ts2.has_piece(0)
+            assert not ts2.has_piece(1)  # dropped: never served onward
+            assert ts2.finished_count() == 1  # never counted
+            # piece 0 is intact and still claimed — it never refetches
+            assert await ts2.read_piece(0) == chunks[0]
+            # refetch counts it back exactly once
+            await ts2.write_piece(1, chunks[1])
+            assert ts2.finished_count() == 2
+            await ts2.write_piece(1, chunks[1])  # duplicate landing: no recount
+            assert ts2.finished_count() == 2
+
+        run(body())
+
+    def test_done_task_with_wrong_length_demoted_to_full_audit(self, run, tmp_path):
+        async def body():
+            sm, ts, _ = await self._seed(tmp_path)
+            ts.mark_done()
+            with open(ts.data_path, "r+b") as f:
+                f.truncate(self.PSIZE)
+            sm2 = StorageManager(tmp_path)
+            sm2.recover()
+            ts2 = sm2.get("edge1")
+            assert not ts2.meta.done  # no longer complete
+            assert ts2.has_piece(0) and not ts2.has_piece(1)
+
+        run(body())
+
+
+class TestDebounceWindow:
+    """The acceptance-pinned debounce-window rule, unflushed side: a piece
+    written but not yet metadata-flushed at crash time refetches — it is
+    never served from the stale claim and never double-counted."""
+
+    def test_unflushed_piece_refetches_never_double_counts(
+        self, run, tmp_path, monkeypatch
+    ):
+        # flushes only when explicitly requested (or at completion)
+        monkeypatch.setattr(TaskStorage, "_META_FLUSH_PIECES", 10_000)
+        monkeypatch.setattr(TaskStorage, "_META_FLUSH_S", 10_000.0)
+        psize = 64 * 1024
+
+        async def body():
+            sm = StorageManager(tmp_path)
+            ts = sm.register_task("win1", url="http://x/f")
+            ts.set_task_info(content_length=3 * psize, piece_size=psize, total_pieces=3)
+            p0, p1 = b"\x01" * psize, b"\x02" * psize
+            await ts.write_piece(0, p0)
+            ts.flush_metadata()  # last durable snapshot: {0}
+            await ts.write_piece(1, p1)  # lands INSIDE the debounce window
+            assert ts.finished_count() == 2  # in-memory truth pre-crash
+
+            sm2 = StorageManager(tmp_path)  # crash + reboot
+            sm2.recover()
+            ts2 = sm2.get("win1")
+            # the unflushed piece is simply not claimed: refetch, not serve
+            assert ts2.has_piece(0) and not ts2.has_piece(1)
+            assert ts2.finished_count() == 1
+            # refetch lands it once; re-landing does not double-count
+            await ts2.write_piece(1, p1)
+            assert ts2.finished_count() == 2
+            await ts2.write_piece(1, p1)
+            assert ts2.finished_count() == 2
+
+        run(body())
+
+    def test_storage_meta_fault_point_opens_window_deterministically(
+        self, run, tmp_path
+    ):
+        """faultline `storage.meta`: an injected save_metadata error leaves
+        the landed piece claimed in memory but NOT on disk — the exact state
+        a crash inside the debounce window produces, now reachable without
+        kill timing."""
+        psize = 64 * 1024
+
+        async def body():
+            sm = StorageManager(tmp_path)
+            ts = sm.register_task("mf1", url="http://x/f")
+            ts.set_task_info(content_length=psize, piece_size=psize, total_pieces=1)
+            fl = faultline.enable("storage.meta:error:1.0,seed=5")
+            try:
+                with pytest.raises(IOError):
+                    # single-piece task: completion makes the flush due, and
+                    # the injected error surfaces like a real disk failure
+                    await ts.write_piece(0, b"\x07" * psize)
+            finally:
+                faultline.disable()
+            assert fl.injected_total("storage.meta") >= 1
+            assert ts.has_piece(0)  # the data write itself landed
+            assert _disk_claims(tmp_path, "", "mf1") == set()  # ...unflushed
+            ts.flush_metadata()  # fault cleared: the shutdown path persists
+            assert _disk_claims(tmp_path, "", "mf1") == {0}
+
+        run(body())
+
+    def test_storage_meta_latency_injects_blocking_delay(self, run, tmp_path):
+        async def body():
+            sm = StorageManager(tmp_path)
+            ts = sm.register_task("ml1", url="http://x/f")
+            fl = faultline.enable("storage.meta:latency:1.0:0.05,seed=6")
+            try:
+                t0 = time.perf_counter()
+                ts.save_metadata()
+                assert time.perf_counter() - t0 >= 0.05
+            finally:
+                faultline.disable()
+            assert fl.injected[("storage.meta", "latency")] >= 1
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# daemon crash at ~50%: restart, re-announce, resume without refetching
+
+
+class TestDaemonCrashResume:
+    def test_crash_at_half_restarts_and_resumes_bit_exact(
+        self, run, tmp_path, payload, monkeypatch
+    ):
+        # tight flush window so disk claims track the download closely (the
+        # debounce-window loss path has its own dedicated tests above)
+        monkeypatch.setattr(TaskStorage, "_META_FLUSH_S", 0.05)
+
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            async with Origin({"f.bin": payload}) as origin:
+                url = origin.url("f.bin")
+                e1 = _engine(tmp_path, client, "restartd", total_download_rate_bps=8e6)
+                await e1.start()
+                tid = e1.make_meta(url).task_id
+                task = asyncio.ensure_future(e1.download_task(url, output=tmp_path / "a.bin"))
+                await _wait_for_partial(
+                    e1, tid, 2, 3, flushed=True, tmp_path=tmp_path, name="restartd"
+                )
+                await crash_engine(e1, task)
+
+                claimed = _disk_claims(tmp_path, "restartd", tid)
+                assert 0 < len(claimed) < 5  # a genuinely partial durable state
+
+                rec_tasks0 = dmetrics.TASK_RECOVERED_TOTAL.labels(state="partial").value
+                e2 = _engine(tmp_path, client, "restartd")
+                await e2.start()  # recovery audit + re-announce
+                ts2 = e2.storage.get(tid)
+                recovered = set(ts2.finished.indices())
+                # clean process kill: every flushed claim survives the audit
+                assert recovered == claimed
+                assert (
+                    dmetrics.TASK_RECOVERED_TOTAL.labels(state="partial").value
+                    == rec_tasks0 + 1
+                )
+                # the scheduler heard the re-announce: this host rejoined as a
+                # partial seed holding exactly the recovered set
+                announced = [
+                    p for p in svc.pool.tasks[tid].peers()
+                    if set(p.finished_pieces.indices()) == recovered
+                ]
+                assert announced, "recovered pieces were never re-announced"
+
+                # resume: only the missing pieces may ride the wire
+                bytes_before = origin.bytes_sent
+                parent0 = dmetrics.PIECE_DOWNLOAD_TOTAL.labels(source="parent").value
+                source0 = dmetrics.PIECE_DOWNLOAD_TOTAL.labels(source="back_to_source").value
+                out = tmp_path / "b.bin"
+                ts3 = await asyncio.wait_for(e2.download_task(url, output=out), 60)
+                missing = [i for i in range(5) if i not in recovered]
+                missing_bytes = sum(
+                    piece_range(i, PIECE, len(payload)).length for i in missing
+                )
+                assert origin.bytes_sent - bytes_before == missing_bytes
+                fetched = (
+                    dmetrics.PIECE_DOWNLOAD_TOTAL.labels(source="parent").value - parent0
+                    + dmetrics.PIECE_DOWNLOAD_TOTAL.labels(source="back_to_source").value
+                    - source0
+                )
+                assert fetched == len(missing)  # the refetch-counter proof
+                assert ts3.is_complete() and ts3.meta.done
+                assert out.read_bytes() == payload  # bit-exact after resume
+                await e2.stop()
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# seed crash while children stream from it
+
+
+class TestSeedCrash:
+    def test_seed_crash_and_restart_child_completes_bit_exact(
+        self, run, tmp_path, payload
+    ):
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            port = _free_port()
+            async with Origin({"f.bin": payload}) as origin:
+                url = origin.url("f.bin")
+                seed = _engine(tmp_path, client, "seed1", upload_port=port)
+                await seed.start()
+                await seed.download_task(url)
+                tid = seed.make_meta(url).task_id
+                seed_host_id = seed.host_id
+
+                child = _engine(
+                    tmp_path, client, "childs", total_download_rate_bps=8e6
+                )
+                await child.start()
+                task = asyncio.ensure_future(
+                    child.download_task(url, output=tmp_path / "c.bin")
+                )
+                await _wait_for_partial(child, tid, 1, 4)
+
+                ghosts = [
+                    p for p in svc.pool.tasks[tid].peers() if p.host.id == seed_host_id
+                ]
+                assert len(ghosts) == 1  # the seed's (about to be) ghost row
+                ghost_id = ghosts[0].id
+                superseded0 = smetrics.PEER_SUPERSEDED_TOTAL.value
+                await crash_engine(seed)  # no leave_host: the ghost stays
+
+                # restart on the same storage + port → same host identity;
+                # recovery re-announces the full task and replaces the ghost
+                seed2 = _engine(tmp_path, client, "seed1", upload_port=port)
+                await seed2.start()
+                rows = [
+                    p for p in svc.pool.tasks[tid].peers() if p.host.id == seed_host_id
+                ]
+                assert len(rows) == 1 and rows[0].id != ghost_id
+                assert rows[0].finished_pieces.count() == 5  # full seed again
+                assert smetrics.PEER_SUPERSEDED_TOTAL.value == superseded0 + 1
+
+                ts = await asyncio.wait_for(task, 60)
+                assert ts.is_complete()
+                assert (tmp_path / "c.bin").read_bytes() == payload
+                await child.stop()
+                await seed2.stop()
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# scheduler crash: the dual — daemons re-register/re-announce
+
+
+class _AmnesiacScheduler:
+    """Scripted control plane: hands out one dead parent, then forgets the
+    peer (reschedule → not_found, like a restarted scheduler), and sends the
+    re-registered peer back to source. Records what the conductor pushes
+    back so the rebuild-from-announces contract is assertable."""
+
+    def __init__(self, content_length: int, dead_port: int):
+        self.registers = 0
+        self.metadata_reports = 0
+        self.possession_announces: list[tuple[str, list[int]]] = []
+        self.success_reported_indices: list[int] = []
+        self._len = content_length
+        self._dead_port = dead_port
+
+    async def register_peer(self, peer_id, meta, host):
+        self.registers += 1
+        if self.registers == 1:
+            return RegisterResult(
+                scope="normal", task_id=meta.task_id,
+                parents=[ParentInfo("ghost", "h9", "127.0.0.1", self._dead_port)],
+                content_length=self._len, piece_size=PIECE,
+                total_pieces=(self._len + PIECE - 1) // PIECE,
+            )
+        return RegisterResult(
+            scope="normal", task_id=meta.task_id, back_to_source=True,
+            content_length=self._len, piece_size=PIECE,
+            total_pieces=(self._len + PIECE - 1) // PIECE,
+        )
+
+    async def reschedule(self, peer_id):
+        from dragonfly2_tpu.rpc.core import RpcError
+
+        raise RpcError(f"unknown peer {peer_id}", code="not_found")
+
+    async def report_task_metadata(self, task_id, **kw):
+        self.metadata_reports += 1
+
+    async def announce_task(self, peer_id, meta, host_info, *, piece_indices, **kw):
+        self.possession_announces.append((peer_id, list(piece_indices)))
+
+    async def report_pieces(self, peer_id, reports):
+        # held-piece pushback must NOT ride the success-report path (it
+        # would re-count traffic bytes and feed 0.0 cost samples)
+        self.success_reported_indices.extend(r[0] for r in reports)
+        return len(reports)
+
+    async def report_piece_result(self, peer_id, piece_index, **kw):
+        self.success_reported_indices.append(piece_index)
+
+    async def report_peer_result(self, *a, **kw): ...
+    async def leave_peer(self, *a, **kw): ...
+
+
+class TestSchedulerCrash:
+    def test_conductor_reregisters_and_pushes_state_on_not_found(
+        self, run, tmp_path
+    ):
+        """The recovery contract at conductor level, deterministically: a
+        not_found reschedule re-registers, re-reports task metadata, and
+        pushes the pieces this peer already holds — then finishes the task
+        through whatever the fresh scheduler says (here: back to source)."""
+        payload = bytes(range(256)) * (32 * 1024)  # 8 MiB -> 2 pieces
+
+        async def body():
+            async with Origin({"f.bin": payload}) as origin:
+                url = origin.url("f.bin")
+                sched = _AmnesiacScheduler(len(payload), _free_port())
+                sm = StorageManager(tmp_path / "amnesia")
+                tid = "resume-tid-0001"
+                ts = sm.register_task(tid, url=url)
+                ts.set_task_info(
+                    content_length=len(payload), piece_size=PIECE, total_pieces=2
+                )
+                await ts.write_piece(0, payload[:PIECE])  # resumed partial state
+                conductor = PeerTaskConductor(
+                    peer_id="amn-peer",
+                    meta=TaskMeta(task_id=tid, url=url),
+                    host=HostInfo(id="amn-host", ip="127.0.0.1", hostname="amn"),
+                    scheduler=sched,
+                    storage=sm,
+                    sources=SourceRegistry(),
+                    config=ConductorConfig(
+                        metadata_poll_interval=0.02, piece_timeout=5.0,
+                        no_progress_reschedule=0.2,
+                    ),
+                )
+                out = await asyncio.wait_for(conductor.run(), 30)
+                assert sched.registers == 2  # re-registered after not_found
+                # held pieces pushed back via the metrics-free possession
+                # announce — NEVER via the success-report path (which would
+                # re-count traffic + feed 0.0 cost samples)
+                assert ("amn-peer", [0]) in sched.possession_announces
+                assert 0 not in sched.success_reported_indices
+                assert 1 in sched.success_reported_indices  # the real fetch
+                assert conductor.pieces_preexisting == 1
+                assert conductor.pieces_fetched == 1  # piece 0 never re-rode
+                assert out.is_complete()
+                data = await out.read_range(Range(0, len(payload)))
+                assert data == payload
+
+        run(body())
+
+    def test_wire_reschedule_of_unknown_peer_maps_to_not_found(self, run):
+        from dragonfly2_tpu.rpc.core import RpcError
+        from dragonfly2_tpu.rpc.scheduler import RemoteSchedulerClient, serve_scheduler
+
+        async def body():
+            server = serve_scheduler(SchedulerService())
+            await server.start()
+            client = RemoteSchedulerClient(f"127.0.0.1:{server.port}", timeout=5.0)
+            try:
+                with pytest.raises(RpcError) as ei:
+                    await client.reschedule("ghost-peer")
+                assert ei.value.code == "not_found"
+            finally:
+                await client.close()
+                await server.stop()
+
+        run(body())
+
+    def test_scheduler_restart_rebuilds_view_from_announces(
+        self, run, tmp_path, payload
+    ):
+        """Scheduler dies and comes back empty; the daemon's possession
+        keepalive (announce_tasks) alone must rebuild enough state for the
+        next child to ride P2P — zero extra origin traffic."""
+
+        async def body():
+            svc1 = SchedulerService()
+            client = InProcessSchedulerClient(svc1)
+            async with Origin({"f.bin": payload}) as origin:
+                url = origin.url("f.bin")
+                e1 = _engine(tmp_path, client, "survivor")
+                await e1.start()
+                await e1.download_task(url)
+                requests_after_seed = origin.requests
+
+                client._svc = SchedulerService()  # crash + cold restart
+                assert await e1.announce_tasks() == 1  # the periodic keepalive
+
+                # keepalive announces are idempotent: the stable per-task
+                # peer id ADOPTS the existing row — a fresh id per interval
+                # would supersede the live seed row, severing children's DAG
+                # edges every 30s in a perfectly healthy cluster
+                tid = e1.make_meta(url).task_id
+                rows1 = {p.id for p in client._svc.pool.tasks[tid].peers()}
+                sup0 = smetrics.PEER_SUPERSEDED_TOTAL.value
+                assert await e1.announce_tasks() == 1
+                assert {p.id for p in client._svc.pool.tasks[tid].peers()} == rows1
+                assert smetrics.PEER_SUPERSEDED_TOTAL.value == sup0
+
+                e2 = _engine(tmp_path, client, "newchild")
+                await e2.start()
+                out = tmp_path / "r.bin"
+                await asyncio.wait_for(e2.download_task(url, output=out), 60)
+                assert out.read_bytes() == payload
+                # the rebuilt scheduler pointed e2 at e1 — origin untouched
+                assert origin.requests == requests_after_seed
+                await e1.stop()
+                await e2.stop()
+
+        run(body())
+
+    def test_scheduler_crash_mid_download_completes_bit_exact(
+        self, run, tmp_path, payload
+    ):
+        """Scheduler swapped for an empty one while a child is mid-transfer:
+        piece reports no-op, the data plane (daemon↔daemon piece fetch +
+        metadata long-poll) keeps flowing, and the download lands bit-exact."""
+
+        async def body():
+            svc1 = SchedulerService()
+            client = InProcessSchedulerClient(svc1)
+            async with Origin({"f.bin": payload}) as origin:
+                url = origin.url("f.bin")
+                parent = _engine(tmp_path, client, "parentm")
+                await parent.start()
+                await parent.download_task(url)
+                child = _engine(tmp_path, client, "childm", total_download_rate_bps=8e6)
+                await child.start()
+                tid = child.make_meta(url).task_id
+                task = asyncio.ensure_future(
+                    child.download_task(url, output=tmp_path / "m.bin")
+                )
+                await _wait_for_partial(child, tid, 1, 4)
+                client._svc = SchedulerService()  # mid-round crash + restart
+                ts = await asyncio.wait_for(task, 60)
+                assert ts.is_complete()
+                assert (tmp_path / "m.bin").read_bytes() == payload
+                await parent.stop()
+                await child.stop()
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# mTLS: manager CA → certs over RPC → TLS control plane → chaos download
+
+
+class TestMTLSDataPlane:
+    def test_mtls_end_to_end_with_chaos_faults(self, run, tmp_path, payload):
+        """ROADMAP #4's security proof: the manager's CA issues leaf certs
+        over the (token-gated, TLS-served) issuance RPC; scheduler and
+        daemons run ALL control RPC over mTLS (server verifies client certs,
+        clients pin the cluster CA); and a P2P download completes bit-exact
+        with chaos faults injected on both the data and control paths. A
+        certless client and a plain-TCP client are both rejected."""
+        from dragonfly2_tpu.manager.server import ManagerServer
+        from dragonfly2_tpu.rpc.core import RpcError
+        from dragonfly2_tpu.rpc.manager import RemoteManagerClient
+        from dragonfly2_tpu.rpc.scheduler import RemoteSchedulerClient, serve_scheduler
+        from dragonfly2_tpu.security.ca import (
+            CertificateAuthority,
+            IssuedCert,
+            client_ssl_context,
+            server_ssl_context,
+            write_issued,
+        )
+
+        async def body():
+            ca_dir = tmp_path / "ca"
+            # manager bootstraps the trust root and self-issues its own leaf
+            ca = CertificateAuthority(ca_dir)
+            mgr_paths = write_issued(
+                ca.issue("manager", sans=["127.0.0.1"]), tmp_path / "mgr"
+            )
+            ca_pem = mgr_paths["ca"]
+            manager = ManagerServer(
+                db_path=":memory:", port=0, rest_port=None,
+                ca_dir=str(ca_dir), cert_token="boot-token",
+                ssl=server_ssl_context(mgr_paths["cert"], mgr_paths["key"]),
+            )
+            await manager.start()
+            clients = []
+            engines = []
+            try:
+                mclient = RemoteManagerClient(
+                    manager.address, ssl=client_ssl_context(ca_pem)
+                )
+                clients.append(mclient)
+
+                async def issue(name: str):
+                    d = await mclient.issue_certificate(
+                        name, sans=["127.0.0.1"], token="boot-token"
+                    )
+                    return write_issued(
+                        IssuedCert(**{k: v.encode() for k, v in d.items()}),
+                        tmp_path / name,
+                    )
+
+                sched_paths = await issue("scheduler")
+                daemon_paths = await issue("daemon")
+
+                svc = SchedulerService()
+                server = serve_scheduler(
+                    svc,
+                    ssl=server_ssl_context(
+                        sched_paths["cert"], sched_paths["key"], ca_pem
+                    ),  # ca_path set → client certs REQUIRED (mTLS)
+                )
+                await server.start()
+                addr = f"127.0.0.1:{server.port}"
+
+                # negative 1: CA-pinned client WITHOUT a client cert is refused
+                certless = RemoteSchedulerClient(
+                    addr, timeout=2.0, retries=0, ssl=client_ssl_context(ca_pem)
+                )
+                clients.append(certless)
+                with pytest.raises((RpcError, ConnectionError, OSError)):
+                    await certless.stat_task("x")
+                # negative 2: a plain-TCP client cannot speak to the TLS port
+                plain = RemoteSchedulerClient(addr, timeout=2.0, retries=0)
+                clients.append(plain)
+                with pytest.raises((RpcError, ConnectionError, OSError)):
+                    await plain.stat_task("x")
+
+                def wire_client():
+                    c = RemoteSchedulerClient(
+                        addr, timeout=5.0, retries=5, retry_backoff=0.02,
+                        ssl=client_ssl_context(
+                            ca_pem, daemon_paths["cert"], daemon_paths["key"]
+                        ),
+                    )
+                    clients.append(c)
+                    return c
+
+                async with Origin({"f.bin": payload}) as origin:
+                    url = origin.url("f.bin")
+                    e1 = make_engine(tmp_path, wire_client(), "tls-peer1")
+                    e2 = make_engine(tmp_path, wire_client(), "tls-peer2")
+                    engines.extend([e1, e2])
+                    await e1.start()
+                    await e2.start()
+                    fl = faultline.enable(
+                        "parent.fetch:error:0.35,rpc.read:latency:0.3:0.01,seed=77"
+                    )
+                    await asyncio.wait_for(e1.download_task(url), 90)
+                    out = tmp_path / "tls.bin"
+                    await asyncio.wait_for(e2.download_task(url, output=out), 90)
+                    faultline.disable()
+                    assert out.read_bytes() == payload  # bit-exact, mTLS + chaos
+                    assert fl.injected_total() > 0, "chaos never fired"
+            finally:
+                faultline.disable()
+                for e in engines:
+                    await e.stop()
+                for c in clients:
+                    await c.close()
+                if "server" in locals():
+                    await server.stop()
+                await manager.stop()
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# the real thing: SIGKILL a daemon subprocess mid-download, restart, resume
+
+
+@pytest.mark.slow
+class TestSigkillDaemon:
+    def test_sigkill_mid_download_restart_resumes(self, run, tmp_path, payload):
+        import sys
+
+        from dragonfly2_tpu.rpc.core import RpcClient
+        from dragonfly2_tpu.rpc.scheduler import serve_scheduler
+        from dragonfly2_tpu.utils import idgen
+
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        store = tmp_path / "dstore"
+        sock = tmp_path / "d.sock"
+        upload_port = _free_port()
+
+        logs = {"n": 0}
+
+        async def spawn_daemon(scheduler_port: int):
+            env = dict(os.environ, JAX_PLATFORMS="cpu")
+            env["PYTHONPATH"] = repo_root + os.pathsep + env.get("PYTHONPATH", "")
+            sock.unlink(missing_ok=True)  # SIGKILL leaves the socket file behind
+            logs["n"] += 1
+            stderr_log = open(tmp_path / f"daemon{logs['n']}.err", "wb")
+            proc = await asyncio.create_subprocess_exec(
+                sys.executable, "-m", "dragonfly2_tpu.daemon.server",
+                "--scheduler", f"127.0.0.1:{scheduler_port}",
+                "--storage", str(store), "--sock", str(sock),
+                "--upload-port", str(upload_port), "--hostname", "skd",
+                cwd=repo_root, env=env,
+                stdout=asyncio.subprocess.PIPE, stderr=stderr_log,
+            )
+            stderr_log.close()  # inherited by the child; keep our fd count flat
+            while True:
+                line = await asyncio.wait_for(proc.stdout.readline(), 60)
+                assert line, "daemon died before READY"
+                if line.startswith(b"DAEMON_READY"):
+                    return proc
+
+        async def body():
+            svc = SchedulerService()
+            server = serve_scheduler(svc)
+            await server.start()
+            # 1 s per ranged GET: 5 pieces at concurrency 4 → two waves,
+            # plenty of wall-clock to land the kill between them
+            async with Origin({"f.bin": payload}, response_delay_s=1.0) as origin:
+                url = origin.url("f.bin")
+                tid = idgen.task_id(url)
+                meta_path = store / tid / "metadata.json"
+                proc = await spawn_daemon(server.port)
+                client = RpcClient(str(sock), timeout=120.0, retries=0)
+                out = tmp_path / "sk.bin"
+                dl = asyncio.ensure_future(
+                    client.call("download", {"url": url, "output": str(out)})
+                )
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    if meta_path.exists():
+                        claims = set(
+                            Bitset(
+                                json.loads(meta_path.read_text())["finished_pieces"]
+                            ).indices()
+                        )
+                        if 0 < len(claims) < 5:
+                            break
+                    await asyncio.sleep(0.05)
+                else:
+                    pytest.fail("daemon never persisted a partial claim")
+                proc.kill()  # SIGKILL: no flush, no leave_host, no goodbye
+                await proc.wait()
+                await asyncio.gather(dl, return_exceptions=True)
+                await client.close()
+                claimed = set(
+                    Bitset(
+                        json.loads(meta_path.read_text())["finished_pieces"]
+                    ).indices()
+                )
+                assert 0 < len(claimed) < 5
+                # Drain the dead daemon's in-flight origin GETs before
+                # snapshotting: the origin counts bytes_sent AFTER its
+                # response_delay_s sleep, so a request the SIGKILL orphaned
+                # mid-sleep would land its piece in the counter a second from
+                # now and read as a phantom refetch by the restarted daemon.
+                quiesce = time.monotonic() + 15
+                prev = -1
+                while time.monotonic() < quiesce:
+                    if origin.inflight == 0 and origin.bytes_sent == prev:
+                        break
+                    prev = origin.bytes_sent
+                    await asyncio.sleep(0.25)
+                else:
+                    pytest.fail("origin never quiesced after SIGKILL")
+                bytes_before = origin.bytes_sent
+                origin.range_log.clear()
+
+                proc2 = await spawn_daemon(server.port)
+                client2 = RpcClient(str(sock), timeout=120.0, retries=0)
+                try:
+                    res = await asyncio.wait_for(
+                        client2.call("download", {"url": url, "output": str(out)}), 90
+                    )
+                    assert res["done"] and res["pieces"] == 5
+                    assert out.read_bytes() == payload  # bit-exact after SIGKILL
+                    missing_bytes = sum(
+                        piece_range(i, PIECE, len(payload)).length
+                        for i in range(5) if i not in claimed
+                    )
+                    # recovered pieces never rode the wire again: no post-
+                    # restart range request overlaps a claimed piece, and the
+                    # byte total is exactly the missing set
+                    for idx in claimed:
+                        r = piece_range(idx, PIECE, len(payload))
+                        for start, length in origin.range_log:
+                            assert not (
+                                start < r.start + r.length and r.start < start + length
+                            ), f"recovered piece {idx} re-downloaded ({start}+{length})"
+                    assert origin.bytes_sent - bytes_before == missing_bytes
+                finally:
+                    await client2.close()
+                    proc2.terminate()
+                    await proc2.wait()
+            await server.stop()
+
+        run(body())

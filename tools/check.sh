@@ -65,16 +65,24 @@ else
     skip_stage "mypy" "not installed"
 fi
 
-# chaos is excluded here and run as its own leg below: a resilience
-# regression is then named by the stage that caught it, and the suite is not
-# paid for twice. (The ROADMAP tier-1 command still runs `-m 'not slow'`,
-# chaos included — both stages together cover exactly that set.)
+# chaos and restart are excluded here and run as their own legs below: a
+# resilience/recovery regression is then named by the stage that caught it,
+# and the suites are not paid for twice. (The ROADMAP tier-1 command still
+# runs `-m 'not slow'`, chaos+restart included — the stages together cover
+# exactly that set.)
 run_stage "pytest-tier1" env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
-    -m 'not slow and not chaos' --continue-on-collection-errors \
+    -m 'not slow and not chaos and not restart' --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
 run_stage "chaos-smoke" env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
     -m 'chaos and not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+
+# restart-smoke: the fast in-process crash/recover/resume path (daemon kill
+# at ~50%, seed crash, scheduler crash, torn-piece debounce window, mTLS-on
+# data plane). The real-SIGKILL subprocess variants are marked slow.
+run_stage "restart-smoke" env JAX_PLATFORMS=cpu python -m pytest tests/test_restart.py -q \
+    -m 'restart and not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
 # control-plane smoke: the bench section at tiny shapes — catches a broken
