@@ -89,13 +89,26 @@ async def run_stress(args: argparse.Namespace) -> dict:
 
 
 async def run_scoring_stress(args: argparse.Namespace) -> dict:
-    """Serving-SLO stress (VERDICT r4 Next #6): drive scheduling rounds
-    through the LIVE evaluator stack — MLEvaluator + MicroBatchScorer + the
-    native multi-round FFI — on a real SchedulerService resource pool, and
-    report rounds/s + p50/p99. This measures the END-TO-END scoring path
-    (feature assembly included), not the raw FFI layer the headline bench
-    isolates; the full-round number (sample + 8 filters + score + top-4) is
-    reported alongside."""
+    """Serving-SLO stress (VERDICT r4 Next #6, sharded in ISSUE 7): drive
+    scheduling rounds through the LIVE evaluator stack on a real
+    SchedulerService resource pool and report rounds/s + p50/p99 for THREE
+    serving shapes, interleaved same-run median-of-3 (2-core box
+    discipline — this container drifts ±30% run-to-run):
+
+      microbatch    the r05 single-loop path: concurrent rounds coalesce in
+                    MicroBatchScorer into one multi-round FFI call
+      workers=1/2   the round dispatcher: each round's assembly+FFI runs
+                    whole on a worker thread with its OWN native handle
+                    (ScorerHandlePool; scorer.cc serializes a shared handle)
+
+    The headline (`value`) is the BEST-measured serving config on this
+    host, named in `eval_best_config` (on wide hosts that should be the
+    dispatcher; on this 2-core box the loop's own glue + one worker already
+    saturate the GIL, so workers1 or microbatch typically wins); the
+    workers=1 leg isolates the thread-scaling factor from the executor-hop
+    overhead both dispatcher legs pay. full_round_rps covers the complete
+    round (sample + filters + score + top-4), again best-of named in
+    `full_round_best_config` with both legs reported."""
     import tempfile
     from pathlib import Path
 
@@ -105,9 +118,15 @@ async def run_scoring_stress(args: argparse.Namespace) -> dict:
     import jax.numpy as jnp
 
     from dragonfly2_tpu.models.graphsage import TopoGraph
-    from dragonfly2_tpu.native import MicroBatchScorer, NativeScorer, export_scorer_artifact
+    from dragonfly2_tpu.native import (
+        MicroBatchScorer,
+        NativeScorer,
+        ScorerHandlePool,
+        export_scorer_artifact,
+    )
     from dragonfly2_tpu.scheduler.evaluator import new_evaluator
     from dragonfly2_tpu.scheduler.resource import HostType
+    from dragonfly2_tpu.scheduler.scheduling import RoundDispatcher, usable_cpu_count
     from dragonfly2_tpu.scheduler.service import SchedulerService, TaskMeta
     from dragonfly2_tpu.trainer import synthetic, train_gnn
 
@@ -158,12 +177,20 @@ async def run_scoring_stress(args: argparse.Namespace) -> dict:
                 parents.append(p)
         node_index = {h.id: i % n_nodes for i, h in enumerate(hosts)}
         mb = MicroBatchScorer(scorer)
-        ev.attach_scorer(scorer, node_index, microbatch=mb)
+        handle_pool = ScorerHandlePool(scorer)
+        ev.attach_scorer(scorer, node_index, microbatch=mb, handle_pool=handle_pool)
+        # two dispatchers over the same Scheduling: the workers=1 vs 2 A/B
+        # must differ ONLY in worker count (same lock, same rng, same pool)
+        disp1 = RoundDispatcher(svc.scheduling, workers=1)
+        disp2 = RoundDispatcher(svc.scheduling, workers=2)
 
         cand = parents[: args.candidates]
-        # warm both paths (first calls build caches / start the flusher)
+        # warm every path (first calls build caches, fork per-thread
+        # handles, start the micro-batch flusher)
         for _ in range(3):
             await asyncio.gather(*(ev.evaluate_async(c, cand) for c in children))
+            await asyncio.gather(*(disp1.evaluate(c, cand) for c in children))
+            await asyncio.gather(*(disp2.evaluate(c, cand) for c in children))
 
         async def measure(fn) -> tuple[float, np.ndarray]:
             done = 0
@@ -181,14 +208,62 @@ async def run_scoring_stress(args: argparse.Namespace) -> dict:
             await asyncio.gather(*(driver(c) for c in children))
             return args.rounds / (time.monotonic() - t0), np.asarray(lat) * 1000
 
+        # ---- eval leg (prepare+score only), three shapes interleaved ----
+        eval_legs = {
+            "microbatch": lambda c: ev.evaluate_async(c, cand),
+            "workers1": lambda c: disp1.evaluate(c, cand),
+            "workers2": lambda c: disp2.evaluate(c, cand),
+        }
+        eval_rates: dict[str, list[float]] = {k: [] for k in eval_legs}
+        # latency samples POOLED across all three reps (keeping only the
+        # last rep's array paired a median-of-3 throughput with a single
+        # noise sample of latency on a ±30%-drift box)
+        eval_lats: dict[str, list[np.ndarray]] = {k: [] for k in eval_legs}
         flushes0, rounds0 = mb.flushes, mb.rounds
-        eval_rps, eval_lat = await measure(lambda c: ev.evaluate_async(c, cand))
-        # snapshot the coalescing stats for the EVAL phase alone (warmup and
-        # the full-round phase below would otherwise pollute the ratio)
+        for _rep in range(3):
+            for name, fn in eval_legs.items():
+                rps, lat = await measure(fn)
+                eval_rates[name].append(rps)
+                eval_lats[name].append(lat)
+        # coalescing stats cover exactly the microbatch legs (the dispatcher
+        # legs never touch the micro-batcher)
         eval_flushes, eval_rounds = mb.flushes - flushes0, mb.rounds - rounds0
-        full_rps, full_lat = await measure(
-            lambda c: svc.scheduling.find_candidate_parents_async(c)
-        )
+        mb_rps = float(np.median(eval_rates["microbatch"]))
+        w1_rps = float(np.median(eval_rates["workers1"]))
+        w2_rps = float(np.median(eval_rates["workers2"]))
+        # Headline = the best-measured serving config ON THIS HOST, named in
+        # eval_best_config: on the 2-core CI box the event loop's own
+        # per-round glue plus one worker already saturate the GIL, so
+        # workers=1 (round CPU off-loop, loop glue on the freed core) is
+        # typically the winner and workers=2 adds nothing the box can give —
+        # the full scaling curve needs wider hosts (ROADMAP #1's caveat;
+        # tests/test_dispatch.py proves the 1→2 growth property with a
+        # GIL-releasing scorer stub).
+        best = max(eval_legs, key=lambda k: float(np.median(eval_rates[k])))
+        eval_rps = float(np.median(eval_rates[best]))
+        eval_lat = np.concatenate(eval_lats[best])
+
+        # ---- full round (sample + filters + score + top-4) ----
+        full_serial_rates, full_disp_rates, full_disp_lats = [], [], []
+        for _rep in range(3):
+            rps, _ = await measure(
+                lambda c: svc.scheduling.find_candidate_parents_async(c)
+            )
+            full_serial_rates.append(rps)
+            rps, lat = await measure(lambda c: disp2.find(c))
+            full_disp_rates.append(rps)
+            full_disp_lats.append(lat)
+        full_lat = np.concatenate(full_disp_lats)
+        full_serial_rps = float(np.median(full_serial_rates))
+        full_disp_rps = float(np.median(full_disp_rates))
+        # same best-config honesty as the eval leg: the serial loop is the
+        # shipping default (dispatch_workers=0) and must never be made to
+        # LOOK slower by pinning the headline to the dispatcher on a host
+        # that can't feed it
+        full_best = "dispatcher" if full_disp_rps >= full_serial_rps else "serial"
+        full_rps = max(full_disp_rps, full_serial_rps)
+        disp1.shutdown()
+        disp2.shutdown()
 
         # Cost decomposition → the host's serving ceiling. Everything on this
         # path is CPU work on the scheduler's event-loop core: feature
@@ -224,22 +299,44 @@ async def run_scoring_stress(args: argparse.Namespace) -> dict:
                 scorer.score_rounds(mf, child=mc, parent=mp)
             ffi_us = (time.monotonic() - t0) / probe_n * 1e6
             ceiling_rps = 1e6 / (prepare_us + ffi_us)
+        handle_pool.close()
         scorer.close()
 
     def pct(lat: np.ndarray, q: float) -> float:
         return round(float(np.percentile(lat, q)), 3) if len(lat) else None
 
+    # Honest ceiling accounting (ISSUE 7 satellite): the r05 capture reported
+    # host_cpu_count 1 on a 2-core box (os.cpu_count semantics under the
+    # container) — cores now come from the scheduling-affinity mask with
+    # os.cpu_count alongside, the ceiling stays PER-CORE by definition
+    # (1/(prepare+ffi) on one core), and the fraction divides by the cores
+    # the dispatcher could actually use, so "1.05 of ceiling" can no longer
+    # read as "done" when a second core sits idle.
+    cpus = usable_cpu_count()
+    cores_usable = min(disp2.workers, cpus)
     return {
         "metric": "evaluator_scoring_rounds_per_sec",
         "value": round(eval_rps, 1),
-        "unit": "rounds/s (MLEvaluator+MicroBatch+native FFI, feature build included)",
+        "unit": (
+            f"rounds/s (MLEvaluator end-to-end, feature build included; "
+            f"best config = {best}, see eval_best_config)"
+        ),
         "extra": {
             "candidates_per_round": len(cand),
             "concurrency": args.concurrency,
             "rounds": args.rounds,
             "eval_p50_ms": pct(eval_lat, 50),
             "eval_p99_ms": pct(eval_lat, 99),
+            "eval_best_config": best,
+            "rounds_per_sec_microbatch": round(mb_rps, 1),
+            "rounds_per_sec_workers1": round(w1_rps, 1),
+            "rounds_per_sec_workers2": round(w2_rps, 1),
+            "thread_scaling_speedup": round(w2_rps / max(w1_rps, 1e-9), 3),
+            "dispatch_workers": disp2.workers,
             "full_round_rps": round(full_rps, 1),
+            "full_round_best_config": full_best,
+            "full_round_rps_serial": round(full_serial_rps, 1),
+            "full_round_rps_dispatcher": round(full_disp_rps, 1),
             "full_round_p50_ms": pct(full_lat, 50),
             "full_round_p99_ms": pct(full_lat, 99),
             "native_flushes": eval_flushes,
@@ -248,9 +345,13 @@ async def run_scoring_stress(args: argparse.Namespace) -> dict:
             "ffi_us_per_round_amortized": round(ffi_us, 1) if ffi_us is not None else None,
             "single_core_ceiling_rps": round(ceiling_rps, 1) if ceiling_rps else None,
             "ceiling_fraction_achieved": (
+                round(eval_rps / (ceiling_rps * cores_usable), 3) if ceiling_rps else None
+            ),
+            "ceiling_fraction_single_core": (
                 round(eval_rps / ceiling_rps, 3) if ceiling_rps else None
             ),
-            "host_cpu_count": os.cpu_count(),
+            "host_cpu_count": cpus,
+            "host_cpu_count_os": os.cpu_count(),
         },
     }
 

@@ -78,11 +78,19 @@ class PieceReportBuffer:
     path. Failed pieces never enter the buffer: they drive rescheduling and
     are reported individually and promptly by the caller.
 
-    Flush triggers: buffer reaches max_batch (spawned task), first add into
-    an empty buffer arms a flush_interval timer (bounds report staleness for
-    long rounds), the conductor flushes at dispatch-round end, and close()
-    flushes at task completion (before report_peer_result, so the
-    scheduler's telemetry sees the full finished set).
+    Flush triggers: buffer reaches max_batch, buffered reports go
+    flush_interval stale (bounds report staleness for long rounds), the
+    conductor flushes at dispatch-round end, and close() flushes at task
+    completion (before report_peer_result, so the scheduler's telemetry sees
+    the full finished set).
+
+    ONE long-lived flusher task per conductor serves the size and staleness
+    triggers (PR 5 carry-over / ROADMAP): the earlier shape re-spawned a
+    staleness-timer task per flush cycle and a detached task per size
+    trigger — per-piece task churn on the hot path (the pattern dflint DF026
+    now flags for threads/pools). add() is still synchronous: it appends,
+    sets an event, and the flusher does the rest; `flusher_starts` counts
+    task creations so tests can pin the no-churn contract.
 
     Exactly-once under rpc.write faults: flush() atomically takes the
     buffered triples and awaits ONE report_pieces call; the rpc client
@@ -102,27 +110,58 @@ class PieceReportBuffer:
         self.flush_interval = flush_interval
         self.log = log or logger
         self._buf: list[tuple[int, float, str]] = []
-        self._timer: asyncio.Task | None = None
         self._lock = asyncio.Lock()  # serializes flushes (ordering + no double-take)
-        self._size_flushes: set[asyncio.Task] = set()
+        self._flusher: asyncio.Task | None = None
+        # events are created in __init__ (lazily loop-bound on 3.10), set by
+        # add(): _wake = "buffer went non-empty", _full = "size trigger hit"
+        self._wake = asyncio.Event()
+        self._full = asyncio.Event()
         self.rpcs = 0  # report_pieces calls that completed (bench/test counter)
         self.buffered = 0  # pieces that rode a batch instead of a unary RPC
+        self.flusher_starts = 0  # long-lived task creations (leak canary: stays 1)
 
     def add(self, piece_index: int, cost_ms: float = 0.0, parent_id: str = "") -> None:
         """Enqueue one successful piece report. Sync — the piece worker goes
-        straight back to its queue; no RPC await on the piece path."""
+        straight back to its queue; no RPC await, no task spawned, on the
+        piece path."""
         self._buf.append((piece_index, cost_ms, parent_id))  # dflint: disable=DF023 loop-thread append, no await around it; the lock serializes FLUSHES, not enqueues
         self.buffered += 1
         if len(self._buf) >= self.max_batch:
-            t = asyncio.ensure_future(self.flush())
-            self._size_flushes.add(t)
-            t.add_done_callback(self._size_flushes.discard)
-        elif self._timer is None or self._timer.done():
-            self._timer = asyncio.ensure_future(self._timer_flush())
+            self._full.set()
+        if self._flusher is None or self._flusher.done():
+            # lazy start (add is the first point with a running loop); a
+            # flusher that DIED (cancelled mid-close, crashed) is restarted
+            # so a reused buffer never silently stops flushing
+            self.flusher_starts += 1
+            self._flusher = asyncio.ensure_future(self._flusher_loop())
+        else:
+            self._wake.set()
 
-    async def _timer_flush(self) -> None:
-        await asyncio.sleep(self.flush_interval)
-        await self.flush()
+    async def _flusher_loop(self) -> None:
+        """The single long-lived flusher: parks while the buffer is empty,
+        then flushes when the buffer fills (size trigger) or flush_interval
+        after it went non-empty (staleness trigger) — the same externally
+        observable schedule the per-flush timer tasks produced, without
+        creating a task per cycle."""
+        while True:
+            if not self._buf:
+                await self._wake.wait()
+                self._wake.clear()
+                if not self._buf:  # spurious wake (a direct flush drained us)
+                    continue
+            if len(self._buf) < self.max_batch:
+                try:
+                    await asyncio.wait_for(self._full.wait(), self.flush_interval)
+                except asyncio.TimeoutError:
+                    pass  # staleness trigger: flush whatever is buffered
+            self._full.clear()  # dflint: disable=DF023 loop-thread event signaling; the lock serializes FLUSHES — flush()'s own clear just runs inside its locked drain
+            await self.flush()
+            if self._buf:
+                # flush failed past the rpc client's retries and re-merged:
+                # PACE the retry. A re-merged buffer >= max_batch would skip
+                # the staleness wait above and hammer a dead scheduler in a
+                # tight loop (fast-failing RPCs make it a busy spin).
+                await asyncio.sleep(self.flush_interval)
 
     async def flush(self) -> None:
         """Drain the buffer in one report_pieces RPC (or a few, if adds land
@@ -152,9 +191,16 @@ class PieceReportBuffer:
                     # already landed re-applies as a no-op — idempotent).
                     self._buf = batch + self._buf
                     raise
+            # Drained: a size-trigger signal set by adds this flush consumed
+            # is now stale — left set, the flusher's next cycle would skip
+            # the staleness wait and ship a tiny batch (a direct round-end
+            # flush racing the size trigger reintroduced near-unary RPCs).
+            # The failure paths above return/raise with the buffer non-empty
+            # and deliberately leave the signal armed for a prompt retry.
+            self._full.clear()
 
     async def aclose(self) -> None:
-        """Task-completion flush; cancels the staleness timer.
+        """Task-completion flush; stops the long-lived flusher.
 
         Unlike mid-round flushes (which can leave failures to the next
         trigger), this is the LAST trigger: a flush that fails past the rpc
@@ -162,9 +208,13 @@ class PieceReportBuffer:
         dropping the residue would lose piece accounting at exactly the
         moment report_peer_result snapshots the finished set into telemetry
         (the chaos suite pins no-loss under rpc.write faults)."""
-        if self._timer is not None:
-            self._timer.cancel()
-            self._timer = None
+        if self._flusher is not None:
+            self._flusher.cancel()
+            # await the cancellation: a flusher parked inside flush()'s RPC
+            # holds the flush lock and must finish its BaseException re-merge
+            # before the close flush below can take the (complete) buffer
+            await asyncio.gather(self._flusher, return_exceptions=True)
+            self._flusher = None
         backoff = BackoffPolicy(base=0.05, max_delay=1.0)
         for attempt in range(4):
             if attempt:

@@ -11,6 +11,7 @@ through the MLP head with no Python/JAX on the hot path.
 from dragonfly2_tpu.native.microbatch import MicroBatchScorer
 from dragonfly2_tpu.native.scorer import (
     NativeScorer,
+    ScorerHandlePool,
     build_native_lib,
     export_scorer_artifact,
 )
@@ -18,6 +19,7 @@ from dragonfly2_tpu.native.scorer import (
 __all__ = [
     "MicroBatchScorer",
     "NativeScorer",
+    "ScorerHandlePool",
     "build_native_lib",
     "export_scorer_artifact",
 ]

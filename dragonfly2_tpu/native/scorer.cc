@@ -28,8 +28,11 @@
 // calls on ONE handle are serialized by an internal mutex (ctypes releases
 // the GIL during the call — without the lock two Python threads sharing a
 // scorer would race on the scratch vectors). For parallel serving use one
-// handle per thread; OpenMP (when compiled in) parallelizes INSIDE a call
-// across row blocks.
+// handle per thread — df_scorer_fork hands out extra handles that SHARE the
+// immutable model data (weights/embeddings/precompute, refcounted), so N
+// worker threads cost one model's cache footprint, not N. OpenMP (when
+// compiled in) parallelizes INSIDE a call across row blocks;
+// df_scorer_set_thread_parallelism caps that per calling thread.
 //
 // Build: g++ -O3 -shared -fPIC -o libdfscorer.so scorer.cc  (see scorer.py)
 //
@@ -43,6 +46,7 @@
 //   f32 W3[H2*1]        f32 b3[1]
 
 #include <algorithm>
+#include <atomic>
 #include <cmath>
 #include <cstdint>
 #include <cstdio>
@@ -112,24 +116,34 @@ inline void storeu16(float* p, v16 v) { *reinterpret_cast<v16u*>(p) = v; }
 // Register-blocked micro-kernel: 8 rows × 16 cols of Y live in 8 vector
 // registers across the whole contraction — Y is read and written exactly
 // once, and each streamed W vector feeds 8 FMAs.
+//
+// Loop order is column-panel OUTER, row-block inner: the W panel
+// (in × 16 floats, ~9 KB at serving shapes) stays L1-resident across every
+// row block, so W streams from cache once per call. The row-outer order
+// re-streamed the whole W (147 KB at H1=256) per 8-row block — R/8 × 147 KB
+// of LLC/DRAM traffic per call, which is what capped two dispatcher
+// workers' concurrent GEMMs at ~1.3x on a host whose ALU-bound work scales
+// 1.93x (X re-reads cost in/out = ~0.5 the W traffic saved, and X rows are
+// hot in L2 anyway).
 void gemm_acc(const float* __restrict__ X, const float* __restrict__ W,
               float* __restrict__ Y, int R, int in, int out) {
   constexpr int RB = 8, CB = 16;
-  int r = 0;
-  for (; r + RB <= R; r += RB) {
-    const float* x[RB];
-    float* y[RB];
-    for (int k = 0; k < RB; ++k) {
-      x[k] = X + static_cast<size_t>(r + k) * in;
-      y[k] = Y + static_cast<size_t>(r + k) * out;
-    }
-    int o = 0;
-    for (; o + CB <= out; o += CB) {
+  int o = 0;
+  for (; o + CB <= out; o += CB) {
+    const float* Wp = W + o;
+    int r = 0;
+    for (; r + RB <= R; r += RB) {
+      const float* x[RB];
+      float* y[RB];
+      for (int k = 0; k < RB; ++k) {
+        x[k] = X + static_cast<size_t>(r + k) * in;
+        y[k] = Y + static_cast<size_t>(r + k) * out;
+      }
       v16 a0 = loadu16(y[0] + o), a1 = loadu16(y[1] + o);
       v16 a2 = loadu16(y[2] + o), a3 = loadu16(y[3] + o);
       v16 a4 = loadu16(y[4] + o), a5 = loadu16(y[5] + o);
       v16 a6 = loadu16(y[6] + o), a7 = loadu16(y[7] + o);
-      const float* w = W + o;
+      const float* w = Wp;
       for (int i = 0; i < in; ++i, w += out) {
         const v16 wv = loadu16(w);
         a0 += x[0][i] * wv;
@@ -150,32 +164,23 @@ void gemm_acc(const float* __restrict__ X, const float* __restrict__ W,
       storeu16(y[6] + o, a6);
       storeu16(y[7] + o, a7);
     }
-    for (; o < out; ++o) {
-      const float* w = W + o;
-      float acc[RB];
-      for (int k = 0; k < RB; ++k) acc[k] = y[k][o];
-      for (int i = 0; i < in; ++i, w += out) {
-        const float wv = *w;
-        for (int k = 0; k < RB; ++k) acc[k] += x[k][i] * wv;
-      }
-      for (int k = 0; k < RB; ++k) y[k][o] = acc[k];
-    }
-  }
-  for (; r < R; ++r) {
-    const float* xr = X + static_cast<size_t>(r) * in;
-    float* yr = Y + static_cast<size_t>(r) * out;
-    int o = 0;
-    for (; o + CB <= out; o += CB) {
+    for (; r < R; ++r) {
+      const float* xr = X + static_cast<size_t>(r) * in;
+      float* yr = Y + static_cast<size_t>(r) * out;
       v16 a = loadu16(yr + o);
-      const float* w = W + o;
+      const float* w = Wp;
       for (int i = 0; i < in; ++i, w += out) a += xr[i] * loadu16(w);
       storeu16(yr + o, a);
     }
-    for (; o < out; ++o) {
-      float a = yr[o];
-      const float* w = W + o;
+  }
+  for (; o < out; ++o) {
+    const float* w0 = W + o;
+    for (int r = 0; r < R; ++r) {
+      const float* xr = X + static_cast<size_t>(r) * in;
+      float a = Y[static_cast<size_t>(r) * out + o];
+      const float* w = w0;
       for (int i = 0; i < in; ++i, w += out) a += xr[i] * *w;
-      yr[o] = a;
+      Y[static_cast<size_t>(r) * out + o] = a;
     }
   }
 }
@@ -184,15 +189,43 @@ void gemm_acc(const float* __restrict__ X, const float* __restrict__ W,
 
 extern "C" {
 
-struct DfScorer {
+// Cap THIS THREAD's intra-call OpenMP parallelism (nthreads ICV is
+// per-thread). The scheduler's round dispatcher pins its worker threads to
+// 1: it shards rounds ACROSS workers, and letting every worker's GEMM also
+// fan out OMP threads oversubscribes the host — libgomp's spin-waiting
+// helpers burn the very cores the other workers' Python needs (measured
+// NEGATIVE scaling, 0.74x at 2 workers on a 2-core host). Single-threaded
+// callers (the micro-batch serving path, the bench headline) never call
+// this and keep whole-host intra-call parallelism. No-op without OpenMP.
+void df_scorer_set_thread_parallelism(int n) {
+#ifdef _OPENMP
+  if (n > 0) omp_set_num_threads(n);
+#endif
+  (void)n;
+}
+
+// Immutable model data, SHARED across handles (refcounted): the weights,
+// embeddings, and uc/up precompute total ~1-2 MB at serving shapes, and the
+// GEMM streams them every call — per-handle copies would double the cache
+// working set per added worker thread and thrash the shared LLC (measured:
+// duplicating the model capped 2-worker scaling at ~1.2x on a host whose
+// compute scales 1.93x; sharing restores the headroom). Handles only own
+// scratch + a mutex.
+struct DfModel {
   Header hdr;
   std::vector<float> z, w1, b1, w2, b2, w3, b3;
   // load-time precompute: first-layer contributions of each node's embedding
   // in child position (uc) and parent position (up), [N, H1] each
   std::vector<float> uc, up;
+  std::atomic<int32_t> refs{1};
+};
+
+struct DfScorer {
+  DfModel* model;
   // per-handle scratch reused across calls (no per-call malloc on the hot
   // path); sliced disjointly by OpenMP row blocks inside one call, guarded
-  // across calls by `mu`
+  // across calls by `mu` — which is why concurrent threads need one handle
+  // each (df_scorer_fork)
   std::vector<float> sx, sy1, sy2;
   std::mutex mu;
 };
@@ -200,41 +233,60 @@ struct DfScorer {
 DfScorer* df_scorer_load(const char* path) {
   FILE* f = std::fopen(path, "rb");
   if (!f) return nullptr;
-  DfScorer* s = new DfScorer();
-  bool ok = std::fread(&s->hdr, sizeof(Header), 1, f) == 1 &&
-            s->hdr.magic == kMagic && s->hdr.version == kVersion;
+  DfModel* m = new DfModel();
+  bool ok = std::fread(&m->hdr, sizeof(Header), 1, f) == 1 &&
+            m->hdr.magic == kMagic && m->hdr.version == kVersion;
   if (ok) {
-    const Header& h = s->hdr;
+    const Header& h = m->hdr;
     const uint32_t in = 3 * h.d + h.fp;
     auto rd = [&](std::vector<float>& v, size_t count) {
       v.resize(count);
       return std::fread(v.data(), sizeof(float), count, f) == count;
     };
-    ok = rd(s->z, (size_t)h.n * h.d) && rd(s->w1, (size_t)in * h.h1) &&
-         rd(s->b1, h.h1) && rd(s->w2, (size_t)h.h1 * h.h2) && rd(s->b2, h.h2) &&
-         rd(s->w3, h.h2) && rd(s->b3, 1);
+    ok = rd(m->z, (size_t)h.n * h.d) && rd(m->w1, (size_t)in * h.h1) &&
+         rd(m->b1, h.h1) && rd(m->w2, (size_t)h.h1 * h.h2) && rd(m->b2, h.h2) &&
+         rd(m->w3, h.h2) && rd(m->b3, 1);
   }
   std::fclose(f);
   if (!ok) {
-    delete s;
+    delete m;
     return nullptr;
   }
   // Precompute uc = z · W1[0:D], up = z · W1[D:2D]  (one-time ~2·N·D·H1 MACs)
-  const Header& h = s->hdr;
-  s->uc.assign((size_t)h.n * h.h1, 0.0f);
-  s->up.assign((size_t)h.n * h.h1, 0.0f);
-  gemm_acc(s->z.data(), s->w1.data(), s->uc.data(), (int)h.n, (int)h.d,
+  const Header& h = m->hdr;
+  m->uc.assign((size_t)h.n * h.h1, 0.0f);
+  m->up.assign((size_t)h.n * h.h1, 0.0f);
+  gemm_acc(m->z.data(), m->w1.data(), m->uc.data(), (int)h.n, (int)h.d,
            (int)h.h1);
-  gemm_acc(s->z.data(), s->w1.data() + (size_t)h.d * h.h1, s->up.data(),
+  gemm_acc(m->z.data(), m->w1.data() + (size_t)h.d * h.h1, m->up.data(),
            (int)h.n, (int)h.d, (int)h.h1);
+  DfScorer* s = new DfScorer();
+  s->model = m;
   return s;
 }
 
-void df_scorer_free(DfScorer* s) { delete s; }
+// A new handle onto the SAME model (refs++): fresh scratch + mutex, zero
+// copies — the per-worker-thread handle the scheduler's round dispatcher
+// uses (scorer.cc rule: one handle per thread).
+DfScorer* df_scorer_fork(DfScorer* s) {
+  if (!s) return nullptr;
+  s->model->refs.fetch_add(1, std::memory_order_relaxed);
+  DfScorer* t = new DfScorer();
+  t->model = s->model;
+  return t;
+}
 
-int32_t df_scorer_num_nodes(const DfScorer* s) { return (int32_t)s->hdr.n; }
-int32_t df_scorer_embed_dim(const DfScorer* s) { return (int32_t)s->hdr.d; }
-int32_t df_scorer_feature_dim(const DfScorer* s) { return (int32_t)s->hdr.fp; }
+void df_scorer_free(DfScorer* s) {
+  if (!s) return;
+  if (s->model->refs.fetch_sub(1, std::memory_order_acq_rel) == 1) {
+    delete s->model;
+  }
+  delete s;
+}
+
+int32_t df_scorer_num_nodes(const DfScorer* s) { return (int32_t)s->model->hdr.n; }
+int32_t df_scorer_embed_dim(const DfScorer* s) { return (int32_t)s->model->hdr.d; }
+int32_t df_scorer_feature_dim(const DfScorer* s) { return (int32_t)s->model->hdr.fp; }
 
 // Score `rounds` independent scheduling rounds of `batch` (child, parent)
 // pairs each in ONE call: child/parent are [rounds*batch] i32, feats is
@@ -244,7 +296,8 @@ int32_t df_scorer_feature_dim(const DfScorer* s) { return (int32_t)s->hdr.fp; }
 int32_t df_scorer_score_rounds(DfScorer* s, const int32_t* child,
                                const int32_t* parent, const float* feats,
                                int32_t rounds, int32_t batch, float* out) {
-  const Header& h = s->hdr;
+  const DfModel* m = s->model;
+  const Header& h = m->hdr;
   const int64_t total64 = (int64_t)rounds * batch;
   if (total64 <= 0 || total64 > (int64_t)1 << 24) return total64 == 0 ? 0 : -2;
   const int32_t R = (int32_t)total64;
@@ -254,58 +307,71 @@ int32_t df_scorer_score_rounds(DfScorer* s, const int32_t* child,
     const int32_t c = child[b], p = parent[b];
     if (c < 0 || p < 0 || (uint32_t)c >= h.n || (uint32_t)p >= h.n) return -1;
   }
+  // Row-TILE the whole three-stage pipeline (128 rows ≈ 72 KB X + 128 KB Y1
+  // scratch): running each stage over the full R first meant ~550 KB of
+  // scratch churn per call — two dispatcher workers' concurrent calls then
+  // fought over the shared cache (measured 1.33x scaling where ALU-bound
+  // work scales 1.93x on this host). Tiled, each worker's hot set stays
+  // private-cache-sized; the extra W1t re-streams per tile are L2 reads.
+  constexpr int32_t kRowTile = 128;
   std::lock_guard<std::mutex> lock(s->mu);
-  s->sx.resize((size_t)R * in1);
-  s->sy1.resize((size_t)R * H1);
-  s->sy2.resize((size_t)R * H2);
-  float* X = s->sx.data();
-  float* Y1 = s->sy1.data();
-  float* Y2 = s->sy2.data();
+  const int32_t tile = std::min<int32_t>(R, kRowTile);
+  s->sx.resize((size_t)tile * in1);
+  s->sy1.resize((size_t)tile * H1);
+  s->sy2.resize((size_t)tile * H2);
   // W1 tail = rows [2D, 3D+FP) — the z_c∘z_p and pair-feature blocks, which
   // are contiguous in the artifact's row-major kernel
-  const float* W1t = s->w1.data() + (size_t)2 * D * h.h1;
+  const float* W1t = m->w1.data() + (size_t)2 * D * h.h1;
 
   int nblk = 1;
 #ifdef _OPENMP
-  nblk = std::min<int>(omp_get_max_threads(), std::max<int32_t>(1, R / 64));
+  nblk = std::min<int>(omp_get_max_threads(), std::max<int32_t>(1, R / kRowTile));
+  if (nblk > 1) {
+    // per-OMP-thread scratch tiles, disjoint slices of the handle's buffers
+    s->sx.resize((size_t)nblk * tile * in1);
+    s->sy1.resize((size_t)nblk * tile * H1);
+    s->sy2.resize((size_t)nblk * tile * H2);
+  }
 #endif
   const int32_t chunk = (R + nblk - 1) / nblk;
 #ifdef _OPENMP
 #pragma omp parallel for schedule(static) num_threads(nblk) if (nblk > 1)
 #endif
   for (int blk = 0; blk < nblk; ++blk) {
-    const int32_t b0 = blk * chunk;
-    const int32_t bn = std::min<int32_t>(R - b0, chunk);
-    if (bn <= 0) continue;
-    // stage 1: build the reduced input rows + preload Y1 with
-    // b1 + uc[child] + up[parent]
-    for (int32_t b = b0; b < b0 + bn; ++b) {
-      float* xb = X + (size_t)b * in1;
-      const float* zc = s->z.data() + (size_t)child[b] * D;
-      const float* zp = s->z.data() + (size_t)parent[b] * D;
-      for (int i = 0; i < D; ++i) xb[i] = zc[i] * zp[i];
-      std::memcpy(xb + D, feats + (size_t)b * FP, FP * sizeof(float));
-      float* yb = Y1 + (size_t)b * H1;
-      const float* ucr = s->uc.data() + (size_t)child[b] * H1;
-      const float* upr = s->up.data() + (size_t)parent[b] * H1;
-      for (int i = 0; i < H1; ++i) yb[i] = s->b1[i] + ucr[i] + upr[i];
-    }
-    float* Xp = X + (size_t)b0 * in1;
-    float* Y1p = Y1 + (size_t)b0 * H1;
-    float* Y2p = Y2 + (size_t)b0 * H2;
-    gemm_acc(Xp, W1t, Y1p, bn, in1, H1);
-    for (size_t i = 0; i < (size_t)bn * H1; ++i) Y1p[i] = gelu(Y1p[i]);
-    for (int32_t b = b0; b < b0 + bn; ++b) {
-      float* yb = Y2 + (size_t)b * H2;
-      std::memcpy(yb, s->b2.data(), H2 * sizeof(float));
-    }
-    gemm_acc(Y1p, s->w2.data(), Y2p, bn, H1, H2);
-    for (size_t i = 0; i < (size_t)bn * H2; ++i) Y2p[i] = gelu(Y2p[i]);
-    for (int32_t b = b0; b < b0 + bn; ++b) {
-      const float* yb = Y2 + (size_t)b * H2;
-      float o = s->b3[0];
-      for (int i = 0; i < H2; ++i) o += yb[i] * s->w3[i];
-      out[b] = sigmoidf(o);
+    const int32_t c0 = blk * chunk;
+    const int32_t cn = std::min<int32_t>(R - c0, chunk);
+    if (cn <= 0) continue;
+    float* X = s->sx.data() + (size_t)blk * tile * in1;
+    float* Y1 = s->sy1.data() + (size_t)blk * tile * H1;
+    float* Y2 = s->sy2.data() + (size_t)blk * tile * H2;
+    for (int32_t t0 = c0; t0 < c0 + cn; t0 += tile) {
+      const int32_t b0 = t0;
+      const int32_t bn = std::min<int32_t>(c0 + cn - t0, tile);
+      // stage 1: build the reduced input rows + preload Y1 with
+      // b1 + uc[child] + up[parent] — scratch rows are tile-local
+      for (int32_t b = b0; b < b0 + bn; ++b) {
+        float* xb = X + (size_t)(b - b0) * in1;
+        const float* zc = m->z.data() + (size_t)child[b] * D;
+        const float* zp = m->z.data() + (size_t)parent[b] * D;
+        for (int i = 0; i < D; ++i) xb[i] = zc[i] * zp[i];
+        std::memcpy(xb + D, feats + (size_t)b * FP, FP * sizeof(float));
+        float* yb = Y1 + (size_t)(b - b0) * H1;
+        const float* ucr = m->uc.data() + (size_t)child[b] * H1;
+        const float* upr = m->up.data() + (size_t)parent[b] * H1;
+        for (int i = 0; i < H1; ++i) yb[i] = m->b1[i] + ucr[i] + upr[i];
+      }
+      gemm_acc(X, W1t, Y1, bn, in1, H1);
+      for (size_t i = 0; i < (size_t)bn * H1; ++i) Y1[i] = gelu(Y1[i]);
+      for (int32_t b = 0; b < bn; ++b)
+        std::memcpy(Y2 + (size_t)b * H2, m->b2.data(), H2 * sizeof(float));
+      gemm_acc(Y1, m->w2.data(), Y2, bn, H1, H2);
+      for (size_t i = 0; i < (size_t)bn * H2; ++i) Y2[i] = gelu(Y2[i]);
+      for (int32_t b = 0; b < bn; ++b) {
+        const float* yb = Y2 + (size_t)b * H2;
+        float o = m->b3[0];
+        for (int i = 0; i < H2; ++i) o += yb[i] * m->w3[i];
+        out[b0 + b] = sigmoidf(o);
+      }
     }
   }
   return 0;

@@ -24,6 +24,10 @@ class SchedulingSection:
     retry_back_to_source_limit: int = cfgfield(5, minimum=0, maximum=100)
     retry_interval: float = cfgfield(0.05, minimum=0.001, maximum=60.0)
     max_tree_depth: int = cfgfield(4, minimum=1, maximum=64)
+    dispatch_workers: int = cfgfield(
+        0, minimum=0, maximum=64,
+        help="round-dispatcher worker threads (0 = serial event-loop rounds)",
+    )
 
 
 @dataclass
@@ -74,6 +78,7 @@ class SchedulerYaml:
             retry_back_to_source_limit=s.retry_back_to_source_limit,
             retry_interval=s.retry_interval,
             max_tree_depth=s.max_tree_depth,
+            dispatch_workers=s.dispatch_workers,
         )
 
     def gc_policy(self):

@@ -51,11 +51,20 @@ def _parent_static_row(p: Peer, h) -> np.ndarray:
     a version (resource.Host.feat_version / Peer.feat_version), so a cached
     row is exact except for ancestor-depth staleness (documented there).
     Child-dependent and round-constant columns are left zero; the caller
-    fills them into the stacked matrix."""
+    fills them into the stacked matrix.
+
+    Thread safety (round dispatcher): the cache is published as ONE
+    (version, row) tuple so concurrent worker threads can never observe a
+    version stamp paired with another version's row; all inputs read here are
+    either scalars published before their version bump (piece_cost_avg_ms),
+    ints/enums (atomic attribute reads), or DAG walks that snapshot under the
+    DAG's own lock (children_of, depth). Racing writers may both compute the
+    row — they compute identical bytes for the same version, so last-write
+    wins harmlessly."""
     ver = (p.feat_version, h.feat_version)
-    if p._feat_row_ver == ver:
-        return p._feat_row
-    costs = p.piece_costs_ms
+    hit_ver, hit_row = p._feat_row
+    if hit_ver == ver:
+        return hit_row
     row = np.array(
         (
             p.finished_piece_ratio(),
@@ -65,7 +74,7 @@ def _parent_static_row(p: Peer, h) -> np.ndarray:
             0.0,  # f4 idc affinity (child-dependent)
             0.0,  # f5 location affinity (child-dependent)
             0.0,  # f6 rtt (child-dependent)
-            (sum(costs) / len(costs) / 30_000.0) if costs else 0.0,
+            p.piece_cost_avg_ms / 30_000.0,
             0.0,  # f8 bandwidth history (child-dependent)
             min(p.depth(), 10) / 10.0,
             0.0,  # f10 child ratio (round constant)
@@ -77,8 +86,7 @@ def _parent_static_row(p: Peer, h) -> np.ndarray:
         ),
         dtype=np.float32,
     )
-    p._feat_row = row
-    p._feat_row_ver = ver
+    p._feat_row = (ver, row)
     return row
 
 
@@ -157,7 +165,14 @@ def build_pair_features(
     one row memcpy: the rtt/bw/affinity recomputes (~2/3 of r05's 129.5 µs
     prepare leg, dominated by statistics.fmean inside avg_rtt_ms) drop out
     entirely. Only the three round-constant columns (10/11/13) are written
-    per call — onto the stacked COPY, so cached rows stay pristine."""
+    per call — onto the stacked COPY, so cached rows stay pristine.
+
+    Safe under the concurrent round dispatcher WITHOUT the scheduler's state
+    lock: cache entries are immutable (key, row) tuples published in one
+    store; version sources bump AFTER their value writes (see
+    BandwidthHistory.observe), so reading the key before the values can at
+    worst cache a NEWER value under an older key — one extra rebuild on the
+    next probe, never a stuck-stale row."""
     n = len(parents)
     if n == 0:
         return np.zeros((0, FEATURE_DIM), dtype=np.float32)
@@ -218,6 +233,15 @@ class Evaluator:
         feats = self.feature_builder(child, parents, self.topology, self.bandwidth)
         return feats @ BASE_WEIGHTS
 
+    def evaluate_many(
+        self, rounds: Sequence[tuple[Peer, Sequence[Peer]]]
+    ) -> list[np.ndarray]:
+        """Score a BATCH of independent rounds in one call — the round
+        dispatcher's worker-side entry. The base evaluator has no FFI hop to
+        amortize, so this is the per-round loop; MLEvaluator overrides it to
+        cross the native FFI once per batch (score_rounds)."""
+        return [self.evaluate(c, ps) for c, ps in rounds]
+
     async def evaluate_async(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:
         """Async scoring entry: the base evaluator is pure numpy, so this is
         just the sync path; MLEvaluator overrides it to await the micro-batched
@@ -260,6 +284,7 @@ class MLEvaluator(Evaluator):
         self._scorer = scorer
         self._node_index = node_index or {}
         self._microbatch = None
+        self._handle_pool = None  # native.ScorerHandlePool when sharded serving is on
         self.refreshed_at: float | None = None
         self._set_serving_mode(self._mode_of(scorer) if scorer is not None else "base")
 
@@ -294,7 +319,9 @@ class MLEvaluator(Evaluator):
 
         metrics.ML_BASE_FALLBACK_TOTAL.inc(reason=reason)
 
-    def attach_scorer(self, scorer, node_index: dict[str, int], *, microbatch=None) -> None:
+    def attach_scorer(
+        self, scorer, node_index: dict[str, int], *, microbatch=None, handle_pool=None
+    ) -> None:
         """Hot-swap the model (called when the trainer publishes a version);
         until then evaluate() serves the base fallback.
 
@@ -302,6 +329,12 @@ class MLEvaluator(Evaluator):
         set, evaluate_async coalesces concurrent scheduling rounds into one
         multi-round FFI call (the 10k-calls/s serving path); the sync
         evaluate() keeps calling `scorer` directly.
+
+        handle_pool: optional native.ScorerHandlePool over `scorer` — when
+        set, the sync evaluate() scores through the CALLING THREAD's own
+        native handle (scorer.cc: one handle per thread, a shared handle
+        serializes on an internal mutex), which is what lets the round
+        dispatcher's workers overlap their FFI legs across cores.
         """
         import time
 
@@ -310,6 +343,7 @@ class MLEvaluator(Evaluator):
         self._scorer = scorer
         self._node_index = node_index
         self._microbatch = microbatch
+        self._handle_pool = handle_pool
         self.refreshed_at = time.time()
         metrics.ML_EMBEDDINGS_REFRESH_TIMESTAMP.set(self.refreshed_at)
         self._set_serving_mode(self._mode_of(scorer))
@@ -363,8 +397,12 @@ class MLEvaluator(Evaluator):
         if c is None:
             self._count_fallback("unknown_hosts")
             return self._base_from(feats)
+        # Per-thread handle when a pool is attached: dispatcher workers each
+        # score on their own native handle (the pool hands the constructing
+        # thread the primary, so the serial path is byte-for-byte unchanged).
+        scorer = self._scorer if self._handle_pool is None else self._handle_pool.get()
         try:
-            ml = self._scorer.score(feats, child=c, parent=p)
+            ml = scorer.score(feats, child=c, parent=p)
         except Exception:
             logger.exception("ml scorer failed; using base evaluator")
             self._count_fallback("scorer_error")
@@ -372,6 +410,76 @@ class MLEvaluator(Evaluator):
         if known is None:
             return np.asarray(ml, dtype=np.float32)
         return np.where(known, ml, self._base_from(feats)).astype(np.float32)
+
+    def evaluate_many(
+        self, rounds: Sequence[tuple[Peer, Sequence[Peer]]]
+    ) -> list[np.ndarray]:
+        """Batch entry for the round dispatcher's workers: every round's
+        features are assembled here (GIL-held numpy), then ALL scorable
+        rounds cross the FFI in ONE score_rounds call on the calling
+        thread's own handle — the per-round wrapper overhead (array
+        conversions, ctypes marshalling) that kept the single-round call
+        GIL-bound is paid once per batch, and the GEMM leg (GIL released)
+        is wide enough to genuinely overlap another worker's Python.
+
+        Fallback semantics per round match evaluate(): unknown hosts or a
+        scorer failure degrade that round to the base score, never the
+        batch."""
+        if not getattr(self._scorer, "ready", False):
+            return [self.evaluate(c, ps) for c, ps in rounds]
+        outs: list[np.ndarray | None] = [None] * len(rounds)
+        prepared = []
+        for i, (child, parents) in enumerate(rounds):
+            if not parents:
+                outs[i] = np.zeros(0, dtype=np.float32)
+                continue
+            feats, c, p, known = self._prepare(child, parents)
+            if c is None:
+                self._count_fallback("unknown_hosts")
+                outs[i] = self._base_from(feats)
+            else:
+                prepared.append((i, feats, c, p, known))
+        if not prepared:
+            return outs
+        scorer = self._scorer if self._handle_pool is None else self._handle_pool.get()
+        if len(prepared) == 1 or not hasattr(scorer, "score_rounds"):
+            single = True
+        else:
+            single = False
+            widths = [len(c) for _i, _f, c, _p, _k in prepared]
+            B = max(widths)
+            M = len(prepared)
+            fp = prepared[0][1].shape[1]
+            mf = np.zeros((M, B, fp), np.float32)
+            mc = np.zeros((M, B), np.int32)
+            mp = np.zeros((M, B), np.int32)
+            for m, (_i, f, c, p, _k) in enumerate(prepared):
+                mf[m, : widths[m]] = f
+                mc[m, : widths[m]] = c
+                mp[m, : widths[m]] = p
+            try:
+                ml_rounds = scorer.score_rounds(mf, child=mc, parent=mp)
+            except Exception:
+                # one bad round (stale node index) rejects the flat batch —
+                # retry per round below so the culprit degrades alone
+                logger.exception("batched ml scoring failed; retrying per round")
+                single = True
+        for m, (i, f, c, p, known) in enumerate(prepared):
+            if single:
+                try:
+                    ml = scorer.score(f, child=c, parent=p)
+                except Exception:
+                    logger.exception("ml scorer failed; using base evaluator")
+                    self._count_fallback("scorer_error")
+                    outs[i] = self._base_from(f)
+                    continue
+            else:
+                ml = ml_rounds[m, : len(c)]
+            if known is None:
+                outs[i] = np.asarray(ml, dtype=np.float32)
+            else:
+                outs[i] = np.where(known, ml, self._base_from(f)).astype(np.float32)
+        return outs
 
     async def evaluate_async(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:
         """Micro-batched scoring: concurrent rounds on the event loop land in

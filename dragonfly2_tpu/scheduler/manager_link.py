@@ -271,17 +271,28 @@ class ManagerLink:
             return
         # Native scorers get the micro-batching facade: concurrent scheduling
         # rounds on the service loop coalesce into one multi-round FFI call
-        # (native/microbatch.py) instead of crossing ctypes per round.
+        # (native/microbatch.py) instead of crossing ctypes per round. When
+        # the sharded round dispatcher is serving, they ALSO get a handle
+        # pool: dispatcher workers score on per-thread forked handles
+        # (scorer.cc's one-handle-per-thread rule; a shared handle would
+        # serialize the workers on its internal mutex).
         microbatch = None
+        handle_pool = None
         if hasattr(scorer, "score_rounds"):
-            from dragonfly2_tpu.native import MicroBatchScorer
+            from dragonfly2_tpu.native import MicroBatchScorer, ScorerHandlePool
 
             microbatch = MicroBatchScorer(scorer)
-        self.service.evaluator.attach_scorer(scorer, node_index, microbatch=microbatch)
+            if getattr(self.service.scheduling, "dispatcher", None) is not None \
+                    and hasattr(scorer, "fork"):
+                handle_pool = ScorerHandlePool(scorer)
+        self.service.evaluator.attach_scorer(
+            scorer, node_index, microbatch=microbatch, handle_pool=handle_pool
+        )
         self._active_model_version = row["version"]
         logger.info(
-            "ml evaluator upgraded to model %s (%d hosts, microbatch=%s)",
+            "ml evaluator upgraded to model %s (%d hosts, microbatch=%s, handle_pool=%s)",
             row["version"], len(node_index), microbatch is not None,
+            handle_pool is not None,
         )
 
     @staticmethod

@@ -48,6 +48,18 @@ PEER_SUPERSEDED_TOTAL = _r.counter(
 DOWNLOAD_TRAFFIC_BYTES = _r.counter(
     "download_traffic_bytes_total", "Bytes reported via piece results", subsystem="scheduler"
 )
+# Sharded round dispatcher (ISSUE 7): worker-thread count and rounds whose
+# find leg ran off-loop — dispatched/total-schedule ratio says whether the
+# multi-core path is actually serving.
+DISPATCH_WORKERS = _r.gauge(
+    "dispatch_workers", "Round-dispatcher worker threads (0 = serial loop)",
+    subsystem="scheduler",
+)
+DISPATCHED_ROUNDS_TOTAL = _r.counter(
+    "dispatched_rounds_total",
+    "Scheduling find rounds sharded onto dispatcher worker threads",
+    subsystem="scheduler",
+)
 PEERS_GAUGE = _r.gauge("peers", "Live peers in the resource pool", subsystem="scheduler")
 TASKS_GAUGE = _r.gauge("tasks", "Live tasks in the resource pool", subsystem="scheduler")
 HOSTS_GAUGE = _r.gauge("hosts", "Live hosts in the resource pool", subsystem="scheduler")

@@ -60,6 +60,13 @@ class EdgeProbes:
         self.min_ms = 0.0
 
     def enqueue(self, rtt_ms: float) -> None:
+        # Mutator-side only (the scheduler's probe ingest); concurrent
+        # READERS (round-dispatcher workers assembling features) touch
+        # nothing but the published scalar stats below — never the deque, so
+        # an in-flight append can't blow up their iteration. Each stat is one
+        # atomic attribute publish; they are written before the caller bumps
+        # pair_version (NetworkTopology.enqueue), so a reader that sees the
+        # new version sees the new stats.
         self.rtts_ms.append(rtt_ms)
         self.probed_count += 1
         self.updated_at = time.time()
@@ -117,6 +124,9 @@ class NetworkTopology:
         edge = self._edges.get(key)
         if edge is None:
             edge = self._edges[key] = EdgeProbes(self.queue_length)
+        # stats first, version bumps second (see BandwidthHistory.observe for
+        # the reader-safe ordering contract the evaluator's pair-row cache
+        # depends on under the concurrent round dispatcher)
         edge.enqueue(rtt_ms)
         self.version += 1
         self._bump_pair(src_host_id, dst_host_id)

@@ -164,6 +164,11 @@ class Peer:
         self.fsm = FSM(PEER_PENDING, _PEER_EVENTS)
         self.finished_pieces = Bitset()
         self.piece_costs_ms: deque[float] = deque(maxlen=20)
+        # Rolling mean over piece_costs_ms, published as ONE scalar at append
+        # time (EdgeProbes.enqueue idiom): the round dispatcher's worker
+        # threads read it during lock-free feature assembly, where iterating
+        # the deque itself would race a concurrent append (RuntimeError).
+        self.piece_cost_avg_ms = 0.0
         self.block_parents: set[str] = set()
         self.range = None
         self.schedule_rounds = 0
@@ -173,8 +178,12 @@ class Peer:
         # is a soft scoring signal, and the cache is what keeps feature
         # assembly inside the serving budget
         self.feat_version = 0
-        self._feat_row = None  # evaluator-owned cached row (np.ndarray)
-        self._feat_row_ver = (-1, -1)
+        # evaluator-owned cached static row, published as ONE (version, row)
+        # tuple: worker threads assembling features concurrently must see a
+        # version WITH its matching row — two separate attributes could tear
+        # between a reader and two racing writers (row from one version,
+        # version stamp from another)
+        self._feat_row = ((-1, -1), None)
         # evaluator-owned per-child-host FULL pair rows (static + idc/loc/
         # rtt/bw columns), keyed child_host_id -> (version_key, row); the
         # version key spans this peer, both hosts, and the topology/bandwidth
@@ -207,6 +216,10 @@ class Peer:
 
     def add_piece_cost(self, ms: float) -> None:
         self.piece_costs_ms.append(ms)
+        # value first, version bump second: a concurrent reader that observes
+        # the new feat_version must also observe the new average (the reverse
+        # order could cache a stale mean under the new version key forever)
+        self.piece_cost_avg_ms = sum(self.piece_costs_ms) / len(self.piece_costs_ms)
         self.bump_feat()
         self.touch()
 
@@ -347,18 +360,19 @@ class Task:
             pass
 
     def parents_of(self, peer_id: str) -> list[Peer]:
+        # snapshotted under the DAG's own lock: dispatcher worker threads
+        # walk ancestry (depth(), lineage context) while the event loop
+        # commits/retires edges
         try:
-            v = self.dag.vertex(peer_id)
+            return self.dag.parent_values(peer_id)
         except VertexNotFound:
             return []
-        return [self.dag.vertex(p).value for p in v.parents]
 
     def children_of(self, peer_id: str) -> list[Peer]:
         try:
-            v = self.dag.vertex(peer_id)
+            return self.dag.child_values(peer_id)
         except VertexNotFound:
             return []
-        return [self.dag.vertex(c).value for c in v.children]
 
     def has_available_peer(self, blocklist: set[str] = frozenset()) -> bool:
         return any(

@@ -18,8 +18,12 @@ are pure functions over the resource model so they unit-test without mocks.
 
 from __future__ import annotations
 
+import asyncio
 import logging
+import os
 import random
+import threading
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -37,6 +41,19 @@ from dragonfly2_tpu.utils.dag import DAGError
 logger = logging.getLogger(__name__)
 
 
+def usable_cpu_count() -> int:
+    """CPUs this process may actually run on: the scheduling affinity mask
+    when the platform exposes one (cgroup-pinned containers report the real
+    grant here while os.cpu_count() reports the machine), else
+    os.cpu_count(). Shared by the dispatcher's worker sizing and the bench's
+    ceiling accounting (ISSUE 7: the r05 capture recorded host_cpu_count 1
+    on a 2-core box)."""
+    try:
+        return len(os.sched_getaffinity(0)) or (os.cpu_count() or 1)
+    except (AttributeError, OSError):  # non-Linux / restricted platforms
+        return os.cpu_count() or 1
+
+
 @dataclass
 class SchedulingConfig:
     """Reference defaults (scheduler/config/constants.go:36-79)."""
@@ -47,6 +64,13 @@ class SchedulingConfig:
     retry_back_to_source_limit: int = 5
     retry_interval: float = 0.05
     max_tree_depth: int = 4
+    # Round-dispatcher worker threads sharding concurrent scheduling calls
+    # across cores (0 = serial: every round runs on the event loop, scoring
+    # coalesced by the micro-batcher — the pre-PR-7 shape). Workers overlap
+    # the GIL-releasing legs (native FFI scoring via per-thread handles,
+    # numpy feature assembly); the mutating apply step stays serialized
+    # under the scheduler state lock either way.
+    dispatch_workers: int = 0
 
 
 @dataclass
@@ -72,6 +96,34 @@ class Scheduling:
             jitter=0.3,
             rng=random.Random(0),
         )
+        # Scheduler state lock (the dispatcher's "narrow lock"): serializes
+        # [candidate sampling + filtering] on worker threads with every
+        # control-plane MUTATION (piece-result apply, peer/host lifecycle,
+        # probe ingest, edge commits — SchedulerService holds it around each
+        # mutating block). Feature assembly and scoring run OUTSIDE it on
+        # version-keyed atomic snapshots. RLock: service mutators nest
+        # (report_peer_result → delete_parents) and the SMALL-scope path
+        # filters inside an already-locked register. With no dispatcher
+        # attached everything runs on the event loop and the uncontended
+        # acquire is noise (~100 ns).
+        self.state_lock = threading.RLock()
+        self.dispatcher: RoundDispatcher | None = None
+        if self.config.dispatch_workers > 0:
+            self.attach_dispatcher(self.config.dispatch_workers)
+
+    def attach_dispatcher(self, workers: int | None = None) -> "RoundDispatcher":
+        """Enable sharded rounds: schedule_candidate_parents' find leg runs
+        on `workers` threads (default: the usable CPU count). Idempotent-ish:
+        replaces any previous dispatcher (shutting it down)."""
+        if self.dispatcher is not None:
+            self.dispatcher.shutdown()
+        self.dispatcher = RoundDispatcher(self, workers=workers)
+        return self.dispatcher
+
+    def close(self) -> None:
+        if self.dispatcher is not None:
+            self.dispatcher.shutdown()
+            self.dispatcher = None
 
     # ---- filters (ref filterCandidateParents' 8 conditions) ----
     #
@@ -140,11 +192,43 @@ class Scheduling:
     def find_candidate_parents(
         self, child: Peer, blocklist: set[str] = frozenset()
     ) -> list[Peer]:
-        """One filtering+scoring round: sample ≤40, filter, score, top-4."""
-        candidates = self._sample_candidates(child, blocklist)
+        """One filtering+scoring round: sample ≤40, filter, score, top-4.
+
+        This IS the unit of work a dispatcher worker runs (_find_round_sync
+        is an alias): sample+filter under the state lock — they read peer
+        sets/deques that service mutators change — then feature assembly and
+        scoring OUTSIDE the lock, where the FFI/numpy legs drop the GIL and
+        overlap across workers. Serial callers run the identical code path,
+        which is what makes the sharded/serial equivalence exact."""
+        with self.state_lock:
+            candidates = self._sample_candidates(child, blocklist)
         if not candidates:
             return []
         return self._top_parents(child, candidates, self.evaluator.evaluate(child, candidates))
+
+    def find_candidate_parents_batch(
+        self, reqs: list[tuple[Peer, set[str]]]
+    ) -> list[list[Peer]]:
+        """A batch of find rounds in one call — the dispatcher's worker-side
+        unit. Sampling+filtering lock per round (short holds, so the event
+        loop's mutators interleave); every round with surviving candidates
+        then rides ONE evaluator batch (MLEvaluator.evaluate_many = one FFI
+        crossing per batch). Equivalent to calling find_candidate_parents
+        per round in order — same rng draws, same filters, same scores."""
+        sampled = []
+        for child, blocklist in reqs:
+            with self.state_lock:
+                sampled.append((child, self._sample_candidates(child, blocklist)))
+        outs: list[list[Peer]] = [[] for _ in reqs]
+        scorable = [i for i, (_c, cands) in enumerate(sampled) if cands]
+        if scorable:
+            scores = self.evaluator.evaluate_many(
+                [(sampled[i][0], sampled[i][1]) for i in scorable]
+            )
+            for i, s in zip(scorable, scores):
+                child, cands = sampled[i]
+                outs[i] = self._top_parents(child, cands, s)
+        return outs
 
     async def find_candidate_parents_async(
         self, child: Peer, blocklist: set[str] = frozenset()
@@ -152,8 +236,10 @@ class Scheduling:
         """Async variant of find_candidate_parents: scoring awaits the
         evaluator's async entry, so concurrent scheduling rounds coalesce in
         the native scorer's micro-batcher instead of crossing the FFI one by
-        one (MLEvaluator.evaluate_async)."""
-        candidates = self._sample_candidates(child, blocklist)
+        one (MLEvaluator.evaluate_async). The serial counterpart of the
+        dispatcher path — used when no dispatcher is attached."""
+        with self.state_lock:
+            candidates = self._sample_candidates(child, blocklist)
         if not candidates:
             return []
         scores = await self.evaluator.evaluate_async(child, candidates)
@@ -164,14 +250,15 @@ class Scheduling:
         Shares the flattened predicate with the NORMAL path plus the explicit
         can_add_edge check the sampler omits (see _passes)."""
         task = child.task
-        ctx = self._filter_ctx(child, set(blocklist))
-        done = [
-            p
-            for p in task.peers()
-            if p.fsm.is_(PEER_SUCCEEDED)
-            and self._passes(p, ctx)
-            and task.can_add_edge(p.id, child.id)
-        ]
+        with self.state_lock:  # filter reads racing worker-visible mutations
+            ctx = self._filter_ctx(child, set(blocklist))
+            done = [
+                p
+                for p in task.peers()
+                if p.fsm.is_(PEER_SUCCEEDED)
+                and self._passes(p, ctx)
+                and task.can_add_edge(p.id, child.id)
+            ]
         if not done:
             return None
         scores = np.asarray(self.evaluator.evaluate(child, done))
@@ -188,24 +275,31 @@ class Scheduling:
             if attempt >= cfg.retry_back_to_source_limit and child.task.can_back_to_source():
                 child.fsm.fire("back_to_source")
                 return ScheduleOutcome(back_to_source=True, rounds=attempt)
-            parents = await self.find_candidate_parents_async(child, blocklist)
+            if self.dispatcher is not None:
+                parents = await self.dispatcher.find(child, blocklist)
+            else:
+                parents = await self.find_candidate_parents_async(child, blocklist)
             if parents:
                 # The await above suspended between filtering and commit, so a
                 # concurrent round may have consumed upload slots or added
-                # edges that invalidate these candidates (the coalescing path
-                # makes this overlap the COMMON case). Re-validate at commit:
-                # stale candidates are skipped, a CycleError round retries.
+                # edges that invalidate these candidates (the coalescing and
+                # dispatcher paths both make this overlap the COMMON case).
+                # Re-validate at commit: stale candidates are skipped, a
+                # CycleError round retries. The whole apply is one state-lock
+                # critical section — a dispatcher worker mid-filter sees
+                # either none or all of this round's edges, never half.
                 task = child.task
-                task.delete_parents(child.id)
                 committed = []
-                for p in parents:
-                    if p.host.free_upload_slots <= 0:
-                        continue
-                    try:
-                        task.add_edge(p.id, child.id)
-                    except DAGError:
-                        continue  # raced into a cycle/duplicate; skip
-                    committed.append(p)
+                with self.state_lock:
+                    task.delete_parents(child.id)
+                    for p in parents:
+                        if p.host.free_upload_slots <= 0:
+                            continue
+                        try:
+                            task.add_edge(p.id, child.id)
+                        except DAGError:
+                            continue  # raced into a cycle/duplicate; skip
+                        committed.append(p)
                 if committed:
                     child.schedule_rounds += 1
                     return ScheduleOutcome(parents=committed, rounds=attempt + 1)
@@ -215,3 +309,173 @@ class Scheduling:
             child.fsm.fire("back_to_source")
             return ScheduleOutcome(back_to_source=True, rounds=cfg.retry_limit)
         return ScheduleOutcome(rounds=cfg.retry_limit)
+
+
+class RoundDispatcher:
+    """Thread-pool round dispatcher: shards concurrent scheduling rounds
+    across cores (ISSUE 7 tentpole; ROADMAP open item #1).
+
+    The single-loop serving path tops out at the single-core Python ceiling
+    (BENCH_r05: 12.2k raw FFI calls/s vs 4.7k end-to-end rounds/s at
+    ceiling fraction 1.045): every round's feature assembly and glue runs on
+    the event loop, so adding cores adds nothing. Podracer (arxiv 2104.06272)
+    makes the same move decoupling a sequential control loop into sharded
+    workers that keep the accelerator-side scoring saturated — here each
+    worker thread runs whole find rounds (sample → filter → assemble →
+    score → top-k):
+
+      - sample+filter hold Scheduling.state_lock (they read peer sets/
+        deques the service mutates), a few tens of µs per round;
+      - feature assembly + scoring run lock-free — ctypes FFI calls release
+        the GIL outright (per-thread native handles via ScorerHandlePool; a
+        shared handle would re-serialize on scorer.cc's internal mutex), so
+        one worker's GEMMs run under another worker's Python;
+      - the mutating apply (DAG edges, peer state, metrics) never runs
+        here: schedule_candidate_parents commits on the event loop under
+        the same state lock, keeping scheduling semantics bit-identical to
+        the serial path (pinned by tests/test_dispatch.py equivalence).
+
+    Dispatch granularity is a BATCH, not a round: a per-round
+    run_in_executor hop costs two thread wakeups + a loop callback, which
+    measured ~40% of the round at these rates (same lesson as PR 3's
+    per-chunk executor hops — bind workers to WORK, not to items). Rounds
+    queue on the loop; each free worker takes the whole backlog up to
+    queue_cap (1 under no load — no latency floor; growing with arrival
+    rate under load, exactly the micro-batcher's self-adjusting shape) and
+    resolves each round's future via call_soon_threadsafe as it finishes.
+    Queue/slot state is mutated ONLY on the event loop.
+
+    Worker threads are created once and live with the dispatcher — never
+    per round (dflint DF026 exists to keep it that way).
+    """
+
+    def __init__(
+        self, scheduling: Scheduling, *, workers: int | None = None,
+        queue_cap: int = 32,
+    ):
+        from dragonfly2_tpu.scheduler import metrics
+
+        self.scheduling = scheduling
+        self.workers = workers if workers and workers > 0 else usable_cpu_count()
+        self.queue_cap = queue_cap
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.workers, thread_name_prefix="df-round"
+        )
+        self._pending: list[tuple] = []  # (kind, args, future) — loop-owned
+        # submitted-but-maybe-not-started batches, keyed by their executor
+        # future: shutdown(cancel_futures=True) silently cancels QUEUED work
+        # items, and a cancelled _run_batch never resolves its rounds'
+        # asyncio futures — without this map those awaits would hang forever
+        self._inflight: dict = {}
+        self._free = self.workers
+        self._closed = False
+        self.rounds = 0  # rounds dispatched (observability/bench)
+        self.batches = 0  # worker submissions (rounds/batches = amortization)
+        metrics.DISPATCH_WORKERS.set(float(self.workers))
+
+    _KIND_FIND = 0
+    _KIND_EVAL = 1
+
+    async def find(self, child: Peer, blocklist: set[str] = frozenset()) -> list[Peer]:
+        """One find round on a worker thread; returns the top candidates
+        (uncommitted — the caller commits on the loop)."""
+        from dragonfly2_tpu.scheduler import metrics
+
+        metrics.DISPATCHED_ROUNDS_TOTAL.inc()
+        return await self._submit(self._KIND_FIND, (child, blocklist))
+
+    async def evaluate(self, child: Peer, parents: list[Peer]):
+        """Score a fixed candidate set on a worker thread (the bench's
+        eval-leg probe — same assembly+FFI path find() runs, minus the
+        sample/filter leg)."""
+        return await self._submit(self._KIND_EVAL, (child, parents))
+
+    def _submit(self, kind, args) -> "asyncio.Future":
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        if self._closed:
+            fut.set_exception(RuntimeError("round dispatcher is shut down"))
+            return fut
+        self.rounds += 1
+        self._pending.append((kind, args, fut))
+        self._maybe_dispatch(loop)
+        return fut
+
+    def _maybe_dispatch(self, loop) -> None:
+        while self._free > 0 and self._pending:
+            # Split the backlog relative to the TOTAL worker count: dividing
+            # by the currently-free count hands the whole queue to whichever
+            # worker frees first (workers free one at a time), re-serializing
+            # the very rounds the pool exists to overlap. ceil(pending/workers)
+            # leaves proportionate shares for the workers about to free.
+            n = -(-len(self._pending) // self.workers)
+            batch = self._pending[: min(n, self.queue_cap)]
+            del self._pending[: len(batch)]
+            self._free -= 1
+            self.batches += 1
+            cf = self._pool.submit(self._run_batch, loop, batch)
+            self._inflight[cf] = batch
+            cf.add_done_callback(lambda f: self._inflight.pop(f, None))
+
+    def _run_batch(self, loop, batch) -> None:
+        """Worker-side: run the batch's find/eval jobs grouped per kind (the
+        find group shares one evaluator FFI crossing, see
+        find_candidate_parents_batch), then resolve every future and free
+        the worker slot in ONE loop callback — per-round
+        call_soon_threadsafe wakeups measured ~40% of a dispatched round."""
+        out: list = [None] * len(batch)
+        errs: list = [None] * len(batch)
+        for kind, runner in (
+            (self._KIND_FIND, self.scheduling.find_candidate_parents_batch),
+            (self._KIND_EVAL, self.scheduling.evaluator.evaluate_many),
+        ):
+            group = [(i, args) for i, (k, args, _f) in enumerate(batch) if k == kind]
+            if not group:
+                continue
+            try:
+                results = runner([args for _i, args in group])
+                for (i, _args), r in zip(group, results):
+                    out[i] = r
+            except BaseException as e:  # noqa: BLE001 — delivered to the awaiting rounds
+                for i, _args in group:
+                    errs[i] = e
+        loop.call_soon_threadsafe(
+            self._finish_batch, loop,
+            [(fut, out[i], errs[i]) for i, (_k, _a, fut) in enumerate(batch)],
+        )
+
+    def _finish_batch(self, loop, triples) -> None:
+        for fut, result, err in triples:
+            if fut.cancelled():
+                continue
+            if err is not None:
+                fut.set_exception(err)
+            else:
+                fut.set_result(result)
+        self._free += 1
+        if not self._closed:
+            self._maybe_dispatch(loop)
+
+    def shutdown(self) -> None:
+        """Tear down the worker pool. Must run on the event-loop thread
+        (every call site does — service.close, attach_dispatcher, bench
+        teardown): it cancels the asyncio futures of rounds that will never
+        run, which is only legal loop-side."""
+        self._closed = True
+        for _kind, _args, fut in self._pending:
+            if not fut.done():
+                fut.cancel()
+        self._pending.clear()
+        # snapshot BEFORE shutdown: cancel_futures fires the executor
+        # futures' done callbacks inline, which pops _inflight
+        inflight = list(self._inflight.items())
+        # cancel_futures: queued (never-started) batches are dropped by the
+        # executor (3.9+ kwarg; this image is 3.10) — their rounds' asyncio
+        # futures are cancelled below so no await strands; batches already
+        # RUNNING complete and resolve their rounds via the loop callback.
+        self._pool.shutdown(wait=False, cancel_futures=True)
+        for cf, batch in inflight:
+            if cf.cancelled():
+                for _kind, _args, fut in batch:
+                    if not fut.done():
+                        fut.cancel()

@@ -112,12 +112,16 @@ async def run_scheduler(
         if telemetry:
             telemetry.flush()
         await server.stop()
+        service.close()  # dispatcher worker threads (no-op in serial mode)
 
 
 def _sweep(service: SchedulerService) -> None:
     from dragonfly2_tpu.scheduler import metrics
 
-    removed = service.pool.gc()
+    # under the scheduler state lock: the TTL sweep deletes peers/edges the
+    # round dispatcher's workers may be sampling/filtering right now
+    with service.state_lock:
+        removed = service.pool.gc()
     metrics.PEERS_GAUGE.set(service.pool.peer_count())
     metrics.TASKS_GAUGE.set(len(service.pool.tasks))
     metrics.HOSTS_GAUGE.set(len(service.pool.hosts))

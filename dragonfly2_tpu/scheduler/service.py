@@ -104,6 +104,12 @@ class SchedulerService:
         self.pool = ResourcePool(gc_policy)
         self.evaluator = evaluator or new_evaluator("base")
         self.scheduling = Scheduling(self.evaluator, scheduling_config)
+        # Scheduler state lock (see Scheduling.state_lock): every mutator
+        # below holds it around its mutating block so the round dispatcher's
+        # worker threads (sample+filter) see consistent peer state. With no
+        # dispatcher configured (the default) every acquire is uncontended
+        # loop-side noise. NEVER held across an await.
+        self.state_lock = self.scheduling.state_lock
         self.telemetry = telemetry
         self.topology = NetworkTopology(telemetry=telemetry)
         self.evaluator.topology = self.topology  # rtt_norm feature source
@@ -115,6 +121,10 @@ class SchedulerService:
         self.evaluator.bandwidth = self.bandwidth
         self.seed_trigger = seed_trigger
         self._seed_triggered: set[str] = set()
+
+    def close(self) -> None:
+        """Release dispatcher worker threads (no-op in serial mode)."""
+        self.scheduling.close()
 
     # ---- registration (ref handleRegisterPeerRequest → schedule()) ----
 
@@ -141,28 +151,29 @@ class SchedulerService:
     async def register_peer(
         self, peer_id: str, meta: TaskMeta, host_info: HostInfo
     ) -> RegisterResult:
-        host = self.pool.load_or_create_host(
-            host_info.id,
-            host_info.ip,
-            host_info.hostname,
-            port=host_info.port,
-            download_port=host_info.download_port,
-            host_type=HostType(host_info.type),
-            idc=host_info.idc,
-            location=host_info.location,
-        )
-        task = self.pool.load_or_create_task(
-            meta.task_id,
-            meta.url,
-            digest=meta.digest,
-            tag=meta.tag,
-            application=meta.application,
-            filters=tuple(meta.filters),
-        )
-        self._supersede_host_peers(task, host.id, peer_id)
-        peer = self.pool.create_peer(peer_id, task, host)
-        if task.fsm.can("download"):
-            task.fsm.fire("download")
+        with self.state_lock:
+            host = self.pool.load_or_create_host(
+                host_info.id,
+                host_info.ip,
+                host_info.hostname,
+                port=host_info.port,
+                download_port=host_info.download_port,
+                host_type=HostType(host_info.type),
+                idc=host_info.idc,
+                location=host_info.location,
+            )
+            task = self.pool.load_or_create_task(
+                meta.task_id,
+                meta.url,
+                digest=meta.digest,
+                tag=meta.tag,
+                application=meta.application,
+                filters=tuple(meta.filters),
+            )
+            self._supersede_host_peers(task, host.id, peer_id)
+            peer = self.pool.create_peer(peer_id, task, host)
+            if task.fsm.can("download"):
+                task.fsm.fire("download")
 
         def ensure_received() -> None:
             # Idempotent for RPC retries: a reused peer may already be past
@@ -224,7 +235,8 @@ class SchedulerService:
             parent = self.scheduling.find_success_parent(peer)
             if parent is not None:
                 ensure_received()
-                task.add_edge(parent.id, peer.id)
+                with self.state_lock:
+                    task.add_edge(parent.id, peer.id)
                 return RegisterResult(
                     scope=scope.value, parents=[ParentInfo.of(parent)], **common
                 )
@@ -269,11 +281,12 @@ class SchedulerService:
         task = self.pool.tasks.get(task_id)
         if task is None:
             return
-        task.set_metadata(content_length, piece_size)
-        if digest:
-            task.digest = digest
-        if direct_piece:
-            task.direct_piece = direct_piece
+        with self.state_lock:
+            task.set_metadata(content_length, piece_size)
+            if digest:
+                task.digest = digest
+            if direct_piece:
+                task.direct_piece = direct_piece
 
     # ---- piece + peer results (ref handleDownloadPiece*Request) ----
 
@@ -323,16 +336,17 @@ class SchedulerService:
         if peer is None:
             return
         peer.touch()
-        if success:
-            self._apply_piece_success(peer, piece_index, cost_ms, parent_id, dedupe=False)
-            return
-        metrics.PIECE_RESULT_TOTAL.inc(success="false")
-        if parent_id:
-            parent = self.pool.peer(parent_id)
-            if parent is not None:
-                parent.host.upload_failed_count += 1
-                parent.host.bump_feat()
-            peer.block_parents.add(parent_id)
+        with self.state_lock:
+            if success:
+                self._apply_piece_success(peer, piece_index, cost_ms, parent_id, dedupe=False)
+                return
+            metrics.PIECE_RESULT_TOTAL.inc(success="false")
+            if parent_id:
+                parent = self.pool.peer(parent_id)
+                if parent is not None:
+                    parent.host.upload_failed_count += 1
+                    parent.host.bump_feat()
+                peer.block_parents.add(parent_id)
 
     def announce_task(
         self,
@@ -356,45 +370,46 @@ class SchedulerService:
         announce supersedes any ghost peer rows its host left behind
         (host crashed without leave_host): the durable on-disk state it
         claims IS the host's state for this task."""
-        host = self.pool.load_or_create_host(
-            host_info.id, host_info.ip, host_info.hostname,
-            port=host_info.port, download_port=host_info.download_port,
-            host_type=HostType(host_info.type), idc=host_info.idc,
-            location=host_info.location,
-        )
-        # ports move across restarts; the announce carries the live ones
-        if host_info.port:
-            host.port = host_info.port
-        if host_info.download_port and host.download_port != host_info.download_port:
-            host.download_port = host_info.download_port
-            host.bump_feat()
-        task = self.pool.load_or_create_task(
-            meta.task_id, meta.url, digest=meta.digest or digest,
-            tag=meta.tag, application=meta.application, filters=tuple(meta.filters),
-        )
-        task.set_metadata(content_length, piece_size)
-        if digest:
-            task.digest = digest
-        if task.fsm.can("download"):
-            task.fsm.fire("download")
-        self._supersede_host_peers(task, host.id, peer_id)
-        peer = self.pool.create_peer(peer_id, task, host)
-        for ev in ("register", "download"):
-            if peer.fsm.can(ev):
-                peer.fsm.fire(ev)
-        for idx in piece_indices:
-            peer.finished_pieces.set(idx)
-        peer.bump_feat()
-        total = task.total_pieces or 0
-        complete = (
-            (total > 0 and peer.finished_pieces.count() >= total)
-            or content_length == 0  # empty objects have no pieces to hold
-        )
-        if complete:
-            if peer.fsm.can("succeed"):
-                peer.fsm.fire("succeed")
-            if task.fsm.can("succeed"):
-                task.fsm.fire("succeed")
+        with self.state_lock:
+            host = self.pool.load_or_create_host(
+                host_info.id, host_info.ip, host_info.hostname,
+                port=host_info.port, download_port=host_info.download_port,
+                host_type=HostType(host_info.type), idc=host_info.idc,
+                location=host_info.location,
+            )
+            # ports move across restarts; the announce carries the live ones
+            if host_info.port:
+                host.port = host_info.port
+            if host_info.download_port and host.download_port != host_info.download_port:
+                host.download_port = host_info.download_port
+                host.bump_feat()
+            task = self.pool.load_or_create_task(
+                meta.task_id, meta.url, digest=meta.digest or digest,
+                tag=meta.tag, application=meta.application, filters=tuple(meta.filters),
+            )
+            task.set_metadata(content_length, piece_size)
+            if digest:
+                task.digest = digest
+            if task.fsm.can("download"):
+                task.fsm.fire("download")
+            self._supersede_host_peers(task, host.id, peer_id)
+            peer = self.pool.create_peer(peer_id, task, host)
+            for ev in ("register", "download"):
+                if peer.fsm.can(ev):
+                    peer.fsm.fire(ev)
+            for idx in piece_indices:
+                peer.finished_pieces.set(idx)
+            peer.bump_feat()
+            total = task.total_pieces or 0
+            complete = (
+                (total > 0 and peer.finished_pieces.count() >= total)
+                or content_length == 0  # empty objects have no pieces to hold
+            )
+            if complete:
+                if peer.fsm.can("succeed"):
+                    peer.fsm.fire("succeed")
+                if task.fsm.can("succeed"):
+                    task.fsm.fire("succeed")
 
     def report_pieces(self, peer_id: str, reports) -> int:
         """Batched success report: one RPC for N pieces (the conductor's
@@ -415,10 +430,13 @@ class SchedulerService:
         peer.touch()
         metrics.PIECE_REPORT_BATCH_TOTAL.inc()
         applied = 0
-        for rep in reports:
-            idx, cost_ms, parent_id = rep[0], rep[1], rep[2]
-            if self._apply_piece_success(peer, idx, cost_ms, parent_id, dedupe=True):
-                applied += 1
+        # one lock hold per BATCH, not per piece: the whole flush applies as
+        # a single critical section against in-flight dispatcher rounds
+        with self.state_lock:
+            for rep in reports:
+                idx, cost_ms, parent_id = rep[0], rep[1], rep[2]
+                if self._apply_piece_success(peer, idx, cost_ms, parent_id, dedupe=True):
+                    applied += 1
         return applied
 
     async def reschedule(self, peer_id: str) -> RegisterResult:
@@ -455,40 +473,56 @@ class SchedulerService:
             return
         metrics.PEER_RESULT_TOTAL.inc(success=str(success).lower())
         task = peer.task
-        if success:
-            if peer.fsm.can("succeed"):
-                peer.fsm.fire("succeed")
-            if task.fsm.can("succeed"):
-                task.fsm.fire("succeed")
-        else:
-            if peer.fsm.can("fail"):
-                peer.fsm.fire("fail")
-            if not task.has_available_peer() and task.fsm.can("fail"):
-                task.fsm.fire("fail")
-        # Record FIRST, observe SECOND: the persisted pair_features must carry
-        # the schedule-time history, not this download's own bandwidth —
-        # otherwise f[8] equals the label on first transfers and the trainer
-        # learns to read the answer off the feature (train/serve skew).
-        self._record_download(peer, success, bandwidth_bps)
-        if success and bandwidth_bps > 0:
-            # feed the bandwidth-history EWMA (feature f[8]) before the
-            # parent edges are dropped below — apportioned across parents:
-            # bandwidth_bps is the child's AGGREGATE rate, so crediting it
-            # whole to each of up to 4 parents would overstate every parent's
-            # EWMA (and the trainer's labels) by the parent-count factor
-            parents = task.parents_of(peer_id)
-            if parents:
-                per_parent = bandwidth_bps / len(parents)
-                for parent in parents:
-                    self.bandwidth.observe(parent.host.id, peer.host.id, per_parent)
-        # The peer stops downloading either way: release its parents' upload
-        # slots now, not at the 24h GC (it stays in the DAG as a parent).
-        task.delete_parents(peer_id)
+        with self.state_lock:
+            if success:
+                if peer.fsm.can("succeed"):
+                    peer.fsm.fire("succeed")
+                if task.fsm.can("succeed"):
+                    task.fsm.fire("succeed")
+            else:
+                if peer.fsm.can("fail"):
+                    peer.fsm.fire("fail")
+                if not task.has_available_peer() and task.fsm.can("fail"):
+                    task.fsm.fire("fail")
+            # Record FIRST, observe SECOND: the persisted pair_features must
+            # carry the schedule-time history, not this download's own
+            # bandwidth — otherwise f[8] equals the label on first transfers
+            # and the trainer learns to read the answer off the feature
+            # (train/serve skew). Rows are BUILT here (feature snapshot
+            # pre-observe, parents still edged) but appended after the lock.
+            records = self._build_download_records(peer, success, bandwidth_bps)
+            if success and bandwidth_bps > 0:
+                # feed the bandwidth-history EWMA (feature f[8]) before the
+                # parent edges are dropped below — apportioned across parents:
+                # bandwidth_bps is the child's AGGREGATE rate, so crediting it
+                # whole to each of up to 4 parents would overstate every
+                # parent's EWMA (and the trainer's labels) by the parent-count
+                # factor
+                parents = task.parents_of(peer_id)
+                if parents:
+                    per_parent = bandwidth_bps / len(parents)
+                    for parent in parents:
+                        self.bandwidth.observe(parent.host.id, peer.host.id, per_parent)
+            # The peer stops downloading either way: release its parents'
+            # upload slots now, not at the 24h GC (it stays in the DAG as a
+            # parent).
+            task.delete_parents(peer_id)
+        # Telemetry emit OUTSIDE the state lock: ColumnarStore.append
+        # synchronously savez-rotates tens of thousands of rows to disk at
+        # its cap — holding the lock across that would stall every
+        # dispatcher worker's sample/filter leg for tens of ms.
+        for kw in records:
+            self.telemetry.downloads.append(**kw)
 
-    def _record_download(self, peer: Peer, success: bool, bandwidth_bps: float) -> None:
-        """Telemetry emit (ref createDownloadRecord, service_v1.go:1241)."""
+    def _build_download_records(
+        self, peer: Peer, success: bool, bandwidth_bps: float
+    ) -> list[dict]:
+        """Telemetry rows for one peer result (ref createDownloadRecord,
+        service_v1.go:1241) — BUILT under the caller's state lock (the
+        feature snapshot must precede the bandwidth observe and the parent
+        edges' removal), appended by the caller outside it."""
         if self.telemetry is None:
-            return
+            return []
         task = peer.task
         parents = task.parents_of(peer.id)
         costs = peer.piece_costs_ms
@@ -513,65 +547,73 @@ class SchedulerService:
         )
         if parents:
             feats = build_pair_features(peer, parents, self.topology, self.bandwidth)
-            for p, f in zip(parents, feats):
-                self.telemetry.downloads.append(
+            return [
+                dict(
                     parent_peer_id=p.id.encode()[:64],
                     parent_host_id=p.host.id.encode()[:64],
                     pair_features=f,
                     **base,
                 )
-        else:
-            self.telemetry.downloads.append(
+                for p, f in zip(parents, feats)
+            ]
+        return [
+            dict(
                 parent_peer_id=b"", parent_host_id=b"",
                 pair_features=np.zeros(16, np.float32), **base,
             )
+        ]
 
     # ---- host lifecycle (ref AnnounceHost / LeaveHost / LeaveTask) ----
 
     def announce_host(self, info: HostInfo, stats: dict[str, float] | None = None) -> None:
-        host = self.pool.load_or_create_host(
-            info.id, info.ip, info.hostname,
-            port=info.port, download_port=info.download_port,
-            host_type=HostType(info.type), idc=info.idc, location=info.location,
-        )
-        # Refresh connection endpoints: the host row may predate this announce
-        # (created by register_peer with no RPC port) and ports move on restart.
-        if info.port:
-            host.port = info.port
-        if info.download_port:
-            host.download_port = info.download_port
-        host.type = HostType(info.type)
-        host.bump_feat()  # type/idc/location feed evaluator features
-        if stats:
-            for k, v in stats.items():
-                if hasattr(host.stats, k):
-                    setattr(host.stats, k, float(v))
-        host.touch()
+        with self.state_lock:
+            host = self.pool.load_or_create_host(
+                info.id, info.ip, info.hostname,
+                port=info.port, download_port=info.download_port,
+                host_type=HostType(info.type), idc=info.idc, location=info.location,
+            )
+            # Refresh connection endpoints: the host row may predate this
+            # announce (created by register_peer with no RPC port) and ports
+            # move on restart.
+            if info.port:
+                host.port = info.port
+            if info.download_port:
+                host.download_port = info.download_port
+            host.type = HostType(info.type)
+            host.bump_feat()  # type/idc/location feed evaluator features
+            if stats:
+                for k, v in stats.items():
+                    if hasattr(host.stats, k):
+                        setattr(host.stats, k, float(v))
+            host.touch()
 
     def leave_peer(self, peer_id: str) -> None:
         peer = self.pool.peer(peer_id)
         if peer is None:
             return
-        if peer.fsm.can("leave"):
-            peer.fsm.fire("leave")
-        # children of this peer must reschedule; drop its edges now
-        self.pool.delete_peer(peer_id)
+        with self.state_lock:
+            if peer.fsm.can("leave"):
+                peer.fsm.fire("leave")
+            # children of this peer must reschedule; drop its edges now
+            self.pool.delete_peer(peer_id)
 
     def leave_host(self, host_id: str) -> None:
         host = self.pool.hosts.get(host_id)
         if host is None:
             return
-        for pid in list(host.peer_ids):
-            self.leave_peer(pid)
-        del self.pool.hosts[host_id]
-        self.topology.forget_host(host_id)
-        self.bandwidth.forget_host(host_id)
+        with self.state_lock:
+            for pid in list(host.peer_ids):
+                self.leave_peer(pid)
+            del self.pool.hosts[host_id]
+            self.topology.forget_host(host_id)
+            self.bandwidth.forget_host(host_id)
 
     # ---- network topology probes (ref SyncProbes, finished here) ----
 
     def sync_probes(self, src_host_id: str, results: list[dict]) -> list[dict]:
         """Ingest a probe round from a daemon and hand back the next targets."""
-        targets = self.topology.sync_probes(src_host_id, results, self.pool.hosts)
+        with self.state_lock:
+            targets = self.topology.sync_probes(src_host_id, results, self.pool.hosts)
         if results:
             metrics.PROBES_SYNCED_TOTAL.inc(len(results))
         return [{"host_id": t.host_id, "ip": t.ip, "port": t.port} for t in targets]

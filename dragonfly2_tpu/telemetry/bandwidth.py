@@ -58,14 +58,21 @@ class BandwidthHistory:
     def observe(self, parent_host_id: str, child_host_id: str, bps: float) -> None:
         if not parent_host_id or not np.isfinite(bps) or bps <= 0:
             return
-        self.version += 1
-        self._bump_parent(parent_host_id)
         a = self.alpha
         key = (parent_host_id, child_host_id)
         prev = self._pair.get(key)
         self._pair[key] = bps if prev is None else (1 - a) * prev + a * bps
         prev = self._parent.get(parent_host_id)
         self._parent[parent_host_id] = bps if prev is None else (1 - a) * prev + a * bps
+        # Versions bump AFTER the EWMA writes (reader-safe ordering for the
+        # dispatcher's lock-free feature assembly): a concurrent reader that
+        # observes the new parent_version must also observe the new EWMA —
+        # the reverse order could cache the stale value under the new version
+        # key, serving it until the NEXT observation. A reader that keyed on
+        # the old version but read the new value merely re-assembles one row
+        # on its next lookup (the cache converges, never sticks stale).
+        self._bump_parent(parent_host_id)
+        self.version += 1
 
     def query(self, parent_host_id: str, child_host_id: str) -> Optional[float]:
         """Best available estimate in bytes/s, or None with no history."""

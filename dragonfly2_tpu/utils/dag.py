@@ -155,6 +155,19 @@ class DAG(Generic[V]):
                     stack.extend(getattr(self._v[cur], attr))
         return out
 
+    def parent_values(self, vid: str) -> list[V]:
+        """Values of vid's direct parents, snapshotted under the DAG lock:
+        callers on other threads (the scheduler's round-dispatcher workers)
+        must never iterate a vertex's live parent set while add_edge /
+        delete_vertex mutate it."""
+        with self._lock:
+            return [self._v[p].value for p in self.vertex(vid).parents]
+
+    def child_values(self, vid: str) -> list[V]:
+        """Values of vid's direct children; see parent_values."""
+        with self._lock:
+            return [self._v[c].value for c in self.vertex(vid).children]
+
     def random_vertices(self, n: int, rng: random.Random | None = None) -> list[Vertex[V]]:
         """Sample up to n distinct vertices uniformly (scheduler candidate draw)."""
         with self._lock:

@@ -83,6 +83,33 @@ class TestPieceReportBuffer:
 
         run(body())
 
+    def test_single_longlived_flusher_no_task_churn(self, run):
+        """PR 7 carry-over: the size/staleness triggers are served by ONE
+        long-lived flusher task per conductor. The r05 shape spawned a task
+        per size trigger plus a fresh staleness timer per cycle — under many
+        flush cycles the live-task count must stay flat and exactly one
+        flusher task must ever have been created."""
+
+        async def body():
+            sched = _FakeSched()
+            buf = PieceReportBuffer(sched, "p1", max_batch=4, flush_interval=0.005)
+            baseline_tasks = len(asyncio.all_tasks())
+            for cycle in range(10):  # size-trigger cycles
+                for i in range(4):
+                    buf.add(cycle * 4 + i)
+                await asyncio.sleep(0.002)
+                # no per-flush task churn: at most the one flusher beyond
+                # the baseline, regardless of how many cycles have run
+                assert len(asyncio.all_tasks()) <= baseline_tasks + 1
+            buf.add(999)  # staleness-trigger cycle rides the same task
+            await asyncio.sleep(0.03)
+            assert buf.flusher_starts == 1
+            assert sum(len(b) for b in sched.batches) == 41 and not buf._buf
+            await buf.aclose()
+            assert buf._flusher is None
+
+        run(body())
+
     def test_failed_flush_remerges_in_order(self, run):
         async def body():
             sched = _FakeSched(fail_first=1)

@@ -577,6 +577,93 @@ def test_df025_not_hidden_by_nested_def():
 
 
 # ---------------------------------------------------------------------------
+# DF026 thread/pool construction on a hot path
+
+
+def test_df026_fires_on_thread_in_for_loop():
+    src = """
+    import threading
+
+    def fan_out(pieces):
+        for p in pieces:
+            t = threading.Thread(target=handle, args=(p,))
+            t.start()
+    """
+    vs = dflint.lint_source(textwrap.dedent(src), "dragonfly2_tpu/daemon/mod.py")
+    assert [v.check for v in vs] == ["DF026"]
+    assert vs[0].line == 6
+
+
+def test_df026_fires_on_pool_in_async_def():
+    src = """
+    from concurrent.futures import ThreadPoolExecutor
+
+    async def handle_round(child):
+        pool = ThreadPoolExecutor(max_workers=2)
+        return pool.submit(score, child)
+    """
+    assert ids(src) == ["DF026"]
+
+
+def test_df026_fires_on_constructing_helper_called_in_loop():
+    src = """
+    import threading
+
+    def make_sender(payload):
+        t = threading.Thread(target=send, args=(payload,))
+        t.start()
+        return t
+
+    def run(payloads):
+        for p in payloads:
+            make_sender(p)
+    """
+    # the construction site inside the helper is NOT flagged (plain sync
+    # function), but its per-iteration call site is
+    vs = dflint.lint_source(textwrap.dedent(src), "dragonfly2_tpu/daemon/mod.py")
+    assert [(v.check, v.line) for v in vs] == [("DF026", 11)]
+
+
+def test_df026_silent_on_init_and_module_scope():
+    src = """
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    _GLOBAL_POOL = ThreadPoolExecutor(max_workers=4)
+
+    class Dispatcher:
+        def __init__(self, workers):
+            self._pool = ThreadPoolExecutor(max_workers=workers)
+            self._watchdog = threading.Thread(target=self._watch, daemon=True)
+    """
+    assert ids(src) == []
+
+
+def test_df026_silent_on_nested_def_inside_loop():
+    # the nested def's body runs when CALLED, not per iteration here
+    src = """
+    import threading
+
+    def build(items):
+        for it in items:
+            def later():
+                return threading.Thread(target=noop)
+            register(later)
+    """
+    assert ids(src) == []
+
+
+def test_df026_silent_on_unrelated_ctor_names():
+    src = """
+    async def handle(items):
+        for it in items:
+            t = Task(it)
+            w = Worker(it)
+    """
+    assert ids(src) == []
+
+
+# ---------------------------------------------------------------------------
 # DF031 silent swallow
 
 

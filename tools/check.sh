@@ -65,13 +65,14 @@ else
     skip_stage "mypy" "not installed"
 fi
 
-# chaos and restart are excluded here and run as their own legs below: a
-# resilience/recovery regression is then named by the stage that caught it,
-# and the suites are not paid for twice. (The ROADMAP tier-1 command still
-# runs `-m 'not slow'`, chaos+restart included — the stages together cover
-# exactly that set.)
+# chaos, restart, and concurrency are excluded here and run as their own
+# legs below: a resilience/recovery/dispatcher regression is then named by
+# the stage that caught it, and the suites are not paid for twice. (The
+# ROADMAP tier-1 command still runs `-m 'not slow'`, all three included —
+# the stages together cover exactly that set.)
 run_stage "pytest-tier1" env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
-    -m 'not slow and not chaos and not restart' --continue-on-collection-errors \
+    -m 'not slow and not chaos and not restart and not concurrency' \
+    --continue-on-collection-errors \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
 run_stage "chaos-smoke" env JAX_PLATFORMS=cpu python -m pytest tests/test_chaos.py -q \
@@ -95,6 +96,14 @@ assert out['full_round_rps'] > 0 and out['evaluator_prepare_us_per_round'] > 0, 
 assert out['piece_report_rpcs_per_round'] == 1, out
 print('control_plane smoke ok:', {k: out[k] for k in ('full_round_rps', 'evaluator_prepare_us_per_round', 'report_wire_us_per_piece_batched')})
 "
+
+# concurrency-smoke: the sharded round dispatcher — thread-scaling proof
+# (GIL-releasing scorer stub, deterministic on a loaded box), serial-vs-
+# sharded bit-identical equivalence, chaos hammer, and the pair-row cache
+# torn-read guards (tests/test_dispatch.py).
+run_stage "concurrency-smoke" env JAX_PLATFORMS=cpu python -m pytest tests/test_dispatch.py -q \
+    -m 'concurrency and not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly
 
 summarize
 echo "check.sh: all stages passed"

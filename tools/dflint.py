@@ -37,6 +37,12 @@ Checks (see README.md "Static analysis" for the catalog):
          the control-plane twin of DF024: one round trip per item serializes
          the loop on network latency; batch into one call (report_pieces,
          train_chunk batching) or hoist the RPC out of the loop
+  DF026  ThreadPoolExecutor/threading.Thread constructed on a hot path: a
+         for/while body, an `async def` (the per-round/per-piece shape), or
+         a same-module function called from a loop — thread/pool spawn costs
+         ~100µs+ and unbounded churn; bind workers to WORK (a long-lived
+         pool owned by the object, built in __init__), not to items (the
+         PieceReportBuffer timer-task and PR 3 per-pump-thread lessons)
   DF031  silent exception swallow: bare/overbroad except whose body is only
          pass/continue/... (no log, no narrowing)
   DF032  mutable default argument (list/dict/set literal or constructor)
@@ -77,6 +83,7 @@ CHECKS: dict[str, str] = {
     "DF023": "lock-guarded attribute also mutated outside the lock",
     "DF024": "raw asyncio.sleep retry loop outside the resilience module",
     "DF025": "awaited per-item RPC call inside a loop outside rpc/ (batch it)",
+    "DF026": "Thread/ThreadPoolExecutor constructed on a hot path (pool churn)",
     "DF031": "bare/overbroad except silently swallowing the error",
     "DF032": "mutable default argument",
     "DF033": "per-row numpy array construction inside a for loop (vectorize)",
@@ -755,6 +762,100 @@ def check_rpc_in_loop(tree: ast.Module, path: str) -> Iterator[Violation]:
                 )
 
 
+# Constructors whose per-item use marks hot-path thread churn (DF026).
+# Canonical dotted names; from-imports resolve through import_aliases.
+THREAD_CTORS = {"threading.Thread", "concurrent.futures.ThreadPoolExecutor"}
+
+
+def check_thread_churn(tree: ast.Module, path: str) -> Iterator[Violation]:
+    """DF026: ThreadPoolExecutor/Thread construction on a hot path.
+
+    Spawning a thread costs ~100µs+ of syscalls and stack setup, and a pool
+    constructed per call leaks its threads' lifetime management into the hot
+    path — the process-level lesson behind PR 3's per-pump hasher threads
+    (halved throughput) and PR 5/7's per-flush timer tasks. Three detected
+    shapes:
+
+      1. construction lexically inside a for/while body (per-item spawn);
+      2. construction inside an `async def` — coroutines are the per-round/
+         per-piece unit here, so a pool built in one is rebuilt per request
+         (RoundDispatcher/PiecePipeline build theirs in __init__ instead);
+      3. a plain-name call, inside a for/while body, to a SAME-MODULE
+         function that constructs one (one level of indirection — the
+         `stream()`-helper-in-a-measured-loop shape).
+
+    Long-lived pools built at import, in __init__, or in plain sync helpers
+    called once are not flagged. Deliberate per-iteration spawns (bench
+    measurement legs, tests) suppress with a reason."""
+    aliases = import_aliases(tree)
+
+    def is_thread_ctor(node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and _resolved_call_name(node, aliases) in THREAD_CTORS
+        )
+
+    seen: set[tuple[int, int]] = set()
+
+    def emit(node: ast.AST, why: str) -> Iterator[Violation]:
+        key = (node.lineno, node.col_offset)
+        if key in seen:
+            return
+        seen.add(key)
+        yield Violation(
+            path, node.lineno, node.col_offset, "DF026",
+            f"{why} — bind workers to WORK: construct the thread/pool once "
+            "(object __init__ / module setup) and submit items to it",
+        )
+
+    # functions that construct a thread/pool anywhere in their body (for
+    # shape 3's one-level call-graph walk)
+    constructing_fns: set[str] = set()
+    for fn in ast.walk(tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for node in ast.walk(fn):
+                if is_thread_ctor(node):
+                    constructing_fns.add(fn.name)
+                    break
+
+    # shape 2: construction inside an async def (own body only — a nested
+    # sync helper runs when called, which shapes 1/3 cover)
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        for stmt in fn.body:
+            for node in walk_pruned(stmt):
+                if is_thread_ctor(node):
+                    yield from emit(
+                        node,
+                        f"{_call_name(node)}() constructed inside async def "
+                        f"{fn.name}() (coroutines run per round/piece)",
+                    )
+
+    # shapes 1 + 3: loops
+    for loop in ast.walk(tree):
+        if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+            continue
+        for stmt in loop.body:
+            for node in walk_pruned(stmt):
+                if is_thread_ctor(node):
+                    yield from emit(
+                        node,
+                        f"{_call_name(node)}() constructed inside a loop "
+                        "(one thread/pool per iteration)",
+                    )
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in constructing_fns
+                ):
+                    yield from emit(
+                        node,
+                        f"{node.func.id}() constructs a thread/pool and is "
+                        "called once per loop iteration",
+                    )
+
+
 _BROAD = {"Exception", "BaseException"}
 
 
@@ -879,6 +980,7 @@ ALL_CHECKS = (
     check_lock_discipline,
     check_raw_retry_sleep,
     check_rpc_in_loop,
+    check_thread_churn,
     check_silent_swallow,
     check_mutable_defaults,
     check_np_ctor_in_row_loop,
