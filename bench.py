@@ -35,12 +35,12 @@ import numpy as np
 
 _SECTION_TIMEOUT_S = int(os.environ.get("DF_BENCH_SECTION_TIMEOUT", "420"))
 _PROBE_TIMEOUT_S = int(os.environ.get("DF_BENCH_PROBE_TIMEOUT", "240"))
-# The worker must outlive its own worst case: ten SIGALRM-bounded sections
+# The worker must outlive its own worst case: eleven SIGALRM-bounded sections
 # plus backend init/compile margin — otherwise the supervisor would kill it
 # and discard sections that did complete.
 _WORKER_TIMEOUT_S = max(
     int(os.environ.get("DF_BENCH_WORKER_TIMEOUT", "1500")),
-    10 * _SECTION_TIMEOUT_S + _PROBE_TIMEOUT_S + 120,
+    11 * _SECTION_TIMEOUT_S + _PROBE_TIMEOUT_S + 120,
 )
 
 
@@ -1226,6 +1226,197 @@ def bench_control_plane(
     }
 
 
+def bench_observability(
+    rounds: int = 1500, span_loops: int = 200_000, pipeline_mb: int = 32,
+) -> dict:
+    """Tracing cost, proven cheap enough to leave on (ISSUE 9 acceptance):
+    interleaved SAME-RUN A/B of the default tracer at sample_rate 0.0
+    (tracing "off": every span site still runs, records nothing) vs the
+    shipped service default (DEFAULT_SERVICE_SAMPLE_RATE) vs 1.0, on the
+    two hot paths the PR instruments — the scheduling round and the piece
+    recv/hash/write pipeline. Plus the raw span primitive in ns.
+
+      trace_span_unsampled_ns        with tracer.span(): pass at rate 0
+      trace_span_sampled_ns          same at rate 1 (ring export only)
+      sched_round_rps_off/deflt/full find_candidate_parents_async rounds/s
+      sched_round_default_overhead_pct   (off - default)/off, median of 3
+      piece_pipeline_default_overhead_pct same A/B on the pooled-buffer
+                                     hash-on-receive pipeline with the
+                                     conductor-shaped per-piece span
+      trace_sample_rate_default      the constant the pct keys are measured at
+
+    Nulls (never 0.0) on a skipped/failed leg per the PR 6 hygiene rule."""
+    import asyncio
+    import random as _random
+
+    from dragonfly2_tpu.observability import tracing
+    from dragonfly2_tpu.scheduler.resource import HostType
+    from dragonfly2_tpu.scheduler.service import SchedulerService
+
+    out: dict = {
+        "trace_span_unsampled_ns": None,
+        "trace_span_sampled_ns": None,
+        "sched_round_rps_off": None,
+        "sched_round_rps_default": None,
+        "sched_round_rps_full": None,
+        "sched_round_default_overhead_pct": None,
+        "piece_pipeline_mb_per_s_off": None,
+        "piece_pipeline_mb_per_s_default": None,
+        "piece_pipeline_default_overhead_pct": None,
+        "trace_sample_rate_default": tracing.DEFAULT_SERVICE_SAMPLE_RATE,
+    }
+
+    # ---- span primitive: ns per with-span at rate 0 and rate 1
+    # (each leg fails independently to null keys — PR 6 hygiene)
+    try:
+        tr_off = tracing.Tracer(service="bench", sample_rate=0.0)
+        tr_on = tracing.Tracer(service="bench", sample_rate=1.0, ring_size=64)
+        for tr, key in ((tr_off, "trace_span_unsampled_ns"), (tr_on, "trace_span_sampled_ns")):
+            t0 = time.perf_counter()
+            for _ in range(span_loops):
+                with tr.span("x"):
+                    pass
+            out[key] = round((time.perf_counter() - t0) / span_loops * 1e9, 1)
+    except Exception as e:  # noqa: BLE001 — leg skipped, keys stay null
+        print(f"bench: observability span leg failed: {e!r}", file=sys.stderr)
+
+    saved = tracing._default
+    rates = (
+        ("sched_round_rps_off", 0.0),
+        ("sched_round_rps_default", tracing.DEFAULT_SERVICE_SAMPLE_RATE),
+        ("sched_round_rps_full", 1.0),
+    )
+    legs: dict[str, list[float]] = {k: [] for k, _r in rates}
+
+    try:
+        # ---- scheduling round leg: the REAL serial round path (the span
+        # sites land in find_candidate_parents_async + the service), same
+        # pool, same rng seeds per leg, interleaved median-of-3. The default
+        # tracer is swapped per leg because that is exactly what the span
+        # sites consult. Setup lives INSIDE the leg's try so a pool/
+        # evaluator failure nulls only these keys, not the section.
+        try:
+            svc = SchedulerService()
+            task = svc.pool.load_or_create_task("obs-task", "http://origin/obs.bin")
+            task.set_metadata(1 << 30, 4 << 20)
+            children, parents_ = [], []
+            for i in range(96):
+                h = svc.pool.load_or_create_host(
+                    f"oh{i}", f"10.9.{i // 256}.{i % 256}", f"ohost{i}",
+                    download_port=8000, host_type=HostType.NORMAL,
+                )
+                h.upload_limit = 10_000
+                p = svc.pool.create_peer(f"opeer{i}", task, h)
+                for evname in ("register", "download"):
+                    if p.fsm.can(evname):
+                        p.fsm.fire(evname)
+                if i < 8:
+                    children.append(p)
+                else:
+                    for idx in range(8):
+                        p.finished_pieces.set(idx)
+                    p.bump_feat()
+                    parents_.append(p)
+            rng = _random.Random(7)
+            for c in children:
+                for p in parents_[:40]:
+                    svc.topology.enqueue(c.host.id, p.host.id, rng.uniform(0.2, 30.0))
+                    svc.bandwidth.observe(p.host.id, c.host.id, rng.uniform(1e8, 1e9))
+
+            async def sched_leg(rate: float) -> float:
+                from dragonfly2_tpu.scheduler.scheduling import Scheduling
+
+                tracing._default = tracing.Tracer(
+                    service="bench", sample_rate=rate, ring_size=64,
+                    rng=_random.Random(11).random,
+                )
+                sched = Scheduling(svc.evaluator)  # fresh seeded rng: same draws per leg
+                t0 = time.perf_counter()
+                for r in range(rounds):
+                    await sched.find_candidate_parents_async(children[r % len(children)])
+                return rounds / (time.perf_counter() - t0)
+
+            for _rep in range(3):
+                for key, rate in rates:
+                    legs[key].append(asyncio.run(sched_leg(rate)))
+            for key, _rate in rates:
+                out[key] = round(float(np.median(legs[key])), 1)
+            off, deflt = out["sched_round_rps_off"], out["sched_round_rps_default"]
+            out["sched_round_default_overhead_pct"] = round(
+                (off - deflt) / off * 100.0, 2
+            )
+        except Exception as e:  # noqa: BLE001 — leg skipped, keys stay null
+            print(f"bench: observability sched leg failed: {e!r}", file=sys.stderr)
+
+        # ---- piece pipeline leg: pooled-buffer feed + hash-on-receive with
+        # the conductor-shaped per-piece span around each piece, rate 0 vs
+        # default, interleaved. Chunks mimic recv granularity (256 KiB).
+        from dragonfly2_tpu.daemon.pipeline import PiecePipeline
+
+        piece = 4 << 20
+        npieces = max(1, (pipeline_mb << 20) // piece)
+        payload = bytes(piece)
+        chunk = 256 << 10
+
+        async def pipe_leg(rate: float) -> float:
+            tracing._default = tracing.Tracer(
+                service="bench", sample_rate=rate, ring_size=64,
+                rng=_random.Random(13).random,
+            )
+            tracer = tracing._default
+            pipeline = PiecePipeline()
+            try:
+                t0 = time.perf_counter()
+                for idx in range(npieces):
+                    with tracer.span(
+                        "conductor.piece", piece=idx, bytes=piece, path="raw"
+                    ) as sp:
+                        pooled = await pipeline.pool.acquire(piece)
+                        pump = pipeline.hash_pump(pooled.view)
+                        try:
+                            t_recv = time.monotonic() if sp.sampled else 0.0
+                            off_b = 0
+                            while off_b < piece:
+                                pooled.view[off_b : off_b + chunk] = payload[
+                                    off_b : off_b + chunk
+                                ]
+                                off_b += chunk
+                                pump.feed(off_b)
+                            if sp.sampled:
+                                sp.set_attr(
+                                    "recv_ms",
+                                    round((time.monotonic() - t_recv) * 1e3, 3),
+                                )
+                            await pump.finish()
+                        except BaseException:
+                            pump.abort()
+                            raise
+                        finally:
+                            pooled.release()
+                return (npieces * piece) / (time.perf_counter() - t0) / (1 << 20)
+            finally:
+                pipeline.close()
+
+        try:
+            pipe_off, pipe_deflt = [], []
+            for _rep in range(3):
+                pipe_off.append(asyncio.run(pipe_leg(0.0)))
+                pipe_deflt.append(
+                    asyncio.run(pipe_leg(tracing.DEFAULT_SERVICE_SAMPLE_RATE))
+                )
+            po, pd = float(np.median(pipe_off)), float(np.median(pipe_deflt))
+            out["piece_pipeline_mb_per_s_off"] = round(po, 1)
+            out["piece_pipeline_mb_per_s_default"] = round(pd, 1)
+            out["piece_pipeline_default_overhead_pct"] = round(
+                (po - pd) / po * 100.0, 2
+            )
+        except Exception as e:  # noqa: BLE001 — leg skipped, keys stay null
+            print(f"bench: observability pipeline leg failed: {e!r}", file=sys.stderr)
+    finally:
+        tracing._default = saved
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -1271,6 +1462,7 @@ def main() -> None:
     piece_pipeline = run_section("piece_pipeline", bench_piece_pipeline, {})
     dataset_build = run_section("dataset_build", bench_dataset_build, {})
     control_plane = run_section("control_plane", bench_control_plane, {})
+    observability = run_section("observability", bench_observability, {})
     mlp_sps, mlp_mse = run_section("mlp_train", bench_mlp_train, (None, None))
     serving = run_section("evaluator_serving", bench_evaluator_serving, {})
     # headline = the production serving path: native C++ scorer when the
@@ -1332,6 +1524,16 @@ def main() -> None:
         # native-FFI serving section below, which needs the C++ toolchain
         "control_plane_full_round_rps": control_plane.get("full_round_rps"),
         "control_plane": control_plane or "skipped",
+        # tracing cost A/B (ISSUE 9): default-sample-rate overhead on the
+        # scheduling round and the piece pipeline, interleaved same-run;
+        # acceptance is ≤5% at the shipped default and ≈0 disabled
+        "observability_sched_round_overhead_pct": observability.get(
+            "sched_round_default_overhead_pct"
+        ),
+        "observability_piece_pipeline_overhead_pct": observability.get(
+            "piece_pipeline_default_overhead_pct"
+        ),
+        "observability": observability or "skipped",
         "backend": backend,
         **serving,
     }

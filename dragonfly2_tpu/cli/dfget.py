@@ -88,20 +88,26 @@ async def ensure_daemon(
 async def single_download(
     client: RpcClient, args: argparse.Namespace, url: str, output: str
 ) -> None:
+    from dragonfly2_tpu.observability.tracing import default_tracer
+
     t0 = time.monotonic()
-    result = await client.call(
-        "download",
-        {
-            "url": url,
-            "output": os.path.abspath(output),
-            "tag": args.tag,
-            "application": args.application,
-            "digest": args.digest if url == args.url else "",
-            "filters": args.filter,
-            "range": args.range if url == args.url else "",
-        },
-        timeout=args.timeout,
-    )
+    # trace ROOT for the download chain: the rpc client ships this context
+    # to the daemon, whose conductor/scheduler/parent-daemon spans all join
+    # the one trace (`dftrace <files>` reassembles it)
+    with default_tracer().span("dfget.download", url=url, output=output):
+        result = await client.call(
+            "download",
+            {
+                "url": url,
+                "output": os.path.abspath(output),
+                "tag": args.tag,
+                "application": args.application,
+                "digest": args.digest if url == args.url else "",
+                "filters": args.filter,
+                "range": args.range if url == args.url else "",
+            },
+            timeout=args.timeout,
+        )
     elapsed = time.monotonic() - t0
     size = result.get("exported_bytes", result["content_length"])
     rate = size / max(elapsed, 1e-6) / (1 << 20)
@@ -234,7 +240,22 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--timeout", type=float, default=600.0)
     ap.add_argument("--spawn-timeout", type=float, default=10.0)
     ap.add_argument("--no-spawn", action="store_true", help="fail if daemon absent")
+    ap.add_argument("--trace-file", default=os.environ.get("DRAGONFLY_TRACE_FILE", ""),
+                    help="record this invocation's trace spans (JSON lines; "
+                         "sampled at 100%% — merge with the services' files "
+                         "via dftrace)")
     args = ap.parse_args(argv)
+    from dragonfly2_tpu.observability.tracing import configure_default_tracer
+
+    # --trace-file: always sampled — the operator asked for THIS download's
+    # timeline, not a 1% draw. Without it, the root still opens but at the
+    # SERVICE default rate: a bare dfget must not ship an always-sampled
+    # context that forces the whole cluster to record every download.
+    configure_default_tracer(
+        "dfget",
+        trace_file=args.trace_file or None,
+        sample_rate=1.0 if args.trace_file else None,
+    )
     return asyncio.run(download(args))
 
 

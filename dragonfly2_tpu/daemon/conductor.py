@@ -33,6 +33,7 @@ import aiohttp
 from dragonfly2_tpu.daemon.rawrange import AddressFamilyError
 from dragonfly2_tpu.daemon.source import SourceError, SourceRegistry
 from dragonfly2_tpu.daemon.storage import StorageManager, TaskStorage
+from dragonfly2_tpu.observability.tracing import default_tracer
 from dragonfly2_tpu.resilience import deadline as dl
 from dragonfly2_tpu.resilience import faultline
 from dragonfly2_tpu.resilience.backoff import BackoffPolicy
@@ -175,7 +176,13 @@ class PieceReportBuffer:
             while self._buf:
                 batch, self._buf = self._buf, []
                 try:
-                    await self._sched.report_pieces(self.peer_id, batch)  # dflint: disable=DF025 this IS the batch flush; the loop only drains reports that arrived during the awaited call
+                    # flush span: how often the buffer ships and how full it
+                    # is are exactly the control-plane amortization questions
+                    # a trace should answer (≤1 flush per dispatch round)
+                    with default_tracer().span(
+                        "conductor.report_flush", batch=len(batch)
+                    ):
+                        await self._sched.report_pieces(self.peer_id, batch)  # dflint: disable=DF025 this IS the batch flush; the loop only drains reports that arrived during the awaited call
                     self.rpcs += 1
                 except Exception as e:  # noqa: BLE001 — advisory accounting:
                     # keep the pieces for the next flush trigger; the download
@@ -606,28 +613,33 @@ class PeerTaskConductor:
             # of in write_piece's second pass
             pipeline = self._pipeline()
             pooled = await pipeline.pool.acquire(r.length)
-            try:
-                pump = pipeline.hash_pump(pooled.view)
+            # origin pieces join the trace too: the cutover path must be
+            # attributable in the same timeline as parent fetches
+            with default_tracer().span(
+                "conductor.piece", piece=idx, bytes=r.length, path="origin"
+            ):
                 try:
-                    off = 0
-                    async for chunk in self.sources.download(self.meta.url, r, self.headers):
-                        if off + len(chunk) > r.length:
-                            raise IOError(
-                                f"source piece {idx}: got more than {r.length} bytes"
-                            )
-                        pooled.view[off : off + len(chunk)] = chunk
-                        off += len(chunk)
-                        pump.feed(off)
-                        await self.bucket.acquire(len(chunk))
-                    if off != r.length:
-                        raise IOError(f"source piece {idx}: got {off}, want {r.length}")
-                    d = await pump.finish()
-                except BaseException:
-                    pump.abort()
-                    raise
-                await self.ts.write_piece_view(idx, pooled.view, digest=d)
-            finally:
-                pooled.release()
+                    pump = pipeline.hash_pump(pooled.view)
+                    try:
+                        off = 0
+                        async for chunk in self.sources.download(self.meta.url, r, self.headers):
+                            if off + len(chunk) > r.length:
+                                raise IOError(
+                                    f"source piece {idx}: got more than {r.length} bytes"
+                                )
+                            pooled.view[off : off + len(chunk)] = chunk
+                            off += len(chunk)
+                            pump.feed(off)
+                            await self.bucket.acquire(len(chunk))
+                        if off != r.length:
+                            raise IOError(f"source piece {idx}: got {off}, want {r.length}")
+                        d = await pump.finish()
+                    except BaseException:
+                        pump.abort()
+                        raise
+                    await self.ts.write_piece_view(idx, pooled.view, digest=d)
+                finally:
+                    pooled.release()
             self.bytes_from_source += r.length
             # same accounting as the sequential path (_write_source_piece):
             # cutover dashboards need parent vs back_to_source piece counts
@@ -732,6 +744,7 @@ class PeerTaskConductor:
         self.dispatcher.update_parents(parents)
         session = self._http()
         reschedules = 0
+        round_no = 0
         last_update = time.monotonic()
 
         try:
@@ -788,23 +801,33 @@ class PeerTaskConductor:
                 queue: asyncio.Queue[int] = asyncio.Queue()
                 for i in available:
                     queue.put_nowait(i)
-                workers = [
-                    asyncio.ensure_future(self._piece_worker(session, queue))
-                    for _ in range(min(self.cfg.piece_workers, len(available)))
-                ]
-                await queue.join()
-                for w in workers:
-                    w.cancel()
-                await asyncio.gather(*workers, return_exceptions=True)
-                # writes the workers deferred must land before the loop
-                # re-reads the bitset, or still-in-flight pieces would look
-                # missing and be refetched
-                await self._drain_writes()
-                # dispatch-round-end flush: the scheduler learns this round's
-                # pieces in ONE report_pieces RPC (≤1 flush per round unless
-                # the size/interval triggers fired mid-round)
-                if self._reports is not None:
-                    await self._reports.flush()
+                round_no += 1
+                # the round span parents every piece span its workers open
+                # (tasks created inside inherit the contextvar context) plus
+                # the round-end report flush — the traced unit ROADMAP #1's
+                # "per-round glue" lever is accounted in
+                with default_tracer().span(
+                    "conductor.dispatch_round",
+                    round=round_no, pieces=len(available),
+                    workers=min(self.cfg.piece_workers, len(available)),
+                ):
+                    workers = [
+                        asyncio.ensure_future(self._piece_worker(session, queue))
+                        for _ in range(min(self.cfg.piece_workers, len(available)))
+                    ]
+                    await queue.join()
+                    for w in workers:
+                        w.cancel()
+                    await asyncio.gather(*workers, return_exceptions=True)
+                    # writes the workers deferred must land before the loop
+                    # re-reads the bitset, or still-in-flight pieces would look
+                    # missing and be refetched
+                    await self._drain_writes()
+                    # dispatch-round-end flush: the scheduler learns this
+                    # round's pieces in ONE report_pieces RPC (≤1 flush per
+                    # round unless the size/interval triggers fired mid-round)
+                    if self._reports is not None:
+                        await self._reports.flush()
                 last_update = time.monotonic()
         finally:
             await self._drain_writes()
@@ -1022,9 +1045,29 @@ class PeerTaskConductor:
         # exactly wrong for an exhausted budget
         piece_timeout = max(0.001, dl.timeout(self.cfg.piece_timeout))
         use_raw = r.length >= self._RAW_FETCH_BYTES
+        # per-piece span with the PR 3 pipeline's stage decomposition lifted
+        # into attributes (recv/hash-wait, write in the nested write span):
+        # this is what lets dftrace say WHERE a slow piece spent its time.
+        # Stage clocks are read only when the trace is sampled — an
+        # unsampled piece pays the span object and nothing else.
+        with default_tracer().span(
+            "conductor.piece",
+            piece=idx, parent_peer=state.info.peer_id, bytes=r.length,
+            path="raw" if use_raw else "http",
+        ) as piece_span:
+            await self._fetch_and_land_piece(
+                session, state, idx, r, path_qs, piece_timeout, t0,
+                use_raw, piece_span,
+            )
+
+    async def _fetch_and_land_piece(
+        self, session, state, idx, r, path_qs, piece_timeout, t0,
+        use_raw, piece_span,
+    ) -> None:
         pooled = None
         digest = ""
         data = b""
+        sampled = piece_span.sampled
         try:
             if faultline.ACTIVE is not None:
                 await faultline.ACTIVE.fire("parent.fetch")
@@ -1041,12 +1084,22 @@ class PeerTaskConductor:
                 pooled = await pipeline.pool.acquire(r.length)
                 pump = pipeline.hash_pump(pooled.view)
                 try:
+                    t_recv = time.monotonic() if sampled else 0.0
                     await self._raw_http().get_range_into(
                         state.info.ip, state.info.download_port, path_qs,
                         r.header(), pooled.view, timeout=piece_timeout,
                         on_chunk=pump.feed, fault_point="parent.piece_body",
                     )
+                    if sampled:
+                        t_hash = time.monotonic()
+                        piece_span.set_attr("recv_ms", round((t_hash - t_recv) * 1e3, 3))
                     digest = await pump.finish()
+                    if sampled:
+                        # the hash overlaps recv; this is the residual WAIT
+                        # for the hash thread after the last byte landed
+                        piece_span.set_attr(
+                            "hash_wait_ms", round((time.monotonic() - t_hash) * 1e3, 3)
+                        )
                 except AddressFamilyError:
                     # this host cannot speak the parent's address family over
                     # a raw socket (e.g. IPv6 parent, odd local stack): not
@@ -1056,6 +1109,7 @@ class PeerTaskConductor:
                     pooled.release()
                     pooled = None
                     use_raw = False
+                    piece_span.set_attr("path", "http")
                     self.log.debug(
                         "parent %s: raw socket family unavailable for %s, "
                         "falling back to aiohttp", state.info.peer_id, state.info.ip,
@@ -1066,19 +1120,31 @@ class PeerTaskConductor:
                     pooled = None
                     raise
             if not use_raw:
+                headers = {"Range": r.header()}
+                ctx = default_tracer().current_context()
+                if ctx is not None:
+                    # the aiohttp fallback carries the same traceparent the
+                    # raw client stamps, so IPv6/small pieces join the trace
+                    headers["traceparent"] = ctx.traceparent()
+                t_recv = time.monotonic() if sampled else 0.0
                 async with session.get(
                     f"http://{_url_host(state.info.ip)}:{state.info.download_port}{path_qs}",
-                    headers={"Range": r.header()},
+                    headers=headers,
                     timeout=aiohttp.ClientTimeout(total=piece_timeout),
                 ) as resp:
                     if resp.status != 206:
                         raise IOError(f"parent returned HTTP {resp.status}")
                     data = await resp.read()
+                if sampled:
+                    piece_span.set_attr(
+                        "recv_ms", round((time.monotonic() - t_recv) * 1e3, 3)
+                    )
                 if faultline.ACTIVE is not None:
                     # damage the payload AFTER the fetch so the digest check
                     # (and only it) stands between a corrupt parent and disk
                     data = faultline.ACTIVE.mutate("parent.piece_body", data)
         except (aiohttp.ClientError, asyncio.TimeoutError, IOError) as e:
+            piece_span.set_attr("failed", True)
             await self._record_piece_failure(
                 state, idx, (time.monotonic() - t0) * 1000, f"failed: {e}"
             )
@@ -1143,7 +1209,13 @@ class PeerTaskConductor:
         the worker-level re-enqueue gives small-piece writes."""
         try:
             try:
-                await self.ts.write_piece_view(idx, pooled.view, digest=digest)
+                # write stage span (inline: nested under conductor.piece;
+                # deferred: a sibling task span in the same round) — the
+                # third leg of the recv/hash/write stage decomposition
+                with default_tracer().span(
+                    "conductor.piece_write", piece=idx, bytes=length
+                ):
+                    await self.ts.write_piece_view(idx, pooled.view, digest=digest)
             finally:
                 pooled.release()
         except Exception as e:

@@ -444,39 +444,44 @@ class PeerEngine:
         if seed:
             metrics.SEED_TASK_TOTAL.inc()
 
-        with dl.scope(timeout):
-            # the conductor task is created inside the scope, so it inherits
-            # the budget through its captured Context
-            ts, producer = await self._reuse_or_conduct(meta, headers, seed=seed)
-        pinned = ts  # engine-held pin for this operation (reclaim immunity)
-        try:
-            if producer is not None:
-                metrics.CONCURRENT_TASKS.inc()
-                try:
-                    with default_tracer().span(
-                        "daemon.peer_task", task_id=meta.task_id, url=url
-                    ):
+        # the span opens BEFORE the conductor task is created so every
+        # conductor-side span (dispatch rounds, pieces, report flushes, the
+        # scheduler RPCs) nests under daemon.peer_task through the task's
+        # captured Context — spanning only the await left the conductor
+        # parented to whatever the caller had current
+        with default_tracer().span(
+            "daemon.peer_task", task_id=meta.task_id, url=url, seed=seed
+        ):
+            with dl.scope(timeout):
+                # the conductor task is created inside the scope, so it
+                # inherits the budget through its captured Context
+                ts, producer = await self._reuse_or_conduct(meta, headers, seed=seed)
+            pinned = ts  # engine-held pin for this operation (reclaim immunity)
+            try:
+                if producer is not None:
+                    metrics.CONCURRENT_TASKS.inc()
+                    try:
                         ts = await producer
-                except Exception:
-                    metrics.TASK_RESULT_TOTAL.inc(success="false")
-                    raise
-                finally:
-                    metrics.CONCURRENT_TASKS.dec()
-                metrics.TASK_RESULT_TOTAL.inc(success="true")
-            if output is not None:
-                if output_range is not None:
-                    start, end = output_range
-                    if start < 0 or end < start or end >= ts.meta.content_length:
-                        raise RangeOutOfBounds(
-                            f"range {start}-{end} out of bounds for "
-                            f"{ts.meta.content_length} bytes"
-                        )
-                    await ts.export_range(output, Range(start, end - start + 1))
-                else:
-                    await ts.export_to(output)
-            return ts
-        finally:
-            pinned.unpin()
+                    except Exception:
+                        metrics.TASK_RESULT_TOTAL.inc(success="false")
+                        raise
+                    finally:
+                        metrics.CONCURRENT_TASKS.dec()
+                    metrics.TASK_RESULT_TOTAL.inc(success="true")
+                if output is not None:
+                    if output_range is not None:
+                        start, end = output_range
+                        if start < 0 or end < start or end >= ts.meta.content_length:
+                            raise RangeOutOfBounds(
+                                f"range {start}-{end} out of bounds for "
+                                f"{ts.meta.content_length} bytes"
+                            )
+                        await ts.export_range(output, Range(start, end - start + 1))
+                    else:
+                        await ts.export_to(output)
+                return ts
+            finally:
+                pinned.unpin()
 
     async def stream_task(
         self,

@@ -293,10 +293,19 @@ class RawRangeClient:
         length = len(view)
         loop = asyncio.get_running_loop()
         host = f"[{ip}]" if ":" in ip else ip
+        # piece bodies join the caller's trace: the standard traceparent
+        # header carries the context (and its sampled flag) to the parent's
+        # upload server, the same way the rpc frame's "t" key does for
+        # control RPCs. No active trace → no header, no cost beyond the get.
+        from dragonfly2_tpu.observability.tracing import Tracer
+
+        ctx = Tracer.current_context()
+        trace_line = f"traceparent: {ctx.traceparent()}\r\n" if ctx is not None else ""
         req = (
             f"GET {path_qs} HTTP/1.1\r\n"
             f"Host: {host}:{port}\r\n"
             f"Range: {range_header}\r\n"
+            f"{trace_line}"
             "Connection: keep-alive\r\n"
             "\r\n"
         ).encode("ascii")

@@ -349,6 +349,12 @@ async def run_daemon(
         objgw = ObjectGateway(engine, backend, host=ip, port=object_storage_port)
         await objgw.start()
 
+    # loop-health sampling is always on (4 clock reads/s): lag histograms
+    # must cover the incident, not start after it — /debug/loop serves them
+    from dragonfly2_tpu.observability.loophealth import default_monitor
+
+    loop_monitor = default_monitor()
+    loop_monitor.start()
     debug = None
     if metrics_port is not None:
         from dragonfly2_tpu.observability.server import start_debug_server
@@ -402,6 +408,7 @@ async def run_daemon(
     try:
         await run_until_signalled(ready_event)
     finally:
+        loop_monitor.stop()
         announcer.cancel()
         await prober.stop()
         if sni_proxy is not None:
@@ -539,6 +546,7 @@ def main() -> None:
     configure_default_tracer(
         "dragonfly-daemon",
         otlp_file=cfg.tracing.otlp_file, otlp_endpoint=cfg.tracing.otlp_endpoint,
+        trace_file=cfg.tracing.trace_file, sample_rate=cfg.tracing.sample_rate,
     )
     asyncio.run(
         run_daemon(

@@ -27,25 +27,51 @@ from dragonfly2_tpu.utils.ratelimit import TokenBucket
 logger = logging.getLogger(__name__)
 
 
+def _close_span_once(holder: list) -> None:
+    """Exit an entered serve span exactly once (prepare's finally, or the
+    GC finalizer for responses aiohttp never prepares). The contextvar
+    token may belong to a dead task context — a ValueError from reset must
+    not mask the export, so the exit is attempted and the export is what
+    matters (Span.__exit__ resets first, then exports)."""
+    if not holder:
+        return
+    span = holder.pop()
+    try:
+        span.__exit__(None, None, None)
+    except ValueError:
+        # token from another context (finalizer thread): the reset fails
+        # but the span must still export — finish it by hand
+        span._token = None
+        span.__exit__(None, None, None)
+
+
 class _PinnedFileResponse(web.FileResponse):
     """FileResponse holding a storage pin from construction until its own
     prepare() (which opens the file and sends the ranged body) completes:
     the threaded storage reclaim must not rmtree the task in the window
     between the handler returning and aiohttp opening the file. A GC
-    finalizer covers responses aiohttp never prepares (connection lost)."""
+    finalizer covers responses aiohttp never prepares (connection lost).
+    When the request carried a traceparent, the serve span rides along the
+    same way — closed after prepare so it covers the sendfile, not just the
+    handler's validation, with the finalizer closing it on the
+    never-prepared path so no span (or stale contextvar) leaks."""
 
-    def __init__(self, *args, ts: TaskStorage, **kwargs):
+    def __init__(self, *args, ts: TaskStorage, span=None, **kwargs):
         super().__init__(*args, **kwargs)
         release = OncePinRelease(ts)
         ts.pin()
         self._df_release = release
+        self._df_span_holder = [span] if span is not None else []
         weakref.finalize(self, release)
+        if span is not None:
+            weakref.finalize(self, _close_span_once, self._df_span_holder)
 
     async def prepare(self, request):
         try:
             return await super().prepare(request)
         finally:
             self._df_release()
+            _close_span_once(self._df_span_holder)
 
 
 class UploadServer:
@@ -262,13 +288,47 @@ class UploadServer:
                 if not ts.has_piece(idx):
                     raise web.HTTPNotFound(text=f"piece {idx} not yet available")
 
+        # the child's piece fetch shipped its trace context in the standard
+        # traceparent header (rawrange + the conductor's aiohttp fallback):
+        # the serve joins that trace as a server-side span covering the
+        # validation AND the sendfile (closed in _PinnedFileResponse.prepare)
+        from dragonfly2_tpu.observability.tracing import (
+            TRACEPARENT_HEADER,
+            SpanContext,
+            default_tracer,
+        )
+
+        # rate-limit BEFORE the span opens: a client disconnect cancelling
+        # the acquire must not leak an entered span
         await self.bucket.acquire(rng.length)
+        span = None
+        remote = SpanContext.from_traceparent(request.headers.get(TRACEPARENT_HEADER))
+        if remote is not None:
+            span = default_tracer().span(  # dflint: disable=DF027 entered here, exited by _PinnedFileResponse.prepare so the span covers the body send
+                "upload.serve_piece", parent=remote,
+                task_id=task_id, range_start=rng.start, range_length=rng.length,
+            )
+            span.__enter__()
+        try:
+            return self._serve_range(request, ts, task_id, rng, span)
+        except BaseException as exc:
+            # anything failing before the response takes over span ownership
+            # (rate-limit cancel, fs errors) must close it — a leaked span
+            # loses the segment AND leaves later requests on this keep-alive
+            # connection parented to a ghost
+            if span is not None:
+                span.__exit__(type(exc), exc, None)
+            raise
+
+    def _serve_range(self, request, ts, task_id, rng, span) -> web.StreamResponse:
         self.bytes_served += rng.length
         self.pieces_served += 1
         if self.pieces_served % 64 == 0:
             self._prune_fd_cache()
         if self._note_serve(task_id, rng.start, rng.length):
             self.pieces_served_hot += 1
+            if span is not None:
+                span.set_attr("hot", True)
         else:
             # first serve of this range: pre-warm page cache for the rest of
             # the fan-out (repeat serves then sendfile straight from cache)
@@ -287,6 +347,7 @@ class UploadServer:
         return _PinnedFileResponse(
             ts.data_path,
             ts=ts,
+            span=span,
             chunk_size=1 << 20,
             headers={"Content-Type": "application/octet-stream"},
         )

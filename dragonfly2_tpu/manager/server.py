@@ -188,6 +188,7 @@ def main() -> None:
     configure_default_tracer(
         "dragonfly-manager",
         otlp_file=cfg.tracing.otlp_file, otlp_endpoint=cfg.tracing.otlp_endpoint,
+        trace_file=cfg.tracing.trace_file, sample_rate=cfg.tracing.sample_rate,
     )
     asyncio.run(amain(args))
 

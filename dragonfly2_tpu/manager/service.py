@@ -257,15 +257,24 @@ class ManagerService:
         """Make this version active; deactivate siblings of the same
         (type, scheduler) — the reference's per-scheduler unique active
         version semantics (models/model.go:19-27)."""
+        from dragonfly2_tpu.observability.tracing import default_tracer
+
         row = self.db.get("models", model_id)
         if row is None:
             raise KeyError(model_id)
-        self.db.update_where(
-            "models",
-            {"type": row["type"], "scheduler_id": row["scheduler_id"], "state": STATE_ACTIVE},
-            state=STATE_INACTIVE,
-        )
-        self.db.update("models", model_id, state=STATE_ACTIVE)
+        # the activation is the ML loop's terminal hop: when the trainer's
+        # publish carried trace context over the RPC, the trace now runs
+        # announcer.upload → trainer.train_run → here, end to end
+        with default_tracer().span(
+            "manager.activate_model",
+            model_id=model_id, model_type=row["type"], version=row["version"],
+        ):
+            self.db.update_where(
+                "models",
+                {"type": row["type"], "scheduler_id": row["scheduler_id"], "state": STATE_ACTIVE},
+                state=STATE_INACTIVE,
+            )
+            self.db.update("models", model_id, state=STATE_ACTIVE)
         return self.db.get("models", model_id)
 
     def active_model(self, model_type: str, scheduler_id: int = 0) -> Optional[dict]:

@@ -7,10 +7,15 @@ same model without the prometheus client dependency: a registry of named
 metric families, label support, histogram buckets, and a text/plain v0.0.4
 render suitable for any scraper.
 
-Thread-safety: metric mutation is a dict update guarded by a lock only on
-family creation; per-child mutation uses plain float ops, which are safe
-under the GIL for the +=/= patterns used here (the services are asyncio,
-single-threaded per process).
+Thread-safety: family creation holds the registry/family lock, and every
+child mutation (Counter.inc / Gauge.set / Histogram.observe) holds a small
+per-child lock. The services are asyncio loops, but hot mutators also run on
+threads since PR 7 — dispatcher workers count scheduling metrics, pipeline
+hash shards and storage writers touch daemon counters — and a bare
+``self.value += x`` is a read-modify-write the GIL can preempt mid-update
+(increments silently lost under contention; pinned by the multi-threaded
+counter regression test). An uncontended Lock acquire is ~100 ns, noise next
+to the dict lookups around it.
 """
 
 from __future__ import annotations
@@ -63,6 +68,13 @@ class _Metric:
     def _labels_of(self, key: tuple[str, ...]) -> dict[str, str]:
         return dict(zip(self.label_names, key))
 
+    def _snapshot_children(self) -> list:
+        """Sorted (key, child) pairs under the family lock: a worker thread
+        recording a NEW label set resizes the child dict, and iterating it
+        bare would raise RuntimeError mid-scrape."""
+        with self._lock:
+            return sorted(self._children.items())
+
     def render(self) -> Iterable[str]:
         raise NotImplementedError
 
@@ -86,25 +98,29 @@ class Counter(_Metric):
 
     @property
     def value(self) -> float:
-        return sum(c.value for c in self._children.values())  # type: ignore[attr-defined]
+        with self._lock:  # a thread creating a new child resizes the dict
+            children = list(self._children.values())
+        return sum(c.value for c in children)  # type: ignore[attr-defined]
 
     class _Child:
-        __slots__ = ("value",)
+        __slots__ = ("value", "_lock")
 
         def __init__(self) -> None:
             self.value = 0.0
+            self._lock = threading.Lock()
 
         def inc(self, amount: float = 1.0) -> None:
             if amount < 0:
                 raise ValueError("counter cannot decrease")
-            self.value += amount
+            with self._lock:  # += is a preemptible read-modify-write
+                self.value += amount
 
     def render(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} counter"
         if not self._children and not self.label_names:
             yield f"{self.name} 0"
-        for key, child in sorted(self._children.items()):
+        for key, child in self._snapshot_children():
             yield f"{self.name}{_fmt_labels(self._labels_of(key))} {_fmt_value(child.value)}"  # type: ignore[attr-defined]
 
 
@@ -130,26 +146,30 @@ class Gauge(_Metric):
 
     @property
     def value(self) -> float:
-        return sum(c.value for c in self._children.values())  # type: ignore[attr-defined]
+        with self._lock:  # a thread creating a new child resizes the dict
+            children = list(self._children.values())
+        return sum(c.value for c in children)  # type: ignore[attr-defined]
 
     class _Child:
-        __slots__ = ("value",)
+        __slots__ = ("value", "_lock")
 
         def __init__(self) -> None:
             self.value = 0.0
+            self._lock = threading.Lock()
 
         def set(self, value: float) -> None:
-            self.value = float(value)
+            self.value = float(value)  # dflint: disable=DF023 a gauge set is one STORE (no read-modify-write), atomic under the GIL; only inc's += needs the lock
 
         def inc(self, amount: float = 1.0) -> None:
-            self.value += amount
+            with self._lock:  # += is a preemptible read-modify-write
+                self.value += amount
 
     def render(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} gauge"
         if not self._children and not self.label_names:
             yield f"{self.name} 0"
-        for key, child in sorted(self._children.items()):
+        for key, child in self._snapshot_children():
             yield f"{self.name}{_fmt_labels(self._labels_of(key))} {_fmt_value(child.value)}"  # type: ignore[attr-defined]
 
 
@@ -181,33 +201,43 @@ class Histogram(_Metric):
         return _HistTimer(self.labels(**labels))
 
     class _Child:
-        __slots__ = ("buckets", "counts", "total", "count")
+        __slots__ = ("buckets", "counts", "total", "count", "_lock")
 
         def __init__(self, buckets: tuple[float, ...]):
             self.buckets = buckets
             self.counts = [0] * len(buckets)
             self.total = 0.0
             self.count = 0
+            self._lock = threading.Lock()
 
         def observe(self, value: float) -> None:
-            self.total += value
-            self.count += 1
-            for i, b in enumerate(self.buckets):
-                if value <= b:
-                    self.counts[i] += 1
+            # one lock for the whole observation: sum/count/buckets must
+            # move together or a concurrent render sees a torn histogram
+            with self._lock:
+                self.total += value
+                self.count += 1
+                for i, b in enumerate(self.buckets):
+                    if value <= b:
+                        self.counts[i] += 1
 
     def render(self) -> Iterable[str]:
         yield f"# HELP {self.name} {self.help}"
         yield f"# TYPE {self.name} histogram"
-        for key, child in sorted(self._children.items()):
+        for key, child in self._snapshot_children():
             base = self._labels_of(key)
-            for b, c in zip(child.buckets, child.counts):  # type: ignore[attr-defined]
+            # snapshot under the child lock: a scrape racing observe() must
+            # never see buckets from one observation and sum/count from
+            # another (the very torn state the lock exists to prevent)
+            with child._lock:  # type: ignore[attr-defined]
+                counts = list(child.counts)  # type: ignore[attr-defined]
+                count, total = child.count, child.total  # type: ignore[attr-defined]
+            for b, c in zip(child.buckets, counts):  # type: ignore[attr-defined]
                 lab = dict(base, le=_fmt_value(b))
                 yield f"{self.name}_bucket{_fmt_labels(lab)} {c}"
             lab = dict(base, le="+Inf")
-            yield f"{self.name}_bucket{_fmt_labels(lab)} {child.count}"  # type: ignore[attr-defined]
-            yield f"{self.name}_sum{_fmt_labels(base)} {_fmt_value(child.total)}"  # type: ignore[attr-defined]
-            yield f"{self.name}_count{_fmt_labels(base)} {child.count}"  # type: ignore[attr-defined]
+            yield f"{self.name}_bucket{_fmt_labels(lab)} {count}"
+            yield f"{self.name}_sum{_fmt_labels(base)} {_fmt_value(total)}"
+            yield f"{self.name}_count{_fmt_labels(base)} {count}"
 
 
 class _HistTimer:

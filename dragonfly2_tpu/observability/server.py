@@ -5,11 +5,17 @@ The reference gives every service a dedicated metrics port plus pprof/statsview
   GET /metrics            Prometheus text exposition
   GET /healthz            liveness
   GET /debug/spans        last finished tracing spans as JSON
+  GET /debug/loop         event-loop lag + dispatcher-worker utilization
+                          (observability.loophealth)
   GET /debug/stacks       every thread's stack + every asyncio task's frame
                           (the /debug/pprof/goroutine analogue)
-  GET /debug/profile?seconds=N   cProfile the event-loop thread for N seconds,
-                          pstats text by cumulative time (the pprof CPU
-                          profile analogue)
+  GET /debug/profile?seconds=N[&mode=sample&hz=H]
+                          mode=cprofile (default): cProfile the event-loop
+                          thread, pstats by cumulative time. mode=sample: a
+                          sampling profiler over sys._current_frames() that
+                          sees EVERY thread — dispatcher workers, hash
+                          shards, writers — which cProfile structurally
+                          cannot (it hooks only the calling thread)
 started via `start_debug_server(port=...)` from any service composition root.
 """
 
@@ -17,6 +23,7 @@ from __future__ import annotations
 
 from aiohttp import web
 
+from dragonfly2_tpu.observability.loophealth import LoopHealthMonitor, default_monitor
 from dragonfly2_tpu.observability.metrics import MetricsRegistry, default_registry
 from dragonfly2_tpu.observability.tracing import Tracer, default_tracer
 
@@ -46,13 +53,72 @@ def _dump_stacks() -> str:
     return "\n".join(out) + "\n"
 
 
+def _sample_threads(seconds: float, hz: float) -> str:
+    """Sampling profiler over ALL threads (runs on a worker thread so a busy
+    event loop cannot starve its own measurement): every 1/hz seconds, grab
+    sys._current_frames() and count (thread, function) hits — leaf frame =
+    self time, any frame = cumulative. cProfile only instruments the thread
+    that enables it, so post-PR 7 round CPU on dispatcher workers was
+    invisible to /debug/profile; this mode sees every thread the process
+    owns, including libgomp-adjacent native stubs parked in ctypes calls."""
+    import sys
+    import threading
+    import time as _time
+    from collections import Counter
+
+    me = threading.get_ident()
+    leaf: Counter = Counter()
+    cum: Counter = Counter()
+    names = {}
+    period = 1.0 / hz
+    deadline = _time.monotonic() + seconds
+    ticks = 0
+    while _time.monotonic() < deadline:
+        ticks += 1
+        names.update({t.ident: t.name for t in threading.enumerate()})
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue  # the sampler itself is noise
+            tname = names.get(tid, str(tid))
+            depth = 0
+            seen = set()
+            while frame is not None and depth < 64:
+                code = frame.f_code
+                key = (tname, f"{code.co_name} ({code.co_filename.rsplit('/', 1)[-1]}:{code.co_firstlineno})")
+                if depth == 0:
+                    leaf[key] += 1
+                if key not in seen:  # recursion must not double-count
+                    cum[key] += 1
+                    seen.add(key)
+                frame = frame.f_back
+                depth += 1
+        _time.sleep(period)
+    # percentages are PER-THREAD occupancy (hits / ticks): a function
+    # burning 100% of one worker reads 100%, not 100/nthreads — dividing by
+    # total thread-samples diluted hot workers by the idle thread count
+    out = [
+        f"sampling profile: {seconds}s at {hz:.0f} Hz, {ticks} ticks "
+        "(pct = fraction of ticks that thread sat in that frame)\n"
+    ]
+    for title, counter in (("self (leaf frames)", leaf), ("cumulative (any frame)", cum)):
+        out.append(f"--- {title} ---")
+        for (tname, where), n in counter.most_common(40):
+            pct = 100.0 * n / max(1, ticks)
+            out.append(f"{pct:6.1f}%  {n:6d}  [{tname}] {where}")
+        out.append("")
+    return "\n".join(out) + "\n"
+
+
 def make_debug_app(
-    registry: MetricsRegistry | None = None, tracer: Tracer | None = None
+    registry: MetricsRegistry | None = None,
+    tracer: Tracer | None = None,
+    loophealth: LoopHealthMonitor | None = None,
 ) -> web.Application:
     from dragonfly2_tpu.observability.metrics import metrics_http_handler
 
     reg = registry or default_registry()
     tr = tracer or default_tracer()
+    lh = loophealth or default_monitor()
     app = web.Application()
     metrics = metrics_http_handler(reg)
     profiling = {"active": False}
@@ -62,6 +128,9 @@ def make_debug_app(
 
     async def spans(_req: web.Request) -> web.Response:
         return web.json_response([s.to_dict() for s in tr.finished()])
+
+    async def loop_health(_req: web.Request) -> web.Response:
+        return web.json_response(lh.stats())
 
     async def stacks(_req: web.Request) -> web.Response:
         return web.Response(text=_dump_stacks(), content_type="text/plain")
@@ -74,13 +143,20 @@ def make_debug_app(
 
         try:
             seconds = min(60.0, max(0.1, float(req.query.get("seconds", "5"))))
+            hz = min(1000.0, max(10.0, float(req.query.get("hz", "200"))))
         except ValueError:
-            raise web.HTTPBadRequest(text="seconds must be a number")
+            raise web.HTTPBadRequest(text="seconds/hz must be numbers")
+        mode = req.query.get("mode", "cprofile")
+        if mode not in ("cprofile", "sample"):
+            raise web.HTTPBadRequest(text="mode must be cprofile or sample")
         if profiling["active"]:
             raise web.HTTPConflict(text="a profile is already running")
         profiling["active"] = True
-        pr = cProfile.Profile()
         try:
+            if mode == "sample":
+                text = await asyncio.to_thread(_sample_threads, seconds, hz)
+                return web.Response(text=text, content_type="text/plain")
+            pr = cProfile.Profile()
             pr.enable()
             await asyncio.sleep(seconds)
             pr.disable()
@@ -93,6 +169,7 @@ def make_debug_app(
     app.router.add_get("/metrics", metrics)
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/debug/spans", spans)
+    app.router.add_get("/debug/loop", loop_health)
     app.router.add_get("/debug/stacks", stacks)
     app.router.add_get("/debug/profile", profile)
     return app
@@ -106,10 +183,11 @@ class DebugServer:
         port: int = 0,
         registry: MetricsRegistry | None = None,
         tracer: Tracer | None = None,
+        loophealth: LoopHealthMonitor | None = None,
     ):
         self.host = host
         self.port = port
-        self._app = make_debug_app(registry, tracer)
+        self._app = make_debug_app(registry, tracer, loophealth)
         self._runner: web.AppRunner | None = None
 
     async def start(self) -> None:
@@ -132,7 +210,10 @@ async def start_debug_server(
     port: int = 0,
     registry: MetricsRegistry | None = None,
     tracer: Tracer | None = None,
+    loophealth: LoopHealthMonitor | None = None,
 ) -> DebugServer:
-    srv = DebugServer(host=host, port=port, registry=registry, tracer=tracer)
+    srv = DebugServer(
+        host=host, port=port, registry=registry, tracer=tracer, loophealth=loophealth
+    )
     await srv.start()
     return srv

@@ -7,11 +7,19 @@ client/config/constants_otel.go). Dependency-free design:
 
 - `Tracer.span(name, **attrs)` opens a child of the current contextvar span;
   nesting follows Python async context automatically.
-- Trace context propagates across processes as a `{"trace_id", "span_id"}`
-  dict carried in RPC payloads / HTTP headers (W3C-traceparent-shaped ids).
-- Finished spans go to an exporter: in-memory ring (tests, /debug) and/or
-  JSON-lines file (the jaeger-exporter stand-in — one dict per span with
-  trace_id, span_id, parent_id, name, start, duration_ms, attrs, status).
+- Trace context propagates across processes as a W3C traceparent string
+  (rpc/core.py carries it in the frame's "t" key; the HTTP piece/metadata
+  paths carry the standard `traceparent` header).
+- Head-based sampling: the ROOT span draws once against `sample_rate` and
+  every descendant — local child or remote continuation — inherits the
+  decision through the context's sampled flag (the traceparent trace-flags
+  byte), so a trace is recorded all-or-nothing across the cluster. An
+  unsampled span costs an object + a contextvar set/reset and nothing else:
+  no id generation, no clock reads, no export.
+- Finished sampled spans go to an exporter: in-memory ring (tests, /debug)
+  and/or JSON-lines file (the jaeger-exporter stand-in — one dict per span
+  with trace_id, span_id, parent_id, name, start, duration_ms, attrs,
+  status), and/or OTLP/JSON batches (file or collector endpoint).
 """
 
 from __future__ import annotations
@@ -21,6 +29,7 @@ import json
 import logging
 import os
 import queue as queue_mod
+import random
 import secrets
 import threading
 import time
@@ -33,6 +42,11 @@ _current_span: contextvars.ContextVar[Optional["Span"]] = contextvars.ContextVar
 )
 
 TRACEPARENT_HEADER = "traceparent"
+
+# Sample rate service composition roots apply when the config carries none:
+# 1-in-100 traces recorded end to end, the rest cost one unsampled-root draw
+# per entry point. Library/test Tracer() instances keep sample_rate=1.0.
+DEFAULT_SERVICE_SAMPLE_RATE = 0.01
 
 
 def _gen_trace_id() -> str:
@@ -47,18 +61,29 @@ def _gen_span_id() -> str:
 class SpanContext:
     trace_id: str
     span_id: str
+    sampled: bool = True
 
     def to_dict(self) -> dict:
-        return {"trace_id": self.trace_id, "span_id": self.span_id}
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "sampled": self.sampled,
+        }
 
     def traceparent(self) -> str:
-        return f"00-{self.trace_id}-{self.span_id}-01"
+        # trace-flags 01 = sampled (W3C trace context); the flag IS the
+        # all-or-nothing head-sampling decision riding the wire
+        return f"00-{self.trace_id}-{self.span_id}-{'01' if self.sampled else '00'}"
 
     @classmethod
     def from_dict(cls, d: Mapping[str, Any] | None) -> Optional["SpanContext"]:
         if not d or "trace_id" not in d:
             return None
-        return cls(trace_id=str(d["trace_id"]), span_id=str(d.get("span_id", "")))
+        return cls(
+            trace_id=str(d["trace_id"]),
+            span_id=str(d.get("span_id", "")),
+            sampled=bool(d.get("sampled", True)),
+        )
 
     @classmethod
     def from_traceparent(cls, header: str | None) -> Optional["SpanContext"]:
@@ -67,13 +92,17 @@ class SpanContext:
         parts = header.split("-")
         if len(parts) != 4:
             return None
-        return cls(trace_id=parts[1], span_id=parts[2])
+        return cls(
+            trace_id=parts[1],
+            span_id=parts[2],
+            sampled=parts[3] != "00",
+        )
 
 
 class Span:
     __slots__ = (
         "name", "trace_id", "span_id", "parent_id", "start", "end",
-        "attrs", "status", "error", "_tracer", "_token",
+        "attrs", "status", "error", "sampled", "_tracer", "_token",
     )
 
     def __init__(
@@ -83,12 +112,22 @@ class Span:
         trace_id: str,
         parent_id: str,
         attrs: dict[str, Any],
+        sampled: bool = True,
     ):
         self.name = name
         self.trace_id = trace_id
-        self.span_id = _gen_span_id()
         self.parent_id = parent_id
-        self.start = time.time()
+        self.sampled = sampled
+        if sampled:
+            self.span_id = _gen_span_id()
+            self.start = time.time()
+        else:
+            # unsampled spans still hold the trace lineage for propagation
+            # (children and remote continuations inherit the decision) but
+            # skip id generation and clock reads — this is what makes the
+            # unsampled hot path cost an object + contextvar churn only
+            self.span_id = ""
+            self.start = 0.0
         self.end = 0.0
         self.attrs = attrs
         self.status = "ok"
@@ -98,7 +137,7 @@ class Span:
 
     @property
     def context(self) -> SpanContext:
-        return SpanContext(self.trace_id, self.span_id)
+        return SpanContext(self.trace_id, self.span_id, self.sampled)
 
     def set_attr(self, key: str, value: Any) -> None:
         self.attrs[key] = value
@@ -108,12 +147,14 @@ class Span:
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
+        if self._token is not None:
+            _current_span.reset(self._token)
+        if not self.sampled:
+            return
         if exc is not None:
             self.status = "error"
             self.error = f"{exc_type.__name__}: {exc}"
         self.end = time.time()
-        if self._token is not None:
-            _current_span.reset(self._token)
         self._tracer._export(self)
 
     def to_dict(self) -> dict:
@@ -197,7 +238,13 @@ class Tracer:
     (DRAGONFLY_TRACE_FILE env overrides), and — when `otlp_path` or
     `otlp_endpoint` is set — as OTLP/JSON ExportTraceServiceRequest batches
     (one request per line in the file; HTTP POST to <endpoint>/v1/traces for
-    the endpoint, e.g. a Jaeger collector's OTLP port)."""
+    the endpoint, e.g. a Jaeger collector's OTLP port).
+
+    `sample_rate` is the head-sampling probability drawn ONCE per root span;
+    descendants (local and remote) inherit the decision. 1.0 records
+    everything (library/test default), 0.0 records nothing while keeping
+    propagation wired; service boots default to
+    DEFAULT_SERVICE_SAMPLE_RATE via configure_default_tracer."""
 
     service: str = "dragonfly"
     path: str = ""
@@ -206,11 +253,14 @@ class Tracer:
     otlp_batch: int = 64
     otlp_max_age_s: float = 10.0  # flush a partial batch once its oldest span ages past this
     ring_size: int = 2048
+    sample_rate: float = 1.0
+    rng: Any = None  # random.random-compatible draw source (tests seed it)
     _ring: deque = field(default_factory=lambda: deque(maxlen=2048), repr=False)
     _lock: threading.Lock = field(default_factory=threading.Lock, repr=False)
     _fh: Any = field(default=None, repr=False)
     _otlp_fh: Any = field(default=None, repr=False)
     _otlp_buf: list = field(default_factory=list, repr=False)
+    _otlp_buf_since: float = field(default=0.0, repr=False)
     _otlp_queue: Any = field(default=None, repr=False)
     _otlp_worker: Any = field(default=None, repr=False)
 
@@ -221,19 +271,39 @@ class Tracer:
         self.otlp_endpoint = self.otlp_endpoint or os.environ.get(
             "DRAGONFLY_OTLP_ENDPOINT", ""
         )
+        env_rate = os.environ.get("DRAGONFLY_TRACE_SAMPLE", "")
+        if env_rate:
+            try:
+                self.sample_rate = min(1.0, max(0.0, float(env_rate)))
+            except ValueError:
+                pass
+        if self.rng is None:
+            self.rng = random.random
 
     def span(self, name: str, parent: SpanContext | None = None, **attrs: Any) -> Span:
         """Open a span. Parent resolution: explicit remote context > current
-        contextvar span > new root."""
+        contextvar span > new root. The sampling decision is made at the
+        root only — children inherit it, which is what makes a trace
+        all-or-nothing across processes."""
         cur = _current_span.get()
         if parent is not None:
-            trace_id, parent_id = parent.trace_id, parent.span_id
+            trace_id, parent_id, sampled = parent.trace_id, parent.span_id, parent.sampled
         elif cur is not None:
-            trace_id, parent_id = cur.trace_id, cur.span_id
+            trace_id, parent_id, sampled = cur.trace_id, cur.span_id, cur.sampled
         else:
-            trace_id, parent_id = _gen_trace_id(), ""
-        attrs.setdefault("service", self.service)
-        return Span(self, name, trace_id, parent_id, attrs)
+            sampled = self.sample_rate >= 1.0 or (
+                self.sample_rate > 0.0 and self.rng() < self.sample_rate
+            )
+            if sampled:
+                trace_id, parent_id = _gen_trace_id(), ""
+            else:
+                # lineage id still propagates downstream so remote peers see
+                # a context (and its not-sampled flag) rather than opening
+                # fresh roots of their own; a cheap counter-free id suffices
+                trace_id, parent_id = "0" * 32, ""
+        if sampled:
+            attrs.setdefault("service", self.service)
+        return Span(self, name, trace_id, parent_id, attrs, sampled)
 
     @staticmethod
     def current() -> Optional[Span]:
@@ -249,24 +319,26 @@ class Tracer:
             self._ring.append(span)
             if self.path:
                 if self._fh is None:
-                    # line-buffered writes, flushed by the OS page cache; no
-                    # per-span fsync/flush so exporting never stalls the
-                    # event loop on a contended disk
                     self._fh = open(self.path, "a", encoding="utf-8", buffering=1 << 16)
+                    # the exporter worker flushes this fh on its poll tick:
+                    # per-span flushes would stall the loop on a contended
+                    # disk, but a LIVE service's span file must be readable
+                    # by dftrace within ~a second — 64 KiB of spans sitting
+                    # in the userspace buffer until process exit made the
+                    # file useless mid-incident (found in verification)
+                    self._ensure_otlp_worker()
                 self._fh.write(json.dumps(span.to_dict()) + "\n")
             if self.otlp_path or self.otlp_endpoint:
-                first = not self._otlp_buf
+                if not self._otlp_buf:
+                    self._otlp_buf_since = time.monotonic()
+                    # the single long-lived exporter worker owns the age
+                    # flush (its queue wait doubles as the age timer) — the
+                    # earlier shape started one threading.Timer per batch,
+                    # thread churn on every partial batch (DF026's smell)
+                    self._ensure_otlp_worker()
                 self._otlp_buf.append(span)
                 if len(self._otlp_buf) >= self.otlp_batch:
                     self._flush_otlp_locked()
-                elif first:
-                    # age flush: a low-traffic service must still export live
-                    # within otlp_max_age_s, not wait for 64 spans or exit —
-                    # one daemon timer per batch start covers the case where
-                    # no further span ever arrives to trigger the size check
-                    t = threading.Timer(self.otlp_max_age_s, self.flush_otlp)
-                    t.daemon = True
-                    t.start()
 
     def _flush_otlp_locked(self, *, sync: bool = False) -> None:
         if not self._otlp_buf:
@@ -304,8 +376,28 @@ class Tracer:
             self._otlp_worker.start()
 
     def _otlp_worker_loop(self) -> None:
+        """The single exporter worker: drains POST batches AND serves every
+        time-based flush — the OTLP age flush (a partial batch that never
+        reaches otlp_batch still exports within ~otlp_max_age_s; no
+        per-batch timer threads) and the buffered file handles (span/OTLP
+        files stay dftrace-readable while the process runs)."""
+        poll = max(0.05, min(self.otlp_max_age_s / 4.0, 1.0))
         while True:
-            req = self._otlp_queue.get()
+            try:
+                req = self._otlp_queue.get(timeout=poll)
+            except queue_mod.Empty:
+                with self._lock:
+                    if (
+                        self._otlp_buf
+                        and time.monotonic() - self._otlp_buf_since
+                        >= self.otlp_max_age_s
+                    ):
+                        self._flush_otlp_locked()
+                    if self._otlp_fh is not None:
+                        self._otlp_fh.flush()
+                    if self._fh is not None:
+                        self._fh.flush()
+                continue
             if req is None:
                 return
             self._post_otlp(req)
@@ -337,10 +429,16 @@ class Tracer:
     def close(self) -> None:
         with self._lock:
             self._flush_otlp_locked(sync=True)
-            if self._otlp_worker is not None and self._otlp_queue is not None:
-                self._otlp_queue.put(None)  # drain-then-exit sentinel
-                self._otlp_worker.join(timeout=10)
-                self._otlp_worker = None
+        # sentinel + join OUTSIDE the lock: the worker's idle tick takes the
+        # same lock, so holding it here would deadline-race the join — a
+        # slow collector during the sync flush above would leave the worker
+        # parked on the lock, unable to consume the sentinel, and every
+        # process exit would burn the full join timeout
+        if self._otlp_worker is not None and self._otlp_queue is not None:
+            self._otlp_queue.put(None)  # drain-then-exit sentinel
+            self._otlp_worker.join(timeout=10)
+            self._otlp_worker = None
+        with self._lock:
             if self._fh is not None:
                 self._fh.flush()
                 self._fh.close()
@@ -375,6 +473,15 @@ class TracingSection:
         help="POST OTLP/JSON batches to this collector base URL "
              "(e.g. http://jaeger:4318)",
     )
+    trace_file: Optional[str] = cfgfield(
+        None, help="append finished spans as JSON lines to this file "
+                   "(the dftrace input format)"
+    )
+    sample_rate: Optional[float] = cfgfield(
+        None, minimum=0.0, maximum=1.0,
+        help="head-sampling probability per trace root (default 0.01; "
+             "1.0 records everything, 0.0 disables recording)",
+    )
 
 
 def configure_default_tracer(
@@ -382,10 +489,14 @@ def configure_default_tracer(
     *,
     otlp_file: str | None = None,
     otlp_endpoint: str | None = None,
+    trace_file: str | None = None,
+    sample_rate: float | None = None,
 ) -> Tracer:
     """Apply config-surface tracing options to the process tracer at boot.
     Registers an atexit close so partially-filled OTLP batches flush on
-    shutdown — a low-traffic process must not export nothing."""
+    shutdown — a low-traffic process must not export nothing. Service boots
+    get head sampling at DEFAULT_SERVICE_SAMPLE_RATE unless the config (or
+    DRAGONFLY_TRACE_SAMPLE) says otherwise."""
     import atexit
 
     t = default_tracer()
@@ -395,6 +506,15 @@ def configure_default_tracer(
         t.otlp_path = otlp_file
     if otlp_endpoint:
         t.otlp_endpoint = otlp_endpoint
-    if otlp_file or otlp_endpoint:
+    if trace_file:
+        t.path = trace_file
+    if sample_rate is not None:
+        t.sample_rate = min(1.0, max(0.0, sample_rate))
+    elif not os.environ.get("DRAGONFLY_TRACE_SAMPLE"):
+        t.sample_rate = DEFAULT_SERVICE_SAMPLE_RATE
+    # condition on the tracer's RESOLVED outputs, not the arguments: exports
+    # configured via DRAGONFLY_TRACE_FILE/DRAGONFLY_OTLP_* env (no config
+    # args) must flush at exit too, or their buffered tails are lost
+    if t.path or t.otlp_path or t.otlp_endpoint:
         atexit.register(t.close)
     return t

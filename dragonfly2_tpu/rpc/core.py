@@ -1,10 +1,14 @@
 """Framed msgpack RPC core.
 
 Wire format: 4-byte big-endian length + msgpack map
-  request:  {"i": id, "m": method, "p": payload}
+  request:  {"i": id, "m": method, "p": payload, "t"?: traceparent}
   response: {"i": id, "r": result} | {"i": id, "e": {"code", "message"}}
 Payloads are msgpack-native types (dicts/lists/str/bytes/numbers); service
-adapters convert dataclasses at the boundary.
+adapters convert dataclasses at the boundary. "t" is the optional compact
+trace context (W3C traceparent string, sampled flag included): the client
+stamps it when a trace is active in its caller's context, the server opens
+a continuation span around the handler — the otelgrpc-interceptor
+equivalent (SURVEY §5) without widening any payload schema.
 
 Server: asyncio.start_server (tcp or unix), method registry, per-server QPS
 token bucket (reference default 10k QPS / 20k burst,
@@ -24,6 +28,7 @@ from typing import Any, Awaitable, Callable
 
 import msgpack
 
+from dragonfly2_tpu.observability.tracing import SpanContext, Tracer, default_tracer
 from dragonfly2_tpu.resilience import deadline as dl
 from dragonfly2_tpu.resilience import faultline
 from dragonfly2_tpu.resilience.backoff import BackoffPolicy
@@ -285,8 +290,25 @@ class RpcServer:
         elif not self._bucket.try_acquire():
             out = {"i": rid, "e": {"code": "resource_exhausted", "message": "rate limited"}}
         else:
+            # continuation span when the caller shipped trace context: the
+            # handler (and everything it awaits — nested rpc calls, piece
+            # fetches, in-process service methods) inherits it through the
+            # contextvar. An unsampled context still flows so downstream
+            # spans stay unrecorded (all-or-nothing); no "t" costs one get.
+            # Non-string "t" (skewed/hostile peer) is ignored, NOT raised:
+            # this parse runs before the error-response try below, and an
+            # exception here would kill the dispatch task and leave the
+            # caller hanging out its full timeout with no response frame.
+            t = msg.get("t")
+            remote = SpanContext.from_traceparent(t) if isinstance(t, str) else None
             try:
-                result = await handler(msg.get("p"))
+                if remote is not None:
+                    with default_tracer().span(
+                        "rpc.server", parent=remote, method=method
+                    ):
+                        result = await handler(msg.get("p"))
+                else:
+                    result = await handler(msg.get("p"))
                 out = {"i": rid, "r": result}
             except RpcError as e:
                 out = {"i": rid, "e": {"code": e.code, "message": str(e)}}
@@ -410,6 +432,11 @@ class RpcClient:
 
     async def call(self, method: str, payload: Any = None, *, timeout: float | None = None) -> Any:
         last_err: Exception | None = None
+        # trace context resolved ONCE per call: each attempt gets its own
+        # client span (attempt index is an attribute, so retries are visible
+        # in the trace), and the span's own context rides the frame's "t"
+        # key. No active trace → no span objects, no wire bytes.
+        traced = Tracer.current() is not None
         for attempt in range(self.retries + 1):
             if not self.breaker.allow():
                 raise RpcError(
@@ -422,7 +449,20 @@ class RpcClient:
             per_op = timeout or self.timeout
             effective = self._effective_timeout(timeout, method)
             try:
-                result = await self._call_once(method, payload, effective)
+                if traced:
+                    with default_tracer().span(
+                        "rpc.client",
+                        method=method,
+                        address=self.address,
+                        attempt=attempt,
+                        deadline_remaining_s=round(effective, 3),
+                    ) as sp:
+                        result = await self._call_once(
+                            method, payload, effective,
+                            trace=sp.context.traceparent(),
+                        )
+                else:
+                    result = await self._call_once(method, payload, effective)
                 self.breaker.record_success()
                 return result
             except (ConnectionClosed, ConnectionError, OSError) as e:
@@ -450,17 +490,22 @@ class RpcClient:
                 raise
         raise last_err or RpcError("rpc call failed")
 
-    async def _call_once(self, method: str, payload: Any, timeout: float) -> Any:
+    async def _call_once(
+        self, method: str, payload: Any, timeout: float, trace: str | None = None
+    ) -> Any:
         await self._connect()
         self._next_id += 1
         rid = self._next_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
+        msg = {"i": rid, "m": method, "p": payload}
+        if trace is not None:
+            msg["t"] = trace
         try:
             # enqueue is synchronous (injected rpc.write faults raise HERE and
             # feed the retry path); the coalescer's flusher owns the drain, so
             # concurrent calls in one loop batch share a single write+drain
-            self._wq.send({"i": rid, "m": method, "p": payload})
+            self._wq.send(msg)
             return await asyncio.wait_for(fut, timeout)
         except asyncio.TimeoutError:
             self._pending.pop(rid, None)

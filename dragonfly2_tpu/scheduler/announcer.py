@@ -52,22 +52,35 @@ class TrainerAnnouncer:
         awaits many round trips, and telemetry appended meanwhile must NOT be
         dropped by the post-upload clear — only the files actually uploaded
         are discarded."""
-        downloads, dl_cut = self.telemetry.downloads.snapshot()
-        probes, pr_cut = self.telemetry.probes.snapshot()
-        token = await self.trainer.train_open(self.hostname, self.scheduler_id)
-        rows = 0
-        for kind, arr in (("downloads", downloads), ("probes", probes)):
-            for start in range(0, len(arr), CHUNK_ROWS):
-                rows = await self.trainer.train_chunk(  # dflint: disable=DF025 already batched: each call ships CHUNK_ROWS rows (one frame-budget-sized chunk per trip)
-                    token, kind, arr[start : start + CHUNK_ROWS]
-                )
-        await self.trainer.train_close(token)
-        if self.clear_after_upload:
-            # dataset handed off; drop exactly the snapshot — rows that
-            # arrived mid-upload stay for the next cycle
-            self.telemetry.downloads.discard(dl_cut)
-            self.telemetry.probes.discard(pr_cut)
-        self.uploads += 1
+        from dragonfly2_tpu.observability.tracing import default_tracer
+
+        # trace ROOT for the ML plane: the upload initiates a chain (trainer
+        # ingest → train run → manager model activation) no download trace
+        # covers — the train_close context captured by the trainer is what
+        # ties the eventual background train run back to this upload
+        with default_tracer().span(
+            "announcer.upload", scheduler=self.hostname or "scheduler"
+        ) as sp:
+            downloads, dl_cut = self.telemetry.downloads.snapshot()
+            probes, pr_cut = self.telemetry.probes.snapshot()
+            token = await self.trainer.train_open(self.hostname, self.scheduler_id)
+            rows = 0
+            for kind, arr in (("downloads", downloads), ("probes", probes)):
+                for start in range(0, len(arr), CHUNK_ROWS):
+                    rows = await self.trainer.train_chunk(  # dflint: disable=DF025 already batched: each call ships CHUNK_ROWS rows (one frame-budget-sized chunk per trip)
+                        token, kind, arr[start : start + CHUNK_ROWS]
+                    )
+            await self.trainer.train_close(token)
+            if self.clear_after_upload:
+                # dataset handed off; drop exactly the snapshot — rows that
+                # arrived mid-upload stay for the next cycle
+                self.telemetry.downloads.discard(dl_cut)
+                self.telemetry.probes.discard(pr_cut)
+            self.uploads += 1
+            if sp.sampled:
+                sp.set_attr("rows", rows)
+                sp.set_attr("downloads", len(downloads))
+                sp.set_attr("probes", len(probes))
         logger.info("uploaded %d telemetry rows to trainer", rows)
         return {"rows": rows, "downloads": len(downloads), "probes": len(probes)}
 

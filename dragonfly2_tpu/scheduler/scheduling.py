@@ -23,11 +23,13 @@ import logging
 import os
 import random
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 import numpy as np
 
+from dragonfly2_tpu.observability.tracing import default_tracer
 from dragonfly2_tpu.resilience.backoff import BackoffPolicy
 from dragonfly2_tpu.scheduler.evaluator import Evaluator
 from dragonfly2_tpu.scheduler.resource import (
@@ -238,12 +240,17 @@ class Scheduling:
         the native scorer's micro-batcher instead of crossing the FFI one by
         one (MLEvaluator.evaluate_async). The serial counterpart of the
         dispatcher path — used when no dispatcher is attached."""
-        with self.state_lock:
-            candidates = self._sample_candidates(child, blocklist)
-        if not candidates:
-            return []
-        scores = await self.evaluator.evaluate_async(child, candidates)
-        return self._top_parents(child, candidates, scores)
+        # serial-vs-dispatched is a first-class span attribute: the trace
+        # itself answers which serving shape a round took (ROADMAP #1)
+        with default_tracer().span("scheduler.round", dispatched=False) as sp:
+            with self.state_lock:
+                candidates = self._sample_candidates(child, blocklist)
+            if not candidates:
+                return []
+            if sp.sampled:
+                sp.set_attr("candidates", len(candidates))
+            scores = await self.evaluator.evaluate_async(child, candidates)
+            return self._top_parents(child, candidates, scores)
 
     def find_success_parent(self, child: Peer, blocklist: set[str] = frozenset()) -> Peer | None:
         """SMALL-scope path: a single finished parent (ref FindSuccessParent).
@@ -376,13 +383,31 @@ class RoundDispatcher:
     _KIND_FIND = 0
     _KIND_EVAL = 1
 
+    @property
+    def busy(self) -> int:
+        """Workers currently running a batch (loop-owned state; the
+        loop-health monitor samples this into the utilization histogram)."""
+        return self.workers - self._free
+
     async def find(self, child: Peer, blocklist: set[str] = frozenset()) -> list[Peer]:
         """One find round on a worker thread; returns the top candidates
         (uncommitted — the caller commits on the loop)."""
         from dragonfly2_tpu.scheduler import metrics
 
         metrics.DISPATCHED_ROUNDS_TOTAL.inc()
-        return await self._submit(self._KIND_FIND, (child, blocklist))
+        # span attrs answer the dispatcher questions a timeline needs:
+        # how long the round queued before a worker took it, which worker
+        # ran it, and how many rounds amortized that worker wakeup
+        with default_tracer().span("scheduler.round", dispatched=True) as sp:
+            meta = {"enq": time.perf_counter()} if sp.sampled else None
+            out = await self._submit(self._KIND_FIND, (child, blocklist), meta)
+            if meta is not None and "start" in meta:
+                sp.set_attr(
+                    "queue_wait_ms", round((meta["start"] - meta["enq"]) * 1e3, 3)
+                )
+                sp.set_attr("worker", meta.get("worker", ""))
+                sp.set_attr("batch_size", meta.get("batch", 0))
+            return out
 
     async def evaluate(self, child: Peer, parents: list[Peer]):
         """Score a fixed candidate set on a worker thread (the bench's
@@ -390,14 +415,14 @@ class RoundDispatcher:
         sample/filter leg)."""
         return await self._submit(self._KIND_EVAL, (child, parents))
 
-    def _submit(self, kind, args) -> "asyncio.Future":
+    def _submit(self, kind, args, meta: dict | None = None) -> "asyncio.Future":
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         if self._closed:
             fut.set_exception(RuntimeError("round dispatcher is shut down"))
             return fut
         self.rounds += 1
-        self._pending.append((kind, args, fut))
+        self._pending.append((kind, args, fut, meta))
         self._maybe_dispatch(loop)
         return fut
 
@@ -423,13 +448,22 @@ class RoundDispatcher:
         find_candidate_parents_batch), then resolve every future and free
         the worker slot in ONE loop callback — per-round
         call_soon_threadsafe wakeups measured ~40% of a dispatched round."""
+        # stamp trace metadata before running: queue-wait is measured to the
+        # moment a worker picked the batch up, not to its first round
+        t_start = time.perf_counter()
+        worker = threading.current_thread().name
+        for _k, _a, _f, meta in batch:
+            if meta is not None:
+                meta["start"] = t_start
+                meta["worker"] = worker
+                meta["batch"] = len(batch)
         out: list = [None] * len(batch)
         errs: list = [None] * len(batch)
         for kind, runner in (
             (self._KIND_FIND, self.scheduling.find_candidate_parents_batch),
             (self._KIND_EVAL, self.scheduling.evaluator.evaluate_many),
         ):
-            group = [(i, args) for i, (k, args, _f) in enumerate(batch) if k == kind]
+            group = [(i, args) for i, (k, args, _f, _m) in enumerate(batch) if k == kind]
             if not group:
                 continue
             try:
@@ -441,7 +475,7 @@ class RoundDispatcher:
                     errs[i] = e
         loop.call_soon_threadsafe(
             self._finish_batch, loop,
-            [(fut, out[i], errs[i]) for i, (_k, _a, fut) in enumerate(batch)],
+            [(fut, out[i], errs[i]) for i, (_k, _a, fut, _m) in enumerate(batch)],
         )
 
     def _finish_batch(self, loop, triples) -> None:
@@ -462,7 +496,7 @@ class RoundDispatcher:
         teardown): it cancels the asyncio futures of rounds that will never
         run, which is only legal loop-side."""
         self._closed = True
-        for _kind, _args, fut in self._pending:
+        for _kind, _args, fut, _meta in self._pending:
             if not fut.done():
                 fut.cancel()
         self._pending.clear()
@@ -476,6 +510,6 @@ class RoundDispatcher:
         self._pool.shutdown(wait=False, cancel_futures=True)
         for cf, batch in inflight:
             if cf.cancelled():
-                for _kind, _args, fut in batch:
+                for _kind, _args, fut, _meta in batch:
                     if not fut.done():
                         fut.cancel()

@@ -52,6 +52,16 @@ async def run_scheduler(
     await server.start()
     logger.info("scheduler listening on %s", server.address)
 
+    # loop-health sampling always on; with a round dispatcher configured the
+    # monitor also samples worker occupancy, so /debug/loop distinguishes
+    # "loop starved, workers idle" (glue-bound — ROADMAP #1) from "everything
+    # pegged" (genuinely out of cores)
+    from dragonfly2_tpu.observability.loophealth import default_monitor
+
+    loop_monitor = default_monitor()
+    if service.scheduling.dispatcher is not None:
+        loop_monitor.attach_dispatcher(service.scheduling.dispatcher)
+    loop_monitor.start()
     debug = None
     if metrics_port is not None:
         from dragonfly2_tpu.observability.server import start_debug_server
@@ -103,6 +113,7 @@ async def run_scheduler(
         await run_until_signalled(ready_event)
     finally:
         gc.stop()
+        loop_monitor.stop()
         if debug is not None:
             await debug.stop()
         if announcer is not None:
@@ -176,6 +187,7 @@ def main() -> None:
     configure_default_tracer(
         "dragonfly-scheduler",
         otlp_file=cfg.tracing.otlp_file, otlp_endpoint=cfg.tracing.otlp_endpoint,
+        trace_file=cfg.tracing.trace_file, sample_rate=cfg.tracing.sample_rate,
     )
     asyncio.run(
         run_scheduler(

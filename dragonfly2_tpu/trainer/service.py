@@ -75,6 +75,11 @@ class TrainSession:
     rows: int = 0  # running row count — O(1) per chunk, not a per-call re-sum
     opened_at: float = field(default_factory=time.time)
     last_activity: float = field(default_factory=time.time)
+    # trace context captured at train_close: the background train run
+    # outlives the RPC that queued it, so its spans parent to this context
+    # explicitly (the drainer task's own captured contextvar points at
+    # whichever close FIRST started it — wrong for every later run)
+    trace_ctx: Any = None
 
 
 @dataclass
@@ -152,6 +157,9 @@ class TrainerService:
         sess = self._sessions.pop(p["token"], None)
         if sess is None:
             raise KeyError(f"unknown train session {p['token']!r}")
+        from dragonfly2_tpu.observability.tracing import Tracer
+
+        sess.trace_ctx = Tracer.current_context()
         self._evict_stale()
         if self.cfg.pool_rows > 0:
             # commit the session's aggregates into the shared pool — the
@@ -243,23 +251,39 @@ class TrainerService:
             await self._train(sess)
 
     async def _train(self, sess: TrainSession) -> None:
+        from dragonfly2_tpu.observability.tracing import default_tracer
+
+        # parent = the trace of the train_close that queued this run: the
+        # announcer's upload root continues through ingest into the train
+        # and model publish, even though the RPC returned long ago
         try:
-            result = await self._run_training(sess)
-            self.last_result = result
-            self.trains_succeeded += 1
-            if self.manager is not None:
-                await self._register_models(sess, result)
+            with default_tracer().span(
+                "trainer.train_run", parent=sess.trace_ctx,
+                scheduler=sess.scheduler_hostname,
+            ) as sp:
+                result = await self._run_training(sess)
+                self.last_result = result
+                self.trains_succeeded += 1
+                if sp.sampled:
+                    sp.set_attr("version", result.get("version", ""))
+                    sp.set_attr("num_pairs", result.get("num_pairs", 0))
+                if self.manager is not None:
+                    with default_tracer().span("trainer.publish"):
+                        await self._register_models(sess, result)
         except Exception:
             logger.exception("training run failed")
             self.last_result = {"error": "training failed"}
 
     async def _run_training(self, sess: TrainSession) -> dict:
+        from dragonfly2_tpu.observability.tracing import default_tracer
+
         acc = sess.acc  # the pool it merged into at close; rotation-safe
         t_build = time.perf_counter()
         # freeze() is a cheap loop-side snapshot; the O(nodes+edges+pairs)
         # materialization runs on a worker thread while chunks keep folding
-        frozen = acc.freeze()
-        ds = await asyncio.to_thread(frozen.finalize)
+        with default_tracer().span("trainer.dataset_build"):
+            frozen = acc.freeze()
+            ds = await asyncio.to_thread(frozen.finalize)
         build_seconds = time.perf_counter() - t_build
         # monotonic suffix: the drainer starts queued runs back-to-back, so
         # two runs inside the same wall-clock second are the normal case and
@@ -275,9 +299,10 @@ class TrainerService:
         if ds.num_pairs >= self.cfg.min_pairs:
             tr, ev = datasetlib.split_pairs(ds.pairs)
             t0 = time.perf_counter()
-            params, evaluation = await asyncio.to_thread(
-                train_mlp.train, self.cfg.mlp, tr, eval_pairs=ev, log=logger.info
-            )
+            with default_tracer().span("trainer.train_mlp", pairs=ds.num_pairs):
+                params, evaluation = await asyncio.to_thread(
+                    train_mlp.train, self.cfg.mlp, tr, eval_pairs=ev, log=logger.info
+                )
             evaluation["train_seconds"] = round(time.perf_counter() - t0, 2)
             path = await asyncio.to_thread(
                 artifacts.save_artifact,
@@ -290,12 +315,13 @@ class TrainerService:
         if ds.num_pairs >= self.cfg.min_pairs and acc.probe_rows >= self.cfg.min_probe_rows:
             cfg = self.cfg.gnn
             t0 = time.perf_counter()
-            state, losses = await train_gnn.train_async(
-                cfg, ds.graph, ds.pairs,
-                steps=self.cfg.gnn_steps,
-                steps_per_call=self.cfg.gnn_steps_per_call,
-                log=logger.info,
-            )
+            with default_tracer().span("trainer.train_gnn", nodes=ds.num_nodes):
+                state, losses = await train_gnn.train_async(
+                    cfg, ds.graph, ds.pairs,
+                    steps=self.cfg.gnn_steps,
+                    steps_per_call=self.cfg.gnn_steps_per_call,
+                    log=logger.info,
+                )
             train_seconds = time.perf_counter() - t0
             evaluation = {
                 "final_loss": losses[-1] if losses else float("nan"),

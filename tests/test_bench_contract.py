@@ -65,6 +65,30 @@ def test_control_plane_contract():
     assert out["report_wire_us_per_piece_batched"] > 0
 
 
+def test_observability_contract():
+    # tiny shapes: pins the key set and the interleaved A/B wiring (rate 0
+    # vs the shipped default vs 1.0) the driver's observability JSON
+    # consumers read, not the real overhead numbers
+    out = bench.bench_observability(rounds=30, span_loops=2_000, pipeline_mb=8)
+    for key in (
+        "trace_span_unsampled_ns", "trace_span_sampled_ns",
+        "sched_round_rps_off", "sched_round_rps_default", "sched_round_rps_full",
+        "sched_round_default_overhead_pct",
+        "piece_pipeline_mb_per_s_off", "piece_pipeline_mb_per_s_default",
+        "piece_pipeline_default_overhead_pct", "trace_sample_rate_default",
+    ):
+        assert key in out, key
+    assert out["trace_span_unsampled_ns"] > 0
+    assert out["trace_span_sampled_ns"] > 0
+    assert out["sched_round_rps_off"] > 0
+    assert out["piece_pipeline_mb_per_s_off"] > 0
+    # the default tracer must be restored: later sections (and the rest of
+    # this test process) depend on it
+    from dragonfly2_tpu.observability.tracing import default_tracer
+
+    assert default_tracer().service != "bench"
+
+
 def test_payload_schema():
     line = bench._payload(1234.5, {"backend": "cpu"})
     d = json.loads(line)

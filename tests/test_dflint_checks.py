@@ -664,6 +664,72 @@ def test_df026_silent_on_unrelated_ctor_names():
 
 
 # ---------------------------------------------------------------------------
+# DF027 span without with
+
+
+def test_df027_fires_on_dropped_span_call():
+    src = """
+    from dragonfly2_tpu.observability.tracing import default_tracer
+
+    def f(tracer):
+        tracer.span("work", piece=3)
+        default_tracer().span("also-dropped")
+    """
+    assert ids(src) == ["DF027"]
+    assert len(lines(src)) == 2
+
+
+def test_df027_fires_on_assigned_and_awaited_shapes():
+    src = """
+    def f(self):
+        sp = self._tracer.span("stored")
+        return sp
+    """
+    assert ids(src) == ["DF027"]
+
+
+def test_df027_silent_on_with_usage():
+    src = """
+    from dragonfly2_tpu.observability.tracing import default_tracer
+
+    async def f(tracer, tr):
+        with tracer.span("a") as sp:
+            sp.set_attr("k", 1)
+        with default_tracer().span("b"), tr.span("c"):
+            pass
+    """
+    assert ids(src) == []
+
+
+def test_df027_silent_on_unrelated_span_attrs():
+    src = """
+    def f(doc, layout):
+        doc.span("not a tracer")
+        layout.row.span(3)
+    """
+    assert ids(src) == []
+
+
+def test_df027_suppression_with_reason():
+    src = """
+    def f(tracer):
+        sp = tracer.span("split-lifecycle")  # dflint: disable=DF027 closed by the response's prepare()
+        sp.__enter__()
+        return sp
+    """
+    assert ids(src) == []
+
+
+def test_df027_fires_inside_async_def_too():
+    src = """
+    async def f(tracer):
+        tracer.span("never-entered")
+        await do_work()
+    """
+    assert ids(src) == ["DF027"]
+
+
+# ---------------------------------------------------------------------------
 # DF031 silent swallow
 
 

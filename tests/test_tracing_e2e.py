@@ -1,0 +1,580 @@
+"""End-to-end distributed tracing (ISSUE 9): wire propagation, all-or-
+nothing head sampling, loop-health telemetry, dftrace reassembly, and the
+metrics thread-safety regression."""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import threading
+import time
+
+import pytest
+
+from dragonfly2_tpu.observability import tracing
+from dragonfly2_tpu.observability.loophealth import LoopHealthMonitor
+from dragonfly2_tpu.observability.metrics import MetricsRegistry
+from dragonfly2_tpu.observability.tracing import SpanContext, Tracer
+from dragonfly2_tpu.rpc.core import RpcClient, RpcError, RpcServer
+
+
+@pytest.fixture
+def swap_default_tracer(tmp_path):
+    """Point the process-global tracer at a per-test file (every service
+    component in-process records through default_tracer())."""
+    saved = tracing._default
+    path = tmp_path / "spans.jsonl"
+    tracer = Tracer(service="test-cluster", path=str(path))
+    tracing._default = tracer
+    yield tracer, path
+    tracer.close()
+    tracing._default = saved
+
+
+# ---------------------------------------------------------------------------
+# wire propagation
+
+
+class TestWirePropagation:
+    def test_traceparent_rides_the_rpc_frame(self, run, swap_default_tracer):
+        tracer, _path = swap_default_tracer
+        seen: list = []
+
+        async def body():
+            srv = RpcServer(port=0)
+
+            async def peek(p):
+                seen.append(Tracer.current_context())
+                return "ok"
+
+            srv.register("peek", peek)
+            await srv.start()
+            client = RpcClient(f"127.0.0.1:{srv.port}")
+            try:
+                with tracer.span("root") as root:
+                    await client.call("peek")
+                # no active trace → no "t" key → no server context
+                await client.call("peek")
+                return root
+            finally:
+                await client.close()
+                await srv.stop()
+
+        root = run(body())
+        assert seen[0] is not None
+        assert seen[0].trace_id == root.trace_id
+        assert seen[0].sampled
+        assert seen[1] is None
+        names = [s.name for s in tracer.finished()]
+        # server span exported before the client span (it finishes first)
+        assert names == ["rpc.server", "rpc.client", "root"]
+        by_name = {s.name: s for s in tracer.finished()}
+        assert by_name["rpc.client"].trace_id == root.trace_id
+        assert by_name["rpc.server"].parent_id == by_name["rpc.client"].span_id
+        assert by_name["rpc.client"].attrs["method"] == "peek"
+
+    def test_non_string_trace_field_still_gets_a_response(self, run):
+        """A skewed/hostile peer's non-string "t" must be ignored, not crash
+        the dispatch task — the old parse-before-try shape left the caller
+        hanging out its full timeout with no response frame."""
+        import struct
+
+        import msgpack
+
+        async def body():
+            srv = RpcServer(port=0)
+
+            async def echo(p):
+                return p
+
+            srv.register("echo", echo)
+            await srv.start()
+            reader, writer = await asyncio.open_connection("127.0.0.1", srv.port)
+            try:
+                body_b = msgpack.packb(
+                    {"i": 7, "m": "echo", "p": "x", "t": 5}, use_bin_type=True
+                )
+                writer.write(struct.pack(">I", len(body_b)) + body_b)
+                await writer.drain()
+                header = await asyncio.wait_for(reader.readexactly(4), 5)
+                (length,) = struct.unpack(">I", header)
+                resp = msgpack.unpackb(
+                    await asyncio.wait_for(reader.readexactly(length), 5), raw=False
+                )
+                return resp
+            finally:
+                writer.close()
+                await srv.stop()
+
+        resp = run(body())
+        assert resp == {"i": 7, "r": "x"}
+
+    def test_retry_attempts_each_get_a_client_span(self, run, swap_default_tracer):
+        tracer, _path = swap_default_tracer
+        server_traces: list = []
+
+        async def body():
+            srv = RpcServer(port=0)
+            calls = {"n": 0}
+
+            async def flaky(p):
+                server_traces.append(Tracer.current_context())
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise RpcError("busy", code="resource_exhausted")
+                return "ok"
+
+            srv.register("flaky", flaky)
+            await srv.start()
+            client = RpcClient(f"127.0.0.1:{srv.port}", retry_backoff=0.01)
+            try:
+                with tracer.span("root") as root:
+                    assert await client.call("flaky") == "ok"
+                return root
+            finally:
+                await client.close()
+                await srv.stop()
+
+        root = run(body())
+        # both attempts carried the SAME trace; each attempt was its own span
+        assert [c.trace_id for c in server_traces] == [root.trace_id] * 2
+        client_spans = [s for s in tracer.finished() if s.name == "rpc.client"]
+        assert [s.attrs["attempt"] for s in client_spans] == [0, 1]
+
+    def test_balancer_passes_context_through_and_avoids_open_breaker(
+        self, run, swap_default_tracer, tmp_path
+    ):
+        """Failover shape: scheduler A's breaker is open, so a NEW task
+        routes to B — and B's server continues the caller's trace."""
+        from dragonfly2_tpu.rpc.balancer import BalancedSchedulerClient
+        from dragonfly2_tpu.rpc.scheduler import serve_scheduler
+        from dragonfly2_tpu.scheduler.service import HostInfo, SchedulerService, TaskMeta
+
+        tracer, _path = swap_default_tracer
+
+        async def body():
+            svc_b = SchedulerService()
+            server_b = serve_scheduler(svc_b)
+            await server_b.start()
+            dead_addr = "127.0.0.1:1"  # nothing listens here
+            live_addr = f"127.0.0.1:{server_b.port}"
+            bal = BalancedSchedulerClient([dead_addr, live_addr])
+            try:
+                # trip the dead address's breaker so ring picks walk past it
+                dead_client = bal._client(dead_addr)
+                for _ in range(10):
+                    dead_client.breaker.record_failure()
+                assert dead_client.breaker.is_open
+                meta = TaskMeta("trace-task", "http://origin/x.bin")
+                host = HostInfo(id="h1", ip="127.0.0.1", hostname="h1", download_port=1234)
+                with tracer.span("root") as root:
+                    await bal.register_peer("p1", meta, host)
+                return root
+            finally:
+                await bal.close()
+                await server_b.stop()
+
+        root = body and run(body())
+        server_spans = [s for s in tracer.finished() if s.name == "rpc.server"]
+        assert server_spans and server_spans[0].trace_id == root.trace_id
+
+    def test_in_process_client_continues_the_trace(self, run, swap_default_tracer):
+        """InProcessSchedulerClient is a same-task call: the contextvar
+        carries the trace without any wire context — the scheduler's own
+        spans must join the caller's trace."""
+        from dragonfly2_tpu.daemon.engine import InProcessSchedulerClient
+        from dragonfly2_tpu.scheduler.service import HostInfo, SchedulerService, TaskMeta
+
+        tracer, _path = swap_default_tracer
+
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            meta = TaskMeta("inproc-task", "http://origin/y.bin")
+            parent_host = HostInfo(id="hp", ip="127.0.0.1", hostname="hp", download_port=1)
+            host = HostInfo(id="h2", ip="127.0.0.2", hostname="h2", download_port=1)
+            # seed a finished parent so the child's registration reaches the
+            # NORMAL scheduling round (the span under test) instead of the
+            # back-to-source shortcut
+            await client.register_peer("pparent", meta, parent_host)
+            await client.report_task_metadata(
+                meta.task_id, content_length=1 << 30, piece_size=4 << 20
+            )
+            await client.report_peer_result("pparent", success=True)
+            with tracer.span("root") as root:
+                await client.register_peer("p2", meta, host)
+            return root
+
+        root = run(body())
+        sched_spans = [s for s in tracer.finished() if s.name == "scheduler.schedule"]
+        assert sched_spans and sched_spans[0].trace_id == root.trace_id
+
+
+# ---------------------------------------------------------------------------
+# head sampling
+
+
+class TestSampling:
+    def test_all_or_nothing_locally(self):
+        draws = iter([0.9, 0.1])  # first root unsampled, second sampled
+        tr = Tracer(service="s", sample_rate=0.5, rng=lambda: next(draws))
+        with tr.span("r1") as r1:
+            with tr.span("c1") as c1:
+                assert not c1.sampled
+        assert not r1.sampled
+        assert tr.finished() == []
+        with tr.span("r2"):
+            with tr.span("c2"):
+                pass
+        assert [s.name for s in tr.finished()] == ["c2", "r2"]
+
+    def test_unsampled_flag_rides_the_wire(self, run, swap_default_tracer):
+        """A rate-0 caller's context still propagates (flag 00): the server
+        must CONTINUE the unsampled decision, not open a fresh root —
+        that is what makes a trace all-or-nothing across processes."""
+        tracer, _path = swap_default_tracer
+        client_tr = Tracer(service="cold-client", sample_rate=0.0)
+
+        async def body():
+            srv = RpcServer(port=0)
+
+            async def handler(p):
+                # a service-side span opened during the handler must inherit
+                # the unsampled decision through the server span's context
+                with tracer.span("service.work") as sp:
+                    assert not sp.sampled
+                return "ok"
+
+            srv.register("m", handler)
+            await srv.start()
+            client = RpcClient(f"127.0.0.1:{srv.port}")
+            try:
+                with client_tr.span("root") as root:
+                    assert not root.sampled
+                    await client.call("m")
+            finally:
+                await client.close()
+                await srv.stop()
+
+        run(body())
+        assert tracer.finished() == []  # nothing recorded anywhere
+        assert client_tr.finished() == []
+
+    def test_traceparent_flag_roundtrip(self):
+        on = SpanContext("a" * 32, "b" * 16, sampled=True)
+        off = SpanContext("a" * 32, "b" * 16, sampled=False)
+        assert on.traceparent().endswith("-01")
+        assert off.traceparent().endswith("-00")
+        assert SpanContext.from_traceparent(on.traceparent()).sampled
+        assert not SpanContext.from_traceparent(off.traceparent()).sampled
+
+    def test_no_timer_threads_for_otlp_age_flush(self, tmp_path):
+        """Satellite regression: the age flush must ride the single
+        long-lived exporter worker, never a threading.Timer per batch."""
+        tr = Tracer(
+            service="t", otlp_path=str(tmp_path / "o.jsonl"), otlp_max_age_s=0.2
+        )
+        for i in range(5):
+            with tr.span(f"s{i}"):
+                pass
+        timers = [t for t in threading.enumerate() if isinstance(t, threading.Timer)]
+        assert timers == []
+        workers = [
+            t for t in threading.enumerate()
+            if t is not threading.main_thread() and t.daemon
+        ]
+        # ONE exporter worker serves both POSTs and the age flush
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if (tmp_path / "o.jsonl").exists() and (tmp_path / "o.jsonl").read_text().strip():
+                break
+            time.sleep(0.05)
+        assert (tmp_path / "o.jsonl").read_text().strip(), "age flush never exported"
+        assert len(workers) >= 1
+        tr.close()
+
+
+# ---------------------------------------------------------------------------
+# loop health
+
+
+class TestLoopHealth:
+    def test_lag_detected_under_blocked_loop(self, run):
+        reg = MetricsRegistry("lh")
+        mon = LoopHealthMonitor(interval=0.05, registry=reg)
+
+        async def body():
+            mon.start()
+            await asyncio.sleep(0.2)  # healthy samples
+            time.sleep(0.4)  # dflint: disable=DF022 the test BLOCKS the loop on purpose to create lag
+            await asyncio.sleep(0.15)  # let the post-stall tick run
+            mon.stop()
+
+        run(body())
+        stats = mon.stats()
+        assert stats["samples"] >= 3
+        assert stats["lag_max_ms"] >= 300.0  # the block showed up
+        assert stats["lag_p50_ms"] < 100.0  # healthy ticks dominate
+        assert "lag_seconds" in reg.render_text().replace("lh_loop_", "")
+
+    def test_dispatcher_utilization_probe(self, run):
+        class FakeDispatcher:
+            busy = 2
+            workers = 4
+
+        mon = LoopHealthMonitor(interval=0.02)
+        mon.attach_dispatcher(FakeDispatcher())
+
+        async def body():
+            mon.start()
+            await asyncio.sleep(0.15)
+            mon.stop()
+
+        run(body())
+        stats = mon.stats()
+        assert stats["dispatcher_utilization_p50"] == 0.5
+
+    def test_debug_loop_endpoint(self, run):
+        from aiohttp import ClientSession
+
+        from dragonfly2_tpu.observability.server import start_debug_server
+
+        mon = LoopHealthMonitor(interval=0.02, registry=MetricsRegistry("dl"))
+
+        async def body():
+            mon.start()
+            srv = await start_debug_server(loophealth=mon)
+            try:
+                await asyncio.sleep(0.1)
+                async with ClientSession() as sess:
+                    async with sess.get(
+                        f"http://127.0.0.1:{srv.port}/debug/loop"
+                    ) as r:
+                        assert r.status == 200
+                        stats = await r.json()
+                # sampling profile mode must cover non-loop threads
+                evt = threading.Event()
+
+                def spin():
+                    while not evt.is_set():
+                        sum(range(2000))
+
+                t = threading.Thread(target=spin, name="df-test-spin", daemon=True)  # dflint: disable=DF026 the test NEEDS a live non-loop thread for the sampler to find
+                t.start()
+                try:
+                    async with ClientSession() as sess:
+                        async with sess.get(
+                            f"http://127.0.0.1:{srv.port}/debug/profile"
+                            "?mode=sample&seconds=0.3&hz=100"
+                        ) as r:
+                            assert r.status == 200
+                            text = await r.text()
+                finally:
+                    evt.set()
+                    t.join()
+                return stats, text
+            finally:
+                mon.stop()
+                await srv.stop()
+
+        stats, text = run(body())
+        assert stats["running"] and stats["samples"] >= 1
+        assert "df-test-spin" in text  # cProfile could never see this thread
+
+
+# ---------------------------------------------------------------------------
+# metrics thread safety
+
+
+class TestMetricsThreadSafety:
+    def test_counter_inc_is_exact_under_thread_contention(self):
+        """Regression for the PR 7 hole: dispatcher worker threads inc
+        counters, and a bare += loses updates when the GIL preempts between
+        the read and the write. With a tiny switch interval the old code
+        loses thousands of increments; the locked child must be exact."""
+        reg = MetricsRegistry("race")
+        c = reg.counter("hits")
+        h = reg.histogram("lat", buckets=(0.5, 1.0))
+        child = c.labels()
+        hchild = h.labels()
+        n_threads, per_thread = 4, 20_000
+        old = sys.getswitchinterval()
+        sys.setswitchinterval(1e-6)
+        try:
+            def work():
+                for _ in range(per_thread):
+                    child.inc()
+                    hchild.observe(0.25)
+
+            threads = [threading.Thread(target=work) for _ in range(n_threads)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            sys.setswitchinterval(old)
+        assert child.value == n_threads * per_thread
+        assert hchild.count == n_threads * per_thread
+        assert hchild.counts[0] == n_threads * per_thread
+
+
+# ---------------------------------------------------------------------------
+# the cluster acceptance test
+
+
+class TestClusterTrace:
+    def test_one_trace_spans_dfget_daemon_scheduler_parent(
+        self, run, tmp_path, swap_default_tracer
+    ):
+        """ISSUE 9 acceptance: client daemon + wire scheduler + seed daemon
+        → ONE trace_id from the dfget-shaped entry through the daemon RPC,
+        the conductor, the scheduler's round, and the parent daemon's piece
+        serves; dftrace reconstructs a critical path whose exclusive stage
+        durations sum to ≈ the measured wall time."""
+        from dragonfly2_tpu.cli import dftrace
+        from dragonfly2_tpu.daemon.conductor import ConductorConfig
+        from dragonfly2_tpu.daemon.engine import PeerEngine
+        from dragonfly2_tpu.daemon.server import DAEMON_METHODS, DaemonRpcAdapter
+        from dragonfly2_tpu.rpc.scheduler import RemoteSchedulerClient, serve_scheduler
+        from dragonfly2_tpu.scheduler.service import SchedulerService
+        from tests.test_e2e import Origin
+
+        tracer, span_path = swap_default_tracer
+        payload = bytes(range(256)) * (40 * 1024)  # 10 MiB -> 3 pieces
+
+        async def body():
+            svc = SchedulerService()
+            sched_server = serve_scheduler(svc)
+            await sched_server.start()
+            clients = []
+
+            def wire_client():
+                c = RemoteSchedulerClient(f"127.0.0.1:{sched_server.port}", timeout=10.0)
+                clients.append(c)
+                return c
+
+            cfg = ConductorConfig(metadata_poll_interval=0.02, piece_timeout=10.0)
+            async with Origin({"f.bin": payload}) as origin:
+                url = origin.url("f.bin")
+                seed = PeerEngine(
+                    storage_root=tmp_path / "seed", scheduler=wire_client(),
+                    hostname="seed", conductor_config=cfg,
+                )
+                client_engine = PeerEngine(
+                    storage_root=tmp_path / "client", scheduler=wire_client(),
+                    hostname="client", conductor_config=cfg,
+                )
+                await seed.start()
+                await client_engine.start()
+                daemon_rpc = RpcServer(port=0)
+                daemon_rpc.register_service(
+                    DaemonRpcAdapter(client_engine), DAEMON_METHODS
+                )
+                await daemon_rpc.start()
+                dfget_client = RpcClient(f"127.0.0.1:{daemon_rpc.port}", timeout=60.0)
+                try:
+                    await seed.download_task(url)  # its own trace (seeding)
+                    out = tmp_path / "out.bin"
+                    t0 = time.monotonic()
+                    with tracer.span("dfget.download", url=url) as root:
+                        await dfget_client.call(
+                            "download", {"url": url, "output": str(out)}
+                        )
+                    wall_s = time.monotonic() - t0
+                    assert out.read_bytes() == payload
+                    return root, wall_s
+                finally:
+                    await dfget_client.close()
+                    await daemon_rpc.stop()
+                    await client_engine.stop()
+                    await seed.stop()
+                    for c in clients:
+                        await c.close()
+                    await sched_server.stop()
+
+        root, wall_s = run(body())
+        tracer.close()
+
+        spans = dftrace.load_spans([str(span_path)])
+        traces = dftrace.assemble_traces(spans)
+        trace = traces[root.trace_id]
+        names = {s["name"] for s in trace}
+        # one trace_id across every hop of the chain
+        assert "dfget.download" in names          # dfget entry
+        assert "rpc.client" in names              # dfget→daemon + daemon→scheduler
+        assert "rpc.server" in names
+        assert "daemon.peer_task" in names        # the engine's task span
+        assert "scheduler.schedule" in names      # the scheduler's round
+        assert "scheduler.round" in names
+        assert "conductor.dispatch_round" in names
+        assert "conductor.piece" in names         # per-piece with stage attrs
+        assert "upload.serve_piece" in names      # the PARENT daemon's serve
+        assert "conductor.report_flush" in names  # report-buffer flush
+
+        # piece spans carry the pipeline stage decomposition
+        piece_spans = [s for s in trace if s["name"] == "conductor.piece"]
+        assert any("recv_ms" in s["attrs"] for s in piece_spans)
+        assert all(s["attrs"].get("parent_peer") or s["attrs"].get("path") == "origin"
+                   for s in piece_spans)
+
+        # dftrace critical path: exclusive times sum to the root's duration,
+        # and the root's duration is the measured wall time
+        path = dftrace.critical_path(trace)
+        assert path[0][0]["name"] == "dfget.download"
+        excl_sum = sum(e for _s, e in path)
+        root_ms = path[0][0]["duration_ms"]
+        assert excl_sum == pytest.approx(root_ms, rel=0.01)
+        assert root_ms == pytest.approx(wall_s * 1e3, rel=0.25, abs=50.0)
+
+        # the stage table sees every instrumented stage
+        stage_names = {row["name"] for row in dftrace.stage_table(trace)}
+        assert {"conductor.piece", "rpc.client", "scheduler.round"} <= stage_names
+
+
+# ---------------------------------------------------------------------------
+# dftrace unit behavior
+
+
+class TestDftrace:
+    def test_merges_jsonl_and_otlp_files(self, tmp_path):
+        from dragonfly2_tpu.cli import dftrace
+
+        a = Tracer(service="svc-a", path=str(tmp_path / "a.jsonl"))
+        b = Tracer(
+            service="svc-b", otlp_path=str(tmp_path / "b.otlp.jsonl"), otlp_batch=100
+        )
+        with a.span("root") as root:
+            with b.span(
+                "remote.child", parent=Tracer.current_context(),
+                k=1, dispatched=False, queue_wait_ms=0.0, piece=5,
+            ):
+                time.sleep(0.002)
+        a.close()
+        b.flush_otlp()
+        b.close()
+        spans = dftrace.load_spans([str(tmp_path / "a.jsonl"), str(tmp_path / "b.otlp.jsonl")])
+        traces = dftrace.assemble_traces(spans)
+        assert set(traces) == {root.trace_id}
+        merged = traces[root.trace_id]
+        assert {s["name"] for s in merged} == {"root", "remote.child"}
+        by_name = {s["name"]: s for s in merged}
+        assert by_name["remote.child"]["attrs"]["service"] == "svc-b"
+        # typed attrs survive the OTLP roundtrip — including falsy values
+        # and int64s (JSON strings on the wire, ints back out)
+        child_attrs = by_name["remote.child"]["attrs"]
+        assert child_attrs["dispatched"] is False
+        assert child_attrs["queue_wait_ms"] == 0.0
+        assert child_attrs["piece"] == 5
+        path = dftrace.critical_path(merged)
+        assert [s["name"] for s, _e in path] == ["root", "remote.child"]
+
+    def test_skips_torn_lines(self, tmp_path):
+        from dragonfly2_tpu.cli import dftrace
+
+        p = tmp_path / "torn.jsonl"
+        p.write_text(
+            '{"trace_id": "t1", "span_id": "s1", "parent_id": "", "name": "a", '
+            '"start": 1.0, "duration_ms": 5.0, "attrs": {}}\n{"trace_id": "t1", "spa'
+        )
+        spans = dftrace.load_spans([str(p)])
+        assert len(spans) == 1

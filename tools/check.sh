@@ -105,5 +105,50 @@ run_stage "concurrency-smoke" env JAX_PLATFORMS=cpu python -m pytest tests/test_
     -m 'concurrency and not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+# observability-smoke: one trace over the REAL rpc wire into two per-process
+# span files, reassembled by dftrace — propagation, all-or-nothing sampling,
+# and the critical-path identity (exclusive times sum to the root's wall)
+# in one shot, without paying for the full tier-1 tracing suite again.
+run_stage "observability-smoke" env JAX_PLATFORMS=cpu python -c "
+import asyncio, json, os, tempfile
+from dragonfly2_tpu.observability import tracing
+from dragonfly2_tpu.rpc.core import RpcClient, RpcServer
+
+d = tempfile.mkdtemp(prefix='df-obs-smoke-')
+fa, fb = os.path.join(d, 'client.jsonl'), os.path.join(d, 'server.jsonl')
+
+async def run():
+    server_tr = tracing.Tracer(service='smoke-server', path=fb)
+    client_tr = tracing.Tracer(service='smoke-client', path=fa)
+    tracing._default = server_tr  # rpc.server spans land in the server file
+    srv = RpcServer(port=0)
+    async def echo(p):
+        with server_tr.span('smoke.work'):
+            await asyncio.sleep(0.01)
+        return p
+    srv.register('echo', echo)
+    await srv.start()
+    client = RpcClient(f'127.0.0.1:{srv.port}')
+    with client_tr.span('smoke.root') as root:
+        assert root.sampled
+        await client.call('echo', {'x': 1})
+    await client.close(); await srv.stop()
+    client_tr.close(); server_tr.close()
+    return root.trace_id
+
+tid = asyncio.run(run())
+from dragonfly2_tpu.cli import dftrace
+spans = dftrace.load_spans([fa, fb])
+traces = dftrace.assemble_traces(spans)
+assert list(traces) == [tid], (list(traces), tid)
+path = dftrace.critical_path(traces[tid])
+names = [s['name'] for s, _ in path]
+assert names[:3] == ['smoke.root', 'rpc.client', 'rpc.server'], names
+wall = path[0][0]['duration_ms']
+excl = sum(e for _s, e in path)
+assert abs(excl - wall) < 0.01, (excl, wall)
+print('observability smoke ok:', {'trace': tid[:8], 'path': names, 'wall_ms': round(wall, 2)})
+"
+
 summarize
 echo "check.sh: all stages passed"

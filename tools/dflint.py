@@ -84,6 +84,7 @@ CHECKS: dict[str, str] = {
     "DF024": "raw asyncio.sleep retry loop outside the resilience module",
     "DF025": "awaited per-item RPC call inside a loop outside rpc/ (batch it)",
     "DF026": "Thread/ThreadPoolExecutor constructed on a hot path (pool churn)",
+    "DF027": "Tracer.span(...) not used as a `with` context manager (leaked span)",
     "DF031": "bare/overbroad except silently swallowing the error",
     "DF032": "mutable default argument",
     "DF033": "per-row numpy array construction inside a for loop (vectorize)",
@@ -856,6 +857,54 @@ def check_thread_churn(tree: ast.Module, path: str) -> Iterator[Violation]:
                     )
 
 
+def check_span_without_with(tree: ast.Module, path: str) -> Iterator[Violation]:
+    """DF027: a `Tracer.span(...)` call not used as a `with` context manager.
+
+    A Span only exports (and only resets the contextvar) in __exit__: a
+    span() call whose result is dropped, stored, or awaited past never
+    finishes — the trace silently loses the segment AND every later span in
+    that task parents to a ghost. The tracer API is with-only by design
+    (observability/tracing.py); the one legitimate split-enter/exit shape
+    (a span closed by a different callback, e.g. upload's sendfile span)
+    suppresses with a reason.
+
+    Receiver heuristic: `<anything>.span(...)` where the receiver is a
+    `default_tracer()`/`Tracer(...)` call or a name whose last segment
+    mentions "tracer" (tracer, self._tracer, tr). Unrelated .span attributes
+    on other objects don't match the heuristic."""
+    aliases = import_aliases(tree)
+
+    def tracerish(recv: ast.AST) -> bool:
+        if isinstance(recv, ast.Call):
+            name = _resolved_call_name(recv, aliases).rsplit(".", 1)[-1]
+            return name in {"default_tracer", "Tracer"}
+        name = dotted(recv).rsplit(".", 1)[-1].lower()
+        return "tracer" in name or name == "tr"
+
+    def is_span_call(node: ast.AST) -> bool:
+        return (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "span"
+            and tracerish(node.func.value)
+        )
+
+    with_items: set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                with_items.add(id(item.context_expr))
+
+    for node in ast.walk(tree):
+        if is_span_call(node) and id(node) not in with_items:
+            yield Violation(
+                path, node.lineno, node.col_offset, "DF027",
+                "span() result must enter a `with` block (Span exports and "
+                "resets the context only in __exit__; anything else leaks an "
+                "unfinished span)",
+            )
+
+
 _BROAD = {"Exception", "BaseException"}
 
 
@@ -981,6 +1030,7 @@ ALL_CHECKS = (
     check_raw_retry_sleep,
     check_rpc_in_loop,
     check_thread_churn,
+    check_span_without_with,
     check_silent_swallow,
     check_mutable_defaults,
     check_np_ctor_in_row_loop,
