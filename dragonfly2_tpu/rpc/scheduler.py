@@ -33,6 +33,8 @@ SCHEDULER_METHODS = [
     "announce_host",
     "stat_task",
     "sync_probes",
+    "federation_sync",
+    "federation_state",
 ]
 
 
@@ -129,6 +131,25 @@ class SchedulerRpcAdapter:
     async def sync_probes(self, p: dict) -> list[dict]:
         return self.svc.sync_probes(p["host_id"], p.get("results", []))
 
+    async def federation_sync(self, p: dict) -> dict:
+        from dragonfly2_tpu.observability.tracing import default_tracer
+
+        # named span on the RESPONDER (continues the initiator's
+        # federation.sync trace): a cluster trace shows the gossip exchange
+        # on BOTH members, which the federation-smoke leg asserts
+        with default_tracer().span("federation.apply", origin=p.get("origin", "")):
+            return self.svc.federation_sync(
+                p.get("origin", ""),
+                topo_since=p.get("topo_since", 0),
+                bw_since=p.get("bw_since", 0),
+                topo_push=p.get("topo_push"),
+                bw_push=p.get("bw_push"),
+                epoch=p.get("epoch", ""),
+            )
+
+    async def federation_state(self, p: Any = None) -> dict:
+        return self.svc.federation_state()
+
 
 def serve_scheduler(service: SchedulerService, **server_kw: Any) -> RpcServer:
     server = RpcServer(**server_kw)
@@ -214,6 +235,21 @@ class RemoteSchedulerClient:
 
     async def sync_probes(self, host_id: str, results: list[dict]):
         return await self._rpc.call("sync_probes", {"host_id": host_id, "results": results})
+
+    async def federation_sync(
+        self, origin: str, *, topo_since=0, bw_since=0, topo_push=None,
+        bw_push=None, epoch="",
+    ):
+        """Scheduler-to-scheduler push-pull gossip exchange (federation.py)."""
+        return await self._rpc.call(
+            "federation_sync",
+            {"origin": origin, "topo_since": topo_since, "bw_since": bw_since,
+             "topo_push": topo_push or [], "bw_push": bw_push or [],
+             "epoch": epoch},
+        )
+
+    async def federation_state(self):
+        return await self._rpc.call("federation_state")
 
     async def healthy(self) -> bool:
         return await self._rpc.healthy()

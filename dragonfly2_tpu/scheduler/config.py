@@ -54,6 +54,10 @@ class SchedulerYaml:
     manager: Optional[str] = cfgfield(None, help="manager address host:port")
     trainer: Optional[str] = cfgfield(None, help="trainer address host:port")
     trainer_interval: Optional[float] = cfgfield(None, minimum=1.0)
+    federation_peers: Optional[str] = cfgfield(
+        None, help='peer scheduler addresses "host:port,...", or "auto" (manager-fed)'
+    )
+    federation_interval: Optional[float] = cfgfield(None, minimum=0.1)
     scheduling: SchedulingSection = cfgfield(default_factory=SchedulingSection)
     gc: GCSection = cfgfield(default_factory=GCSection)
     tracing: TracingSection = cfgfield(default_factory=TracingSection)

@@ -132,6 +132,9 @@ class ManagerLink:
         self._active_model_version: str | None = None
         self.scheduler_id: int | None = None
         self.cluster_id: int | None = None
+        # live scheduler address book from dynconfig — the federation layer's
+        # membership source (same list the daemons' balancer resolver polls)
+        self.scheduler_addresses: list[str] = []
         self.seed_connector = SeedPeerConnector(service)
         self.dynconfig = Dynconfig(
             self._fetch_cluster_config,
@@ -147,6 +150,17 @@ class ManagerLink:
 
     def _on_config(self, cfg: dict) -> None:
         self.seed_connector.update_address_book(cfg.get("seed_peers") or [])
+        self.scheduler_addresses = [
+            f"{s['ip']}:{s['port']}"
+            for s in (cfg.get("schedulers") or [])
+            if s.get("ip") and s.get("port")
+        ]
+
+    def federation_peers(self) -> list[str]:
+        """Live ring members excluding this scheduler — FederationSync's
+        peers_fn when membership is manager-fed."""
+        me = f"{self.ip}:{self.port}"
+        return [a for a in self.scheduler_addresses if a != me]
 
     async def start(self) -> None:
         """Register with the manager, start keepalive + dynconfig + job loops,
@@ -261,6 +275,11 @@ class ManagerLink:
 
     async def _check_model(self) -> None:
         row = await self.manager.active_model("gnn", self.scheduler_id or 0)
+        if row is None and self.scheduler_id:
+            # federation: ONE trainer ingests every member's telemetry and
+            # publishes a single cluster-wide model under scheduler_id 0 —
+            # fall back to it when no per-scheduler version exists
+            row = await self.manager.active_model("gnn", 0)
         if row is None or row["version"] == self._active_model_version:
             return
         path = row.get("artifact_path", "")

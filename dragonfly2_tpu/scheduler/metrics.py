@@ -90,3 +90,31 @@ ML_BASE_FALLBACK_TOTAL = _r.counter(
     subsystem="scheduler",
     labels=("reason",),
 )
+# Scheduler federation (ISSUE 10): push-pull topology/bandwidth gossip
+# between ring members. Sent/received counts are DELTA entries (edges +
+# bandwidth pairs), so steady-state rates near zero are the health signal
+# that watermarking works — O(all edges) payloads every tick would show up
+# here immediately.
+FEDERATION_SYNCS_TOTAL = _r.counter(
+    "federation_syncs_total", "Federation sync rounds by outcome",
+    subsystem="scheduler", labels=("result",),
+)
+FEDERATION_DELTAS_SENT_TOTAL = _r.counter(
+    "federation_deltas_sent_total",
+    "Topology/bandwidth delta entries pushed or served to peer schedulers",
+    subsystem="scheduler",
+)
+FEDERATION_DELTAS_APPLIED_TOTAL = _r.counter(
+    "federation_deltas_applied_total",
+    "Peer delta entries merged into the local topology/bandwidth view",
+    subsystem="scheduler",
+)
+FEDERATION_PEERS_GAUGE = _r.gauge(
+    "federation_peers", "Peer schedulers currently in the sync set",
+    subsystem="scheduler",
+)
+FEDERATION_LAST_SYNC_TIMESTAMP = _r.gauge(
+    "federation_last_sync_timestamp_seconds",
+    "Unix time of the last successful federation sync (0 = never)",
+    subsystem="scheduler",
+)

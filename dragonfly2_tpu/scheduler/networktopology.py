@@ -40,6 +40,22 @@ class ProbeTarget:
     port: int  # upload (piece server) port — what daemons can reach
 
 
+@dataclass
+class RemoteEdge:
+    """A peer scheduler's view of one (src, dst) edge, merged in by the
+    federation sync (scheduler/federation.py). Stats only — the sample deque
+    stays on the scheduler that ingested the probes; telemetry rows are
+    emitted exactly once, by the origin (each scheduler trains on what IT
+    ingested, the trainer merges across uploads)."""
+
+    avg_ms: float
+    std_ms: float
+    min_ms: float
+    probed_count: int
+    updated_at: float
+    origin: str = ""
+
+
 class EdgeProbes:
     """Bounded FIFO of RTT samples for one (src, dst) edge (ref probes.go).
 
@@ -101,6 +117,20 @@ class NetworkTopology:
         # pops): a host id recycled after GC must not collide a fresh count
         # with a stale cached row keyed on the same small number.
         self._pair_vers: dict[tuple[str, str], int] = {}
+        # Federation delta clock (shared semantics: utils/deltaclock.py):
+        # every LOCAL mutation (enqueue/forget) stamps its directed edge key
+        # with the post-bump coarse `version`, so local_edges_since(w) can
+        # ship exactly the edges a peer has not seen. Keys of deleted edges
+        # KEEP their deletion stamp (tombstone: stamped but not in _edges).
+        # Remote merges are deliberately NOT stamped — merged data must
+        # never be re-gossiped (each edge has one origin; full-mesh pull
+        # converges in one hop).
+        from dragonfly2_tpu.utils.deltaclock import DeltaClock
+
+        self._clock = DeltaClock()
+        # Peer schedulers' edges, keyed like _edges; consulted by avg_rtt_ms
+        # when no local probes exist for either direction of the pair.
+        self._remote: dict[tuple[str, str], RemoteEdge] = {}
 
     # ---- store ----
 
@@ -130,6 +160,7 @@ class NetworkTopology:
         edge.enqueue(rtt_ms)
         self.version += 1
         self._bump_pair(src_host_id, dst_host_id)
+        self._clock.stamp(key, self.version)
         if self.telemetry is not None:
             self.telemetry.probes.append(
                 src_host_id=src_host_id.encode()[:64],
@@ -142,14 +173,24 @@ class NetworkTopology:
 
     def avg_rtt_ms(self, src_host_id: str, dst_host_id: str) -> Optional[float]:
         """Average RTT on the directed edge; falls back to the reverse edge
-        (RTT is roughly symmetric and either end may have probed first)."""
+        (RTT is roughly symmetric and either end may have probed first), then
+        to the federation's merged remote view — probes for this pair may
+        only ever have been reported to a peer scheduler (the balancer routes
+        each host's sync_probes to ONE ring owner)."""
         edge = self._edges.get((src_host_id, dst_host_id))
         if edge is None or not edge.rtts_ms:
             edge = self._edges.get((dst_host_id, src_host_id))
-        return edge.avg_ms if edge is not None and edge.rtts_ms else None
+        if edge is not None and edge.rtts_ms:
+            return edge.avg_ms
+        remote = self._remote.get((src_host_id, dst_host_id)) \
+            or self._remote.get((dst_host_id, src_host_id))
+        return remote.avg_ms if remote is not None else None
 
     def edge_count(self) -> int:
         return len(self._edges)
+
+    def remote_edge_count(self) -> int:
+        return len(self._remote)
 
     def forget_host(self, host_id: str) -> int:
         """Drop edges touching a GC'd host."""
@@ -157,7 +198,79 @@ class NetworkTopology:
         for k in dead:
             del self._edges[k]
             self._bump_pair(*k)
+            self.version += 1
+            self._clock.stamp(k, self.version)  # tombstone: gossiped as a delete
+        for k in [k for k in self._remote if host_id in k]:
+            del self._remote[k]
+            self._bump_pair(*k)
+            self.version += 1
         if dead:
+            self._clock.prune(self._edges.__contains__)
+        return len(dead)
+
+    # ---- federation delta sync (scheduler/federation.py) ----
+
+    def local_edges_since(self, since: int) -> tuple[int, list[dict]]:
+        """(watermark, deltas): every LOCALLY-mutated edge whose stamp is
+        above `since` — live edges ship their published stats, deleted edges
+        ship a tombstone. The payload is O(edges changed since the peer's
+        watermark), which is what makes steady-state gossip cheap (the bench
+        counter-asserts this); the enumeration itself scans the seq map."""
+        out = []
+        for key in self._clock.since(since):
+            edge = self._edges.get(key)
+            if edge is None or not edge.rtts_ms:
+                out.append({"src": key[0], "dst": key[1], "deleted": True})
+            else:
+                out.append({
+                    "src": key[0], "dst": key[1],
+                    "avg_ms": edge.avg_ms, "std_ms": edge.std_ms,
+                    "min_ms": edge.min_ms, "probed_count": edge.probed_count,
+                    "updated_at": edge.updated_at,
+                })
+        return self.version, out
+
+    def merge_remote(self, edges: list[dict], *, origin: str = "") -> int:
+        """Apply a peer's delta batch into the remote view. Idempotent (a
+        retransmitted batch re-applies to the same state) and monotonic per
+        edge (an older updated_at never overwrites a newer one, so two sync
+        paths racing can't flap the merged stats). Bumps pair versions so
+        the evaluator's cached pair rows re-assemble with the merged RTT.
+        Returns the number of entries that changed local state."""
+        applied = 0
+        for e in edges:
+            key = (e["src"], e["dst"])
+            if e.get("deleted"):
+                if self._remote.pop(key, None) is not None:
+                    applied += 1
+                    self.version += 1
+                    self._bump_pair(*key)
+                continue
+            prev = self._remote.get(key)
+            if prev is not None and prev.updated_at > e["updated_at"]:
+                continue
+            if prev is not None and prev.updated_at == e["updated_at"] \
+                    and prev.probed_count == e["probed_count"]:
+                continue  # exact re-delivery: no state change, no version churn
+            self._remote[key] = RemoteEdge(
+                avg_ms=float(e["avg_ms"]), std_ms=float(e["std_ms"]),
+                min_ms=float(e["min_ms"]), probed_count=int(e["probed_count"]),
+                updated_at=float(e["updated_at"]), origin=origin,
+            )
+            applied += 1
+            self.version += 1
+            self._bump_pair(*key)
+        return applied
+
+    def purge_remote_origin(self, origin: str) -> int:
+        """Drop every merged edge received from `origin` — called when the
+        federation detects that peer restarted (new epoch): the dead
+        instance's edges have no tombstones in its successor's empty clock,
+        so no delete could ever arrive for them. Returns entries dropped."""
+        dead = [k for k, e in self._remote.items() if e.origin == origin]
+        for k in dead:
+            del self._remote[k]
+            self._bump_pair(*k)
             self.version += 1
         return len(dead)
 

@@ -32,6 +32,8 @@ async def run_scheduler(
     trainer_addr: str | None = None,
     trainer_interval: float | None = None,
     model_watch_interval: float | None = None,
+    federation_peers: str | None = None,
+    federation_interval: float | None = None,
     hostname: str = "",
     idc: str = "",
     location: str = "",
@@ -93,6 +95,38 @@ async def run_scheduler(
             except Exception as stop_err:
                 logger.debug("half-started link teardown failed: %s", stop_err)
             link = None
+    # Scheduler federation: static peer list and/or manager-fed membership.
+    # "auto" (or any static list alongside a manager link) keeps the peer
+    # set live from dynconfig — a member joining/leaving the ring starts/
+    # stops syncing within one dynconfig refresh.
+    federation = None
+    if federation_peers:
+        from dragonfly2_tpu.scheduler.federation import (
+            DEFAULT_SYNC_INTERVAL,
+            FederationSync,
+        )
+
+        static = [] if federation_peers.strip() == "auto" else [
+            a.strip() for a in federation_peers.split(",") if a.strip()
+        ]
+        if federation_peers.strip() == "auto" and link is None:
+            logger.warning(
+                "--federation-peers auto needs a manager link; federation disabled"
+            )
+        else:
+            federation = FederationSync(
+                service,
+                self_addr=f"{host}:{server.port}",
+                name=hostname or f"{host}:{server.port}",
+                peers=static,
+                peers_fn=link.federation_peers if link is not None else None,
+                interval=federation_interval or DEFAULT_SYNC_INTERVAL,
+            )
+            federation.start()
+            logger.info(
+                "federation sync up (interval %.1fs, peers %s)",
+                federation.interval, static or "manager-fed",
+            )
     announcer = None
     if trainer_addr and telemetry is not None:
         from dragonfly2_tpu.scheduler.announcer import DEFAULT_INTERVAL, TrainerAnnouncer
@@ -116,6 +150,8 @@ async def run_scheduler(
         loop_monitor.stop()
         if debug is not None:
             await debug.stop()
+        if federation is not None:
+            await federation.stop()
         if announcer is not None:
             await announcer.stop()
         if link is not None:
@@ -171,6 +207,11 @@ def main() -> None:
                     help="seconds between active-model registry polls (default 60)")
     ap.add_argument("--trainer-interval", type=float, default=cfg.trainer_interval,
                     help="telemetry upload cadence in seconds (default 7 days)")
+    ap.add_argument("--federation-peers", default=cfg.federation_peers,
+                    help='peer scheduler addresses "host:port,host:port", or '
+                         '"auto" to follow the manager address book')
+    ap.add_argument("--federation-interval", type=float, default=cfg.federation_interval,
+                    help="seconds between federation gossip rounds (default 5)")
     ap.add_argument("--hostname", default=cfg.hostname)
     ap.add_argument("--idc", default=cfg.idc)
     ap.add_argument("--location", default=cfg.location)
@@ -201,6 +242,8 @@ def main() -> None:
             trainer_addr=args.trainer,
             trainer_interval=args.trainer_interval,
             model_watch_interval=args.model_watch_interval,
+            federation_peers=args.federation_peers,
+            federation_interval=args.federation_interval,
             hostname=args.hostname,
             idc=args.idc,
             location=args.location,

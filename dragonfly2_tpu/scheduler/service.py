@@ -121,6 +121,14 @@ class SchedulerService:
         self.evaluator.bandwidth = self.bandwidth
         self.seed_trigger = seed_trigger
         self._seed_triggered: set[str] = set()
+        # Federation instance epoch: version counters reset on restart, so a
+        # peer's saved watermarks against THIS instance are meaningless for
+        # the next one — the sync protocol compares epochs and restarts from
+        # zero (and a member that reaches itself through a misconfigured
+        # static peer list sees its own epoch and self-excludes).
+        import os as _os
+
+        self.federation_epoch = _os.urandom(8).hex()
 
     def close(self) -> None:
         """Release dispatcher worker threads (no-op in serial mode)."""
@@ -617,6 +625,72 @@ class SchedulerService:
         if results:
             metrics.PROBES_SYNCED_TOTAL.inc(len(results))
         return [{"host_id": t.host_id, "ip": t.ip, "port": t.port} for t in targets]
+
+    # ---- scheduler federation (scheduler/federation.py drives this) ----
+
+    def federation_sync(
+        self,
+        origin: str,
+        *,
+        topo_since: int = 0,
+        bw_since: int = 0,
+        topo_push: list[dict] | None = None,
+        bw_push: list[dict] | None = None,
+        epoch: str = "",
+    ) -> dict[str, Any]:
+        """One push-pull gossip exchange, served to a peer scheduler: merge
+        the peer's pushed deltas into the remote view, then answer with OUR
+        local deltas above the peer's watermarks. Merging and enumeration
+        run under the state lock (dispatcher workers read these structures
+        lock-free via the version keys; the merge bumps versions with the
+        same stats-before-bump ordering the local mutators use).
+
+        `epoch` is the CALLER's instance epoch: when it equals ours the
+        caller reached itself (0.0.0.0 bind + its own address in a shared
+        static peer list) — refuse the exchange instead of mirroring the
+        member's own edges back into its remote view."""
+        if epoch and epoch == self.federation_epoch:
+            return {
+                "epoch": self.federation_epoch, "self": True,
+                "topo_watermark": 0, "bw_watermark": 0,
+                "edges": [], "bandwidth": [], "applied": 0,
+            }
+        applied = 0
+        with self.state_lock:
+            if topo_push:
+                applied += self.topology.merge_remote(topo_push, origin=origin)
+            if bw_push:
+                applied += self.bandwidth.merge_remote(bw_push, origin=origin)
+            topo_wm, edges = self.topology.local_edges_since(topo_since)
+            bw_wm, entries = self.bandwidth.local_entries_since(bw_since)
+        if applied:
+            metrics.FEDERATION_DELTAS_APPLIED_TOTAL.inc(applied)
+        if edges or entries:
+            metrics.FEDERATION_DELTAS_SENT_TOTAL.inc(len(edges) + len(entries))
+        return {
+            "epoch": self.federation_epoch,
+            "topo_watermark": topo_wm,
+            "bw_watermark": bw_wm,
+            "edges": edges,
+            "bandwidth": entries,
+            "applied": applied,
+        }
+
+    def federation_state(self) -> dict[str, Any]:
+        """Merged-view introspection for tests, the bench's convergence
+        probe, and operators (served over RPC as `federation_state`)."""
+        return {
+            "epoch": self.federation_epoch,
+            "local_edges": self.topology.edge_count(),
+            "remote_edges": self.topology.remote_edge_count(),
+            "topo_watermark": self.topology.version,
+            "local_bandwidth_pairs": len(self.bandwidth),
+            "remote_bandwidth_pairs": self.bandwidth.remote_entry_count(),
+            "bw_watermark": self.bandwidth.version,
+            "hosts": len(self.pool.hosts),
+            "peers": self.pool.peer_count(),
+            "tasks": len(self.pool.tasks),
+        }
 
     def stat_task(self, task_id: str) -> dict[str, Any] | None:
         task = self.pool.tasks.get(task_id)

@@ -46,6 +46,18 @@ class BandwidthHistory:
         # parent. Monotonic, never deleted (see NetworkTopology._pair_vers
         # for the id-recycling rationale).
         self._parent_vers: dict[str, int] = {}
+        # Federation delta clock + merged remote view (same contract as
+        # NetworkTopology — shared semantics in utils/deltaclock.py): local
+        # observes stamp their pair key with the post-bump coarse version;
+        # merged entries are never re-stamped (never re-gossiped), carry
+        # their origin (so a restarted peer's leftovers can be purged), and
+        # are consulted by query() only when no local history exists.
+        from dragonfly2_tpu.utils.deltaclock import DeltaClock
+
+        self._clock = DeltaClock()
+        self._remote_pair: dict[tuple[str, str], float] = {}
+        self._remote_origin: dict[tuple[str, str], str] = {}
+        self._remote_parent: dict[str, float] = {}
 
     def parent_version(self, parent_host_id: str) -> int:
         """Change counter covering every pair this parent serves (pair EWMA
@@ -73,13 +85,23 @@ class BandwidthHistory:
         # on its next lookup (the cache converges, never sticks stale).
         self._bump_parent(parent_host_id)
         self.version += 1
+        self._clock.stamp(key, self.version)
 
     def query(self, parent_host_id: str, child_host_id: str) -> Optional[float]:
-        """Best available estimate in bytes/s, or None with no history."""
+        """Best available estimate in bytes/s, or None with no history.
+        Lookup order: local pair EWMA → federation-merged pair EWMA → local
+        per-parent aggregate → merged per-parent aggregate (local data wins
+        at equal specificity: it is fresher than a gossip round)."""
         v = self._pair.get((parent_host_id, child_host_id))
         if v is not None:
             return v
-        return self._parent.get(parent_host_id)
+        v = self._remote_pair.get((parent_host_id, child_host_id))
+        if v is not None:
+            return v
+        v = self._parent.get(parent_host_id)
+        if v is not None:
+            return v
+        return self._remote_parent.get(parent_host_id)
 
     def normalized(self, parent_host_id: str, child_host_id: str) -> float:
         """Feature-space value: observed bps / 1 GiB/s, clipped to [0, 1];
@@ -99,7 +121,88 @@ class BandwidthHistory:
             # forgotten host was the child side
             if key[0] != host_id:
                 self._bump_parent(key[0])
+            self.version += 1
+            self._clock.stamp(key, self.version)  # tombstone for the gossip
+        self._remote_parent.pop(host_id, None)
+        for key in [k for k in self._remote_pair if host_id in k]:
+            del self._remote_pair[key]
+            self._remote_origin.pop(key, None)
+            if key[0] != host_id:
+                self._bump_parent(key[0])
         self.version += 1
+        self._clock.prune(self._pair.__contains__)
+
+    # ---- federation delta sync (scheduler/federation.py) ----
+
+    def local_entries_since(self, since: int) -> tuple[int, list[dict]]:
+        """(watermark, deltas): locally-observed pair EWMAs stamped above
+        `since`, each carrying the parent's aggregate fallback alongside;
+        forgotten pairs ship tombstones. O(changed) payload."""
+        out = []
+        for key in self._clock.since(since):
+            bps = self._pair.get(key)
+            if bps is None:
+                out.append({"parent": key[0], "child": key[1], "deleted": True})
+            else:
+                out.append({
+                    "parent": key[0], "child": key[1], "bps": bps,
+                    "parent_agg": self._parent.get(key[0], bps),
+                })
+        return self.version, out
+
+    def merge_remote(self, entries: list[dict], *, origin: str = "") -> int:
+        """Apply a peer's bandwidth deltas into the merged view (idempotent:
+        re-delivering the same EWMA value is a no-op). Bumps the parent
+        version so cached pair rows reading the fallback re-assemble."""
+        applied = 0
+        for e in entries:
+            key = (e["parent"], e["child"])
+            if e.get("deleted"):
+                if self._remote_pair.pop(key, None) is not None:
+                    self._remote_origin.pop(key, None)
+                    applied += 1
+                    self.version += 1
+                    self._bump_parent(key[0])
+                # drop the merged parent aggregate once its LAST remote pair
+                # is gone: a GC'd (possibly id-recycled) parent must not keep
+                # serving a stale fallback estimate forever
+                if not any(k[0] == key[0] for k in self._remote_pair):
+                    if self._remote_parent.pop(key[0], None) is not None:
+                        self._bump_parent(key[0])
+                        self.version += 1
+                continue
+            changed = self._remote_pair.get(key) != e["bps"]
+            agg = e.get("parent_agg")
+            if agg is not None and self._remote_parent.get(key[0]) != agg:
+                self._remote_parent[key[0]] = float(agg)
+                changed = True
+            if not changed:
+                continue
+            self._remote_pair[key] = float(e["bps"])
+            self._remote_origin[key] = origin
+            applied += 1
+            self.version += 1
+            self._bump_parent(key[0])
+        return applied
+
+    def purge_remote_origin(self, origin: str) -> int:
+        """Drop merged entries received from a peer that RESTARTED (its
+        successor's empty clock can never tombstone them) — mirror of
+        NetworkTopology.purge_remote_origin."""
+        dead = [k for k, o in self._remote_origin.items() if o == origin]
+        for k in dead:
+            self._remote_pair.pop(k, None)
+            del self._remote_origin[k]
+            self._bump_parent(k[0])
+            self.version += 1
+            if not any(p == k[0] for p, _ in self._remote_pair):
+                if self._remote_parent.pop(k[0], None) is not None:
+                    self._bump_parent(k[0])
+                    self.version += 1
+        return len(dead)
+
+    def remote_entry_count(self) -> int:
+        return len(self._remote_pair)
 
     def load_from(self, telemetry) -> int:
         """Warm-start from persisted download records (oldest first, so the

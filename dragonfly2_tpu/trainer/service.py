@@ -80,6 +80,10 @@ class TrainSession:
     # explicitly (the drainer task's own captured contextvar points at
     # whichever close FIRST started it — wrong for every later run)
     trace_ctx: Any = None
+    # multi-source attribution (federation): every scheduler whose session
+    # committed into the pool this run trains on — stamped at close time,
+    # unioned when the drainer coalesces runs over the same pool
+    contributors: set = field(default_factory=set)
 
 
 @dataclass
@@ -114,6 +118,9 @@ class TrainerService:
         self.cfg = config or TrainerConfig()
         self.manager = manager
         self._acc = datasetlib.DatasetAccumulator(max_pair_rows=self.cfg.pool_rows)
+        # schedulers that have committed into the CURRENT pool epoch —
+        # cleared on rotation with the pool it describes
+        self._pool_contributors: set[tuple[int, str]] = set()
         self._sessions: dict[str, TrainSession] = {}
         self._next = 0
         self._queue: collections.deque[TrainSession] = collections.deque()
@@ -169,6 +176,12 @@ class TrainerService:
             # THIS pool even if a later close rotates in a fresh one
             self._acc.merge_from(sess.acc)
             sess.acc = self._acc
+            # federation attribution: a model trained on the pool carries
+            # every scheduler that fed THIS pool epoch, not just the closer
+            self._pool_contributors.add((sess.scheduler_id, sess.scheduler_hostname))
+            sess.contributors = set(self._pool_contributors)
+        else:
+            sess.contributors = {(sess.scheduler_id, sess.scheduler_hostname)}
         # never await the previous run here: queue the session and let the
         # drainer serialize training (one run at a time) off this RPC's back
         self._queue.append(sess)
@@ -212,6 +225,7 @@ class TrainerService:
                 self._acc.num_hosts, self._acc.num_edges, self._acc.pair_rows,
             )
             self._acc = datasetlib.DatasetAccumulator(max_pair_rows=cfg.pool_rows)
+            self._pool_contributors = set()
             self.pool_rotations += 1
 
     def _evict_stale(self) -> None:
@@ -245,7 +259,9 @@ class TrainerService:
         while self._queue:
             sess = self._queue.popleft()
             while self._queue and self._queue[0].acc is sess.acc:
-                sess = self._queue.popleft()
+                nxt = self._queue.popleft()
+                nxt.contributors |= sess.contributors
+                sess = nxt
                 self.trains_coalesced += 1
             self.trains_started += 1
             await self._train(sess)
@@ -352,7 +368,17 @@ class TrainerService:
         return out
 
     async def _register_models(self, sess: TrainSession, result: dict) -> None:
-        """Finish the reference's CreateModel stub: version rows + activation."""
+        """Finish the reference's CreateModel stub: version rows + activation.
+
+        Models register CLUSTER-WIDE (scheduler_id 0): ONE trainer ingests
+        telemetry from every federation member and each member's model watch
+        falls back to the scheduler_id-0 row, so a single activation fans the
+        version out to all of them. The evaluation dict carries the
+        contributing schedulers — the attribution proof the cross-scheduler
+        cluster test pins."""
+        contributors = sorted(
+            name or f"scheduler-{sid}" for sid, name in sess.contributors
+        )
         for mtype in ("mlp", "gnn"):
             info = result.get(mtype)
             if not info:
@@ -360,8 +386,8 @@ class TrainerService:
             try:
                 row = await self.manager.create_model(
                     mtype, result["version"],
-                    scheduler_id=sess.scheduler_id,
-                    evaluation=info["evaluation"],
+                    scheduler_id=0,
+                    evaluation={**info["evaluation"], "contributors": contributors},
                     artifact_path=info["artifact"],
                 )
                 await self.manager.activate_model(row["id"])

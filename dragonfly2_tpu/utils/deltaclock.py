@@ -1,0 +1,53 @@
+"""Delta clock for federation gossip: ONE implementation of the
+stamp-on-mutate / tombstone-on-delete / cap-and-prune protocol that
+NetworkTopology and BandwidthHistory both gossip with — two hand-kept copies
+of the same semantics would silently desynchronize the moment one grew a
+change (per-origin purge, TTL, ...) the other missed.
+
+The owner stamps every LOCAL mutation with its store's post-bump version
+counter; `since(w)` enumerates keys a peer with watermark `w` has not seen
+(the owner decides per key whether that is live stats or a tombstone by
+looking at its own store); `prune(is_live)` bounds retained tombstone stamps
+at `tombstone_cap`, dropping the OLDEST — a peer that last synced before a
+pruned stamp keeps the stale remote entry until that key churns again (the
+bounded-memory tradeoff; regularly-syncing peers are always far past the
+prune horizon).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Hashable, Iterator
+
+DEFAULT_TOMBSTONE_CAP = 4096
+
+
+class DeltaClock:
+    __slots__ = ("seq", "tombstone_cap")
+
+    def __init__(self, tombstone_cap: int = DEFAULT_TOMBSTONE_CAP):
+        self.seq: dict[Hashable, int] = {}
+        self.tombstone_cap = tombstone_cap
+
+    def stamp(self, key: Hashable, version: int) -> None:
+        self.seq[key] = version
+
+    def since(self, watermark: int) -> Iterator[Hashable]:
+        """Keys mutated after `watermark` (O(all stamps) scan; the PAYLOAD
+        is O(changed), which is the property the gossip depends on)."""
+        for key, seq in self.seq.items():
+            if seq > watermark:
+                yield key
+
+    def prune(self, is_live: Callable[[Hashable], bool]) -> None:
+        """Drop the oldest dead-key stamps past the cap (live keys keep
+        their stamp for the key's lifetime; tombstones exist only to gossip
+        deletes)."""
+        dead = [k for k in self.seq if not is_live(k)]
+        if len(dead) <= self.tombstone_cap:
+            return
+        dead.sort(key=self.seq.__getitem__)
+        for k in dead[: len(dead) - self.tombstone_cap]:
+            del self.seq[k]
+
+    def __len__(self) -> int:
+        return len(self.seq)

@@ -60,6 +60,11 @@ class FakeS3:
     # ---- auth ----
 
     async def _verify(self, request: web.Request, body: bytes) -> web.Response | None:
+        # Callers MUST compare the result against None, never truth-test it:
+        # aiohttp's web.Response is a MutableMapping whose len() is 0, so a
+        # bare `if self._verify(...)` is always False — that exact bug
+        # silently bypassed every auth check here (the carried
+        # bad-credentials tier-1 failure) until the `is not None` guards.
         if "X-Amz-Signature" in request.rel_url.query:
             return self._verify_presigned(request)
         auth = request.headers.get("Authorization", "")
@@ -144,7 +149,7 @@ class FakeS3:
 
     async def _root(self, request: web.Request) -> web.Response:
         body = await request.read()
-        if bad := await self._verify(request, body):
+        if (bad := await self._verify(request, body)) is not None:
             return bad
         if request.method != "GET":
             return self._err(405, "MethodNotAllowed", request.method)
@@ -156,7 +161,7 @@ class FakeS3:
 
     async def _bucket(self, request: web.Request) -> web.Response:
         body = await request.read()
-        if bad := await self._verify(request, body):
+        if (bad := await self._verify(request, body)) is not None:
             return bad
         name = request.match_info["bucket"]
         if request.method == "PUT":
@@ -226,7 +231,7 @@ class FakeS3:
 
     async def _object(self, request: web.Request) -> web.Response:
         body = await request.read()
-        if bad := await self._verify(request, body):
+        if (bad := await self._verify(request, body)) is not None:
             return bad
         bucket = request.match_info["bucket"]
         key = request.match_info["key"]

@@ -1,0 +1,483 @@
+"""Scheduler federation tests (ISSUE 10): delta-sync watermark semantics,
+push-pull gossip over the real wire, the merged-topology download E2E
+(a round on scheduler A scored with probes only ever reported to B), and
+the chaos failover (kill a ring member mid-download; the survivor serves
+the swarm and downloads complete bit-exact)."""
+
+import asyncio
+import hashlib
+
+import pytest
+
+from dragonfly2_tpu.daemon.conductor import ConductorConfig
+from dragonfly2_tpu.daemon.engine import PeerEngine
+from dragonfly2_tpu.rpc.balancer import BalancedSchedulerClient, ConsistentHashRing
+from dragonfly2_tpu.rpc.scheduler import serve_scheduler
+from dragonfly2_tpu.scheduler.federation import FederationSync
+from dragonfly2_tpu.scheduler.networktopology import NetworkTopology
+from dragonfly2_tpu.scheduler.service import SchedulerService
+from dragonfly2_tpu.telemetry import TelemetryStorage
+from dragonfly2_tpu.telemetry.bandwidth import BandwidthHistory
+from dragonfly2_tpu.utils import idgen
+from tests.test_e2e import Origin, make_engine
+
+
+class TestTopologyDeltas:
+    def test_watermark_enumeration_ships_only_new_edges(self):
+        t = NetworkTopology()
+        t.enqueue("a", "b", 5.0)
+        t.enqueue("c", "d", 3.0)
+        wm, edges = t.local_edges_since(0)
+        assert {(e["src"], e["dst"]) for e in edges} == {("a", "b"), ("c", "d")}
+        # steady state: nothing above the watermark
+        wm2, edges2 = t.local_edges_since(wm)
+        assert edges2 == [] and wm2 == wm
+        # one new probe -> exactly one delta entry
+        t.enqueue("a", "b", 7.0)
+        _, edges3 = t.local_edges_since(wm)
+        assert [(e["src"], e["dst"]) for e in edges3] == [("a", "b")]
+        assert edges3[0]["avg_ms"] == 6.0
+
+    def test_forget_host_ships_tombstones_and_clears_merged_view(self):
+        t = NetworkTopology()
+        t.enqueue("a", "b", 5.0)
+        wm, edges = t.local_edges_since(0)
+        other = NetworkTopology()
+        other.merge_remote(edges, origin="s1")
+        assert other.avg_rtt_ms("a", "b") == 5.0
+        t.forget_host("a")
+        _, deltas = t.local_edges_since(wm)
+        assert deltas and all(d.get("deleted") for d in deltas)
+        other.merge_remote(deltas, origin="s1")
+        assert other.avg_rtt_ms("a", "b") is None
+        assert other.remote_edge_count() == 0
+
+    def test_merge_is_idempotent_and_monotonic(self):
+        t = NetworkTopology()
+        t.enqueue("a", "b", 5.0)
+        _, edges = t.local_edges_since(0)
+        other = NetworkTopology()
+        assert other.merge_remote(edges, origin="s1") == 1
+        # exact re-delivery (the retransmit after a lost response): no state
+        # change, no version churn
+        v = other.pair_version("a", "b")
+        assert other.merge_remote(edges, origin="s1") == 0
+        assert other.pair_version("a", "b") == v
+        # an OLDER snapshot never overwrites a newer merge
+        stale = [dict(edges[0], avg_ms=99.0, updated_at=edges[0]["updated_at"] - 10)]
+        assert other.merge_remote(stale, origin="s1") == 0
+        assert other.avg_rtt_ms("a", "b") == 5.0
+
+    def test_remote_edges_never_regossiped(self):
+        t = NetworkTopology()
+        t.merge_remote(
+            [{"src": "x", "dst": "y", "avg_ms": 1.0, "std_ms": 0.0, "min_ms": 1.0,
+              "probed_count": 1, "updated_at": 123.0}],
+            origin="s1",
+        )
+        _, edges = t.local_edges_since(0)
+        assert edges == []  # merged data has no origin here; shipping it would loop
+
+    def test_remote_fallback_order_prefers_local(self):
+        t = NetworkTopology()
+        t.merge_remote(
+            [{"src": "a", "dst": "b", "avg_ms": 50.0, "std_ms": 0.0, "min_ms": 50.0,
+              "probed_count": 1, "updated_at": 1.0}],
+            origin="s1",
+        )
+        assert t.avg_rtt_ms("a", "b") == 50.0
+        assert t.avg_rtt_ms("b", "a") == 50.0  # reverse-direction fallback
+        t.enqueue("a", "b", 10.0)
+        assert t.avg_rtt_ms("a", "b") == 10.0  # local probes win
+
+    def test_bandwidth_deltas_and_merged_fallback(self):
+        b = BandwidthHistory()
+        b.observe("p", "c", 1e8)
+        wm, entries = b.local_entries_since(0)
+        assert len(entries) == 1 and entries[0]["parent"] == "p"
+        other = BandwidthHistory()
+        assert other.merge_remote(entries) == 1
+        assert other.query("p", "c") == 1e8
+        # merged parent aggregate serves children with no pair history
+        assert other.query("p", "someone-else") == 1e8
+        assert other.merge_remote(entries) == 0  # idempotent
+        # steady state ships nothing
+        _, entries2 = b.local_entries_since(wm)
+        assert entries2 == []
+        # local observation beats the merged pair value
+        other.observe("p", "c", 5e8)
+        assert other.query("p", "c") == 5e8
+
+    def test_bandwidth_merge_bumps_parent_version(self):
+        other = BandwidthHistory()
+        v = other.parent_version("p")
+        other.merge_remote([{"parent": "p", "child": "c", "bps": 1e8, "parent_agg": 1e8}])
+        assert other.parent_version("p") > v  # cached pair rows re-assemble
+
+    def test_bandwidth_tombstone_clears_merged_parent_aggregate(self):
+        b = BandwidthHistory()
+        b.observe("p", "c1", 1e8)
+        b.observe("p", "c2", 2e8)
+        wm, entries = b.local_entries_since(0)
+        other = BandwidthHistory()
+        other.merge_remote(entries)
+        b.forget_host("c1")  # only ONE of the parent's pairs dies
+        _, t1 = b.local_entries_since(wm)
+        other.merge_remote(t1)
+        # the aggregate survives while another remote pair still backs it
+        assert other.query("p", "c2") == 2e8
+        assert other.query("p", "unseen") is not None
+        wm2, _ = b.local_entries_since(0)
+        b.forget_host("p")  # last pair gone -> aggregate must go too
+        _, t2 = b.local_entries_since(wm2)
+        other.merge_remote(t2)
+        # a GC'd (possibly id-recycled) parent serves NO stale estimate
+        assert other.query("p", "unseen") is None
+
+    def test_tombstone_maps_stay_bounded_under_host_churn(self):
+        from dragonfly2_tpu.utils.deltaclock import DEFAULT_TOMBSTONE_CAP as cap
+
+        t = NetworkTopology()
+        b = BandwidthHistory()
+        for i in range(cap + 500):
+            t.enqueue(f"h{i}", "hub", 1.0)
+            b.observe(f"h{i}", "hub", 1e8)
+            t.forget_host(f"h{i}")
+            b.forget_host(f"h{i}")
+        assert len(t._clock) <= cap
+        assert len(b._clock) <= cap
+
+
+class TestWireSync:
+    def test_push_pull_converges_both_sides_over_one_edge(self, run):
+        """A one-directional peer config (B lists A... here A lists B) still
+        converges BOTH members: the single RPC pushes the initiator's deltas
+        and pulls the responder's."""
+
+        async def body():
+            sa, sb = SchedulerService(), SchedulerService()
+            srv_a = serve_scheduler(sa, port=0)
+            srv_b = serve_scheduler(sb, port=0)
+            await srv_a.start()
+            await srv_b.start()
+            sb.topology.enqueue("child", "seed", 4.2)
+            sa.bandwidth.observe("seed", "child", 3e8)
+            fed = FederationSync(
+                sa, self_addr=srv_a.address, name="schA", peers=[srv_b.address]
+            )
+            try:
+                await fed.sync_peer(srv_b.address)
+                assert sa.topology.avg_rtt_ms("child", "seed") == 4.2
+                assert sb.bandwidth.query("seed", "child") == 3e8
+                # steady state: zero-entry payloads both directions
+                out = await fed.sync_peer(srv_b.address)
+                assert out["edges"] == [] and out["bandwidth"] == []
+                # retransmit safety: wiping the peer state replays history
+                # into the same merged state (at-least-once delivery)
+                fed._state.clear()
+                before = sa.topology.remote_edge_count()
+                await fed.sync_peer(srv_b.address)
+                assert sa.topology.remote_edge_count() == before
+            finally:
+                await fed.stop()
+                await srv_a.stop()
+                await srv_b.stop()
+                sa.close()
+                sb.close()
+
+        run(body())
+
+    def test_peer_restart_resets_watermarks_and_replays(self, run):
+        """A restarted peer's version counters reset below the initiator's
+        saved watermarks; the epoch mismatch must restart BOTH directions
+        from zero — without it a responder-only (chain-config) peer would
+        never ship post-restart probes nor re-receive the initiator's."""
+
+        async def body():
+            sa, sb = SchedulerService(), SchedulerService()
+            srv_a = serve_scheduler(sa, port=0)
+            await srv_a.start()
+            srv_b = serve_scheduler(sb, port=0)
+            await srv_b.start()
+            port = srv_b.port
+            sa.topology.enqueue("a-src", "a-dst", 1.0)
+            for i in range(5):  # run the peer's version counter up
+                sb.topology.enqueue(f"b{i}", "hub", 2.0)
+            fed = FederationSync(
+                sa, self_addr=srv_a.address, name="schA",
+                peers=[srv_b.address],
+            )
+            try:
+                await fed.sync_peer(srv_b.address)
+                assert sa.topology.remote_edge_count() == 5
+                assert sb.topology.avg_rtt_ms("a-src", "a-dst") == 1.0
+
+                # "restart" B: fresh service (epoch + counters reset), same port
+                await srv_b.stop()
+                sb.close()
+                sb2 = SchedulerService()
+                srv_b2 = serve_scheduler(sb2, port=port)
+                await srv_b2.start()
+                sb2.topology.enqueue("fresh", "edge", 3.0)  # version 1 << old watermark
+
+                out = await fed.sync_peer(srv_b.address)
+                # post-restart data crossed BOTH ways despite stale watermarks
+                assert sa.topology.avg_rtt_ms("fresh", "edge") == 3.0, out
+                assert sb2.topology.avg_rtt_ms("a-src", "a-dst") == 1.0
+                # the dead instance's 5 merged edges were PURGED (its
+                # successor's empty clock could never tombstone them); only
+                # the replayed fresh edge remains in A's remote view
+                assert sa.topology.remote_edge_count() == 1
+                assert sa.topology.avg_rtt_ms("b0", "hub") is None
+                await srv_b2.stop()
+                sb2.close()
+            finally:
+                await fed.stop()
+                await srv_a.stop()
+                sa.close()
+
+        run(body())
+
+    def test_member_reaching_itself_self_excludes(self, run):
+        """0.0.0.0-bound member listed in its own shared static peer list:
+        the epoch handshake detects the mirror and excludes the address
+        instead of merging the member's own edges into its remote view."""
+
+        async def body():
+            sa = SchedulerService()
+            srv = serve_scheduler(sa, port=0)
+            await srv.start()
+            sa.topology.enqueue("x", "y", 1.0)
+            fed = FederationSync(
+                sa, self_addr="0.0.0.0:9999", name="schA", peers=[srv.address]
+            )
+            try:
+                await fed.sync_once()
+                assert sa.topology.remote_edge_count() == 0  # no self-mirror
+                assert srv.address not in fed.peer_addresses()  # excluded for good
+            finally:
+                await fed.stop()
+                await srv.stop()
+                sa.close()
+
+        run(body())
+
+    def test_sync_loop_runs_and_recovers_from_dead_peer(self, run):
+        async def body():
+            sa, sb = SchedulerService(), SchedulerService()
+            srv_b = serve_scheduler(sb, port=0)
+            await srv_b.start()
+            dead = "127.0.0.1:1"  # nothing listens on port 1
+            fed = FederationSync(
+                sa, self_addr="127.0.0.1:0", name="schA",
+                peers=[dead, srv_b.address], interval=0.05,
+            )
+            sb.topology.enqueue("x", "y", 1.0)
+            fed.start()
+            try:
+                deadline = asyncio.get_running_loop().time() + 5.0
+                while asyncio.get_running_loop().time() < deadline:
+                    if fed.syncs_ok >= 2 and sa.topology.remote_edge_count() == 1:
+                        break
+                    await asyncio.sleep(0.02)
+                assert fed.syncs_ok >= 2  # live peer kept syncing
+                assert fed.syncs_failed >= 1  # dead peer counted, never fatal
+                assert sa.topology.avg_rtt_ms("x", "y") == 1.0
+            finally:
+                await fed.stop()
+                await srv_b.stop()
+                sa.close()
+                sb.close()
+
+        run(body())
+
+
+def _pick_url_owned_by(origin: Origin, ring: ConsistentHashRing, addr: str,
+                       files: dict) -> str:
+    """A URL whose task id the ring assigns to `addr` (the origin port is
+    random, so ownership must be computed per-run, not hard-coded)."""
+    for name in files:
+        url = origin.url(name)
+        if ring.pick(idgen.task_id(url)) == addr:
+            return url
+    raise AssertionError("no candidate file hashed to the wanted scheduler")
+
+
+class TestMergedTopologyDownload:
+    def test_round_on_owner_scored_with_probes_reported_only_to_peer(
+        self, run, tmp_path
+    ):
+        """ISSUE 10 acceptance E2E: 2 schedulers behind the ring serve one
+        cluster — the download's scheduling rounds run on the task's ring
+        owner (A), while the (child, seed) RTT probes were only ever
+        reported to the OTHER member (B). The federation gossip is what
+        makes A's round see them: A holds zero local probe edges, yet the
+        persisted pair-feature row carries B's RTT."""
+        payload = bytes(range(256)) * (40 * 1024)  # 10 MiB -> 3 pieces
+        files = {f"model-{i}.bin": payload for i in range(8)}
+
+        async def body():
+            svc_a = SchedulerService(telemetry=TelemetryStorage(tmp_path / "tel-a"))
+            svc_b = SchedulerService(telemetry=TelemetryStorage(tmp_path / "tel-b"))
+            srv_a = serve_scheduler(svc_a, port=0)
+            srv_b = serve_scheduler(svc_b, port=0)
+            await srv_a.start()
+            await srv_b.start()
+            addrs = [srv_a.address, srv_b.address]
+            ring = ConsistentHashRing(addrs)
+            fed_a = FederationSync(
+                svc_a, self_addr=srv_a.address, name="schA", peers=[srv_b.address]
+            )
+            e1 = make_engine(tmp_path, BalancedSchedulerClient(addrs), "seed-peer")
+            e2 = make_engine(tmp_path, BalancedSchedulerClient(addrs), "child-peer")
+            async with Origin(files) as origin:
+                url = _pick_url_owned_by(origin, ring, srv_a.address, files)
+                await e1.start()
+                await e2.start()
+                try:
+                    await e1.download_task(url)
+                    # task state lives on the ring owner A, nowhere else
+                    tid = idgen.task_id(url)
+                    assert svc_a.stat_task(tid) is not None
+                    assert svc_b.stat_task(tid) is None
+
+                    # the (child, seed) probes go to B ONLY — the real
+                    # sync_probes ingest path, as a daemon prober would
+                    svc_b.sync_probes(
+                        e2.host_id,
+                        [{"dst_host_id": e1.host_id, "rtt_ms": 40.0, "success": True}],
+                    )
+                    assert svc_a.topology.edge_count() == 0
+                    await fed_a.sync_peer(srv_b.address)  # one gossip hop
+                    assert svc_a.topology.edge_count() == 0  # still no LOCAL probes
+                    assert svc_a.topology.remote_edge_count() == 1
+                    assert svc_a.topology.avg_rtt_ms(e2.host_id, e1.host_id) == 40.0
+
+                    out = tmp_path / "dl2.bin"
+                    await e2.download_task(url, output=out)
+                    assert hashlib.sha256(out.read_bytes()).hexdigest() == \
+                        hashlib.sha256(payload).hexdigest()
+
+                    # the persisted pair-feature rows (built at the peer
+                    # result with the SAME builder the scheduling round
+                    # scores with) carry B's RTT: rtt_norm = 40ms / 1s
+                    svc_a.telemetry.flush()
+                    rows = svc_a.telemetry.downloads.load_all()
+                    seed_host = e1.host_id.encode()
+                    got = [
+                        float(r["pair_features"][6])
+                        for r in rows
+                        if bytes(r["parent_host_id"]).rstrip(b"\x00") == seed_host
+                    ]
+                    assert got, "no (seed, child) download record on scheduler A"
+                    assert any(abs(v - 0.04) < 1e-6 for v in got), got
+                finally:
+                    await e1.stop()
+                    await e2.stop()
+                    await fed_a.stop()
+                    await srv_a.stop()
+                    await srv_b.stop()
+                    svc_a.close()
+                    svc_b.close()
+
+        run(body())
+
+
+class TestSchedulerFailover:
+    @pytest.mark.chaos
+    def test_kill_ring_member_mid_download_survivor_serves(self, run, tmp_path):
+        """Federation chaos: one ring member dies while a child is
+        mid-download. The in-flight download completes bit-exact (the data
+        plane rides peers, piece reports fail soft), the membership resolver
+        re-shards the ring to the survivor, the seed's possession
+        re-announce rebuilds the survivor's view, and a NEW child is
+        scheduled by the survivor onto the existing swarm — no origin
+        re-fetch."""
+        payload = bytes(range(256)) * (40 * 1024)  # 10 MiB -> 3 pieces
+        files = {f"chaos-{i}.bin": payload for i in range(8)}
+
+        async def body():
+            svc_a = SchedulerService()
+            svc_b = SchedulerService()
+            srv_a = serve_scheduler(svc_a, port=0)
+            srv_b = serve_scheduler(svc_b, port=0)
+            await srv_a.start()
+            await srv_b.start()
+            addrs = [srv_a.address, srv_b.address]
+            live = list(addrs)
+
+            async def resolve():
+                return list(live)
+
+            def client():
+                c = BalancedSchedulerClient(addrs, resolve=resolve, resolve_interval=0.1)
+                c.start_resolver()
+                return c
+
+            ring = ConsistentHashRing(addrs)
+            # slow the child so the kill lands mid-download (~2.5 s at 4 MB/s)
+            slow = ConductorConfig(
+                metadata_poll_interval=0.02, piece_timeout=10.0,
+                download_rate_bps=4e6,
+            )
+            e1 = make_engine(tmp_path, client(), "fo-seed")
+            e2 = PeerEngine(  # make_engine pins its own conductor_config
+                storage_root=tmp_path / "fo-child", scheduler=client(),
+                hostname="fo-child", conductor_config=slow,
+            )
+            e3 = make_engine(tmp_path, client(), "fo-late")
+            async with Origin(files) as origin:
+                url = _pick_url_owned_by(origin, ring, srv_a.address, files)
+                await e1.start()
+                await e2.start()
+                await e3.start()
+                try:
+                    await e1.download_task(url)
+                    origin_after_seed = origin.requests
+
+                    dl2 = asyncio.ensure_future(
+                        e2.download_task(url, output=tmp_path / "fo-out2.bin")
+                    )
+                    # wait until the child is genuinely mid-download
+                    tid = idgen.task_id(url)
+                    deadline = asyncio.get_running_loop().time() + 20
+                    while asyncio.get_running_loop().time() < deadline:
+                        ts = e2.storage.get(tid)
+                        if ts is not None and ts.finished_count() >= 1:
+                            break
+                        await asyncio.sleep(0.02)
+                    assert not dl2.done(), "kill must land MID-download"
+
+                    # ring member A dies; membership drops it
+                    await srv_a.stop()
+                    live.remove(srv_a.address)
+                    await asyncio.sleep(0.3)  # resolver tick re-shards the ring
+
+                    # the daemon keepalive's possession re-announce (driven
+                    # manually here; daemon/server.py runs it on a timer)
+                    # rebuilds the survivor's parent view from announces
+                    await e1.announce_tasks()
+
+                    # a late child registers on the SURVIVOR and rides the
+                    # existing swarm
+                    out3 = tmp_path / "fo-out3.bin"
+                    await e3.download_task(url, output=out3)
+                    want = hashlib.sha256(payload).hexdigest()
+                    assert hashlib.sha256(out3.read_bytes()).hexdigest() == want
+                    assert svc_b.stat_task(tid) is not None  # survivor scheduled it
+
+                    await dl2  # the mid-kill download also lands bit-exact
+                    got = hashlib.sha256(
+                        (tmp_path / "fo-out2.bin").read_bytes()
+                    ).hexdigest()
+                    assert got == want
+                    # nothing re-rode the origin: both children were P2P
+                    assert origin.requests == origin_after_seed
+                finally:
+                    for e in (e1, e2, e3):
+                        await e.stop()
+                    await srv_b.stop()
+                    svc_a.close()
+                    svc_b.close()
+
+        run(body())

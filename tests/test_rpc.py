@@ -459,14 +459,18 @@ class TestGracefulDeparture:
 
 
 class TestClusterMLLoop:
-    def test_ml_loop_across_real_cluster(self, tmp_path):
-        """VERDICT r4 Next #5 — the FULL ml loop through real processes:
-        daemon downloads + probes feed the scheduler's telemetry; the
-        announcer uploads to the trainer; the trainer trains and activates a
-        model in the manager registry; the scheduler's model watch hot-swaps
-        the ml evaluator; a later scheduling round is scored by the ACTIVATED
-        model (serving-mode metric native, no base-fallback growth), and the
-        embeddings-staleness gauge is exported."""
+    def test_ml_loop_across_federated_cluster(self, tmp_path):
+        """VERDICT r4 Next #5, extended across the federation (ISSUE 10) —
+        the FULL ml loop through real processes with TWO schedulers behind
+        the consistent-hash ring: daemon downloads split across both members
+        (ownership computed per-url), each member's announcer uploads to ONE
+        trainer, the trainer trains on the merged pool and activates a
+        single CLUSTER-WIDE model (scheduler_id 0) attributed to both
+        contributors; BOTH schedulers' model watches hot-swap the ml
+        evaluator to the same activated version (serving-mode metric native,
+        no base-fallback growth), and the federation gossip leaves each
+        member holding the other's probe edges."""
+        import asyncio
         import shutil
         import socket
         import urllib.request
@@ -474,10 +478,15 @@ class TestClusterMLLoop:
         if shutil.which("g++") is None:
             pytest.skip("no C++ toolchain for the native scorer")
 
+        from dragonfly2_tpu.rpc.balancer import ConsistentHashRing
+        from dragonfly2_tpu.utils import idgen
+
         env = dict(os.environ, PYTHONPATH="/root/repo", JAX_PLATFORMS="cpu")
-        with socket.socket() as s:
-            s.bind(("127.0.0.1", 0))
-            metrics_port = s.getsockname()[1]
+        metrics_ports = []
+        for _ in range(2):
+            with socket.socket() as s:
+                s.bind(("127.0.0.1", 0))
+                metrics_ports.append(s.getsockname()[1])
 
         procs = []
 
@@ -491,9 +500,9 @@ class TestClusterMLLoop:
             assert line.startswith(ready_prefix), (args, line)
             return line
 
-        def metrics_text() -> str:
+        def metrics_text(port) -> str:
             with urllib.request.urlopen(
-                f"http://127.0.0.1:{metrics_port}/metrics", timeout=5
+                f"http://127.0.0.1:{port}/metrics", timeout=5
             ) as r:
                 return r.read().decode()
 
@@ -519,24 +528,31 @@ class TestClusterMLLoop:
                 "TRAINER_READY",
             )
             trainer_addr = line.split()[1]
-            line = spawn(
-                ["dragonfly2_tpu.scheduler.server", "--port", "0",
-                 "--evaluator", "ml",
-                 "--manager", manager_addr,
-                 "--trainer", trainer_addr, "--trainer-interval", "2",
-                 "--model-watch-interval", "1",
-                 "--telemetry-dir", str(tmp_path / "tel"),
-                 "--metrics-port", str(metrics_port),
-                 "--hostname", "sch1"],
-                "SCHEDULER_READY",
-            )
-            sched_addr = line.split()[1]
+            sched_addrs = []
+            for i in (0, 1):
+                args = [
+                    "dragonfly2_tpu.scheduler.server", "--port", "0",
+                    "--evaluator", "ml",
+                    "--manager", manager_addr,
+                    "--trainer", trainer_addr, "--trainer-interval", "2",
+                    "--model-watch-interval", "1",
+                    "--telemetry-dir", str(tmp_path / f"tel{i}"),
+                    "--metrics-port", str(metrics_ports[i]),
+                    "--hostname", f"sch{i + 1}",
+                    "--federation-interval", "0.5",
+                ]
+                if sched_addrs:  # chain: push-pull converges both directions
+                    args += ["--federation-peers", ",".join(sched_addrs)]
+                line = spawn(args, "SCHEDULER_READY")
+                sched_addrs.append(line.split()[1])
+            sched_spec = ",".join(sched_addrs)
+            ring = ConsistentHashRing(sched_addrs)
             socks = []
             for name in ("md1", "md2"):
                 sock = str(tmp_path / f"{name}.sock")
                 socks.append(sock)
                 spawn(
-                    ["dragonfly2_tpu.daemon.server", "--scheduler", sched_addr,
+                    ["dragonfly2_tpu.daemon.server", "--scheduler", sched_spec,
                      "--sock", sock, "--storage", str(tmp_path / f"store_{name}"),
                      "--hostname", name, "--probe-interval", "0.5"],
                     "DAEMON_READY",
@@ -546,75 +562,136 @@ class TestClusterMLLoop:
                 return subprocess.run(
                     [sys.executable, "-m", "dragonfly2_tpu.cli.dfget", url,
                      "-O", str(out), "--sock", sock, "--no-spawn",
-                     "--scheduler", sched_addr],
+                     "--scheduler", sched_spec],
                     capture_output=True, text=True, env=env, timeout=120,
                 )
+
+            def files_owned_by(owner_addr, want, start):
+                """Payload files whose task ids the ring assigns to owner
+                (tmp_path is random, so ownership must be computed live)."""
+                out, i = [], start
+                while len(out) < want:
+                    f = tmp_path / f"f{i}.bin"
+                    if ring.pick(idgen.task_id(f"file://{f}")) == owner_addr:
+                        f.write_bytes(os.urandom(200_000))
+                        out.append(f)
+                    i += 1
+                return out, i
 
             # base fallback is the expected mode BEFORE any telemetry exists
             # (checked before the downloads: a fast machine can train and
             # activate while the download loop is still running)
-            assert metric_value(
-                metrics_text(), 'dragonfly_scheduler_ml_serving_mode{mode="base"}'
-            ) == 1.0
+            for port in metrics_ports:
+                assert metric_value(
+                    metrics_text(port), 'dragonfly_scheduler_ml_serving_mode{mode="base"}'
+                ) == 1.0
 
             # downloads on d1 (seed) then d2 (p2p) produce (parent,child)
-            # telemetry rows; 6 files > the trainer's min_pairs=4
-            for i in range(6):
-                f = tmp_path / f"f{i}.bin"
-                f.write_bytes(os.urandom(200_000))
-                r = dfget(socks[0], f"file://{f}", tmp_path / f"o1_{i}.bin")
+            # telemetry rows ON BOTH ring members: 3 tasks owned by each
+            # (> the trainer's min_pairs=4 combined) — proving both members
+            # feed the ONE trainer
+            files_a, nxt = files_owned_by(sched_addrs[0], 3, 0)
+            files_b, nxt = files_owned_by(sched_addrs[1], 3, nxt)
+            for j, f in enumerate(files_a + files_b):
+                r = dfget(socks[0], f"file://{f}", tmp_path / f"o1_{j}.bin")
                 assert r.returncode == 0, r.stderr
-                r = dfget(socks[1], f"file://{f}", tmp_path / f"o2_{i}.bin")
+                r = dfget(socks[1], f"file://{f}", tmp_path / f"o2_{j}.bin")
                 assert r.returncode == 0, r.stderr
 
-            # announcer (2s) -> trainer -> registry -> model watch (1s):
-            # within the deadline the serving mode must flip to native
+            # announcers (2s) -> trainer merged pool -> registry -> model
+            # watch (1s): within the deadline BOTH members must flip native
             deadline = time.monotonic() + 120
+            texts = [None, None]
             while time.monotonic() < deadline:
-                text = metrics_text()
-                if metric_value(
-                    text, 'dragonfly_scheduler_ml_serving_mode{mode="native"}'
-                ) == 1.0:
+                texts = [metrics_text(p) for p in metrics_ports]
+                if all(
+                    metric_value(
+                        t, 'dragonfly_scheduler_ml_serving_mode{mode="native"}'
+                    ) == 1.0
+                    for t in texts
+                ):
                     break
                 time.sleep(1.0)
             else:
-                pytest.fail(f"model never activated; metrics:\n{text}")
-            assert metric_value(
-                text, "dragonfly_scheduler_ml_embeddings_refresh_timestamp_seconds"
-            ) > 0
+                pytest.fail(f"model never activated on both; metrics:\n{texts[0]}\n{texts[1]}")
+            for t in texts:
+                assert metric_value(
+                    t, "dragonfly_scheduler_ml_embeddings_refresh_timestamp_seconds"
+                ) > 0
 
-            fallback_before = metric_value(
-                text, 'dragonfly_scheduler_ml_base_fallback_total{reason="no_scorer"}'
-            )
-            unknown_before = metric_value(
-                text, 'dragonfly_scheduler_ml_base_fallback_total{reason="unknown_hosts"}'
-            )
-            rounds_before = metric_value(
-                text, "dragonfly_scheduler_schedule_duration_seconds_count"
-            )
+            # ONE cluster-wide model row (scheduler_id 0), attributed to
+            # BOTH contributing schedulers once their uploads merged
+            async def check_registry():
+                from dragonfly2_tpu.rpc.manager import RemoteManagerClient
 
-            # post-activation downloads: the p2p rounds these trigger must be
-            # scored by the activated model, not the base fallback
-            for i in range(6, 8):
-                f = tmp_path / f"f{i}.bin"
-                f.write_bytes(os.urandom(200_000))
-                assert dfget(socks[0], f"file://{f}", tmp_path / f"o1_{i}.bin").returncode == 0
-                assert dfget(socks[1], f"file://{f}", tmp_path / f"o2_{i}.bin").returncode == 0
+                mc = RemoteManagerClient(manager_addr)
+                try:
+                    dl = time.monotonic() + 60
+                    while time.monotonic() < dl:
+                        row = await mc.active_model("gnn", 0)
+                        got = set((row or {}).get("evaluation", {}).get("contributors", ()))
+                        if {"sch1", "sch2"} <= got:
+                            return row
+                        await asyncio.sleep(1.0)
+                    raise AssertionError(
+                        f"cluster-wide model never attributed to both: {row}"
+                    )
+                finally:
+                    await mc.close()
 
-            text = metrics_text()
-            rounds_after = metric_value(
-                text, "dragonfly_scheduler_schedule_duration_seconds_count"
-            )
-            assert rounds_after > rounds_before  # scheduling rounds did run
-            for reason, before in (
-                ("no_scorer", fallback_before), ("unknown_hosts", unknown_before),
-            ):
-                after = metric_value(
-                    text,
-                    f'dragonfly_scheduler_ml_base_fallback_total{{reason="{reason}"}}',
+            asyncio.run(check_registry())
+
+            # the federation gossip is live: some member holds probe edges
+            # it never ingested locally (daemon probes route per-host to ONE
+            # ring owner; the other member sees them only via sync)
+            async def check_federation():
+                from dragonfly2_tpu.rpc.scheduler import RemoteSchedulerClient
+
+                states = []
+                for addr in sched_addrs:
+                    c = RemoteSchedulerClient(addr, retries=0)
+                    try:
+                        states.append(await c.federation_state())
+                    finally:
+                        await c.close()
+                assert any(s["remote_edges"] > 0 for s in states), states
+
+            asyncio.run(check_federation())
+
+            fallback_before = []
+            rounds_before = []
+            for t in texts:
+                fallback_before.append((
+                    metric_value(t, 'dragonfly_scheduler_ml_base_fallback_total{reason="no_scorer"}'),
+                    metric_value(t, 'dragonfly_scheduler_ml_base_fallback_total{reason="unknown_hosts"}'),
+                ))
+                rounds_before.append(
+                    metric_value(t, "dragonfly_scheduler_schedule_duration_seconds_count")
                 )
-                # NaN == never incremented at all, which also passes
-                assert not (after > before), (reason, before, after, text)
+
+            # post-activation downloads, one task owned by EACH member: the
+            # p2p rounds must be scored by the activated model on both
+            post_a, nxt = files_owned_by(sched_addrs[0], 1, nxt)
+            post_b, _ = files_owned_by(sched_addrs[1], 1, nxt)
+            for j, f in enumerate(post_a + post_b):
+                assert dfget(socks[0], f"file://{f}", tmp_path / f"p1_{j}.bin").returncode == 0
+                assert dfget(socks[1], f"file://{f}", tmp_path / f"p2_{j}.bin").returncode == 0
+
+            for i, port in enumerate(metrics_ports):
+                text = metrics_text(port)
+                rounds_after = metric_value(
+                    text, "dragonfly_scheduler_schedule_duration_seconds_count"
+                )
+                assert rounds_after > rounds_before[i], f"sch{i + 1} ran no rounds"
+                for reason, before in zip(
+                    ("no_scorer", "unknown_hosts"), fallback_before[i]
+                ):
+                    after = metric_value(
+                        text,
+                        f'dragonfly_scheduler_ml_base_fallback_total{{reason="{reason}"}}',
+                    )
+                    # NaN == never incremented at all, which also passes
+                    assert not (after > before), (i, reason, before, after)
         finally:
             for p in procs:
                 p.send_signal(signal.SIGTERM)

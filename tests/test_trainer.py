@@ -111,6 +111,41 @@ def test_scan_training_converges_and_matches_semantics():
     assert np.mean(losses[-10:]) < np.mean(losses[:10]) * 0.5, losses[:3] + losses[-3:]
 
 
+def test_remat_changes_lowered_hlo_at_16k_nodes():
+    """GNNTrainConfig.remat is live (ROADMAP #2 satellite; VERDICT #2): at
+    the 16k-node scaled shape the rematted step's lowered HLO differs from
+    the baseline and carries MORE matmuls — the backward pass re-runs the
+    GNN forward instead of holding the [N, K, H] activations. Lowering only
+    (ShapeDtypeStruct args for the scaled operands), no 16k compile/alloc."""
+    from dragonfly2_tpu.models.features import FEATURE_DIM
+    from dragonfly2_tpu.models.graphsage import TopoGraph
+    from dragonfly2_tpu.trainer.synthetic import EDGE_FEATURE_DIM
+
+    cfg = train_gnn.GNNTrainConfig(hidden=32, embed_dim=16, num_layers=2)
+    # params/opt-state shapes are node-count independent: init on a tiny
+    # graph, lower against the abstract 16k-node operands
+    tiny = synthetic.make_cluster(num_nodes=32, num_neighbors=4, num_pairs=64, seed=0)
+    state = train_gnn.init_state(cfg, tiny.graph)
+    N, K, B = 16384, 16, 1024
+    sds = jax.ShapeDtypeStruct
+    g16k = TopoGraph(
+        sds((N, tiny.graph.node_feats.shape[1]), jnp.float32),
+        sds((N, K), jnp.int32),
+        sds((N, K), jnp.float32),
+        sds((N, K, EDGE_FEATURE_DIM), jnp.float32),
+    )
+    batch = PairBatch(
+        sds((B,), jnp.int32), sds((B,), jnp.int32),
+        sds((B, FEATURE_DIM), jnp.float32), sds((B,), jnp.float32),
+    )
+    base = jax.jit(train_gnn.make_train_step(remat=False)).lower(state, g16k, batch).as_text()
+    remat = jax.jit(train_gnn.make_train_step(remat=True)).lower(state, g16k, batch).as_text()
+    assert base != remat, "remat knob did not change the lowered HLO"
+    assert remat.count("dot_general") > base.count("dot_general"), (
+        remat.count("dot_general"), base.count("dot_general"),
+    )
+
+
 def test_mlp_training_learns_bandwidth():
     """North-star config 1: MLP bandwidth predictor on download records."""
     import optax

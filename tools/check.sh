@@ -105,6 +105,15 @@ run_stage "concurrency-smoke" env JAX_PLATFORMS=cpu python -m pytest tests/test_
     -m 'concurrency and not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+# federation-smoke: the cluster-in-a-box boots manager + 2 federated
+# schedulers + 2 daemons + origin as REAL subprocesses, runs a real dfget
+# through the federation (seed + P2P, bit-exact), then asserts from the
+# collected trace files that the task's scheduling rounds rode EXACTLY ONE
+# scheduler (ring ownership) while federation sync spans appear on BOTH
+# (the gossip is live).
+run_stage "federation-smoke" env JAX_PLATFORMS=cpu python -m dragonfly2_tpu.cli.dfcluster \
+    demo --payload-kb 6144 --verify-trace
+
 # observability-smoke: one trace over the REAL rpc wire into two per-process
 # span files, reassembled by dftrace — propagation, all-or-nothing sampling,
 # and the critical-path identity (exclusive times sum to the root's wall)

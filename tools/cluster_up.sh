@@ -1,0 +1,11 @@
+#!/usr/bin/env bash
+# Cluster-in-a-box: boot manager + 2 federated schedulers + 2 daemons +
+# origin on localhost, run a real dfget through the federation, and stay up
+# until Ctrl-C. Thin wrapper over cli/dfcluster (see `--help` there for the
+# knobs: scheduler/daemon counts, swarm load, trace verification).
+#
+#   bash tools/cluster_up.sh                 # demo + stay up
+#   bash tools/cluster_up.sh --swarm 200     # + 200-peer dfstress swarm
+set -eu
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m dragonfly2_tpu.cli.dfcluster demo --keep "$@"
