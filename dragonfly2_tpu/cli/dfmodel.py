@@ -1,4 +1,5 @@
-"""dfmodel: publish / fetch model checkpoints over the P2P fabric.
+"""dfmodel: publish / fetch model checkpoints over the P2P fabric, and drive
+the serving-model rollout state machine.
 
 The config-4 CLI (no reference equivalent — SURVEY.md §2.4 flags this as the
 new TPU-VM component): `publish` imports a checkpoint directory into the P2P
@@ -8,6 +9,20 @@ cluster).
 
   python -m dragonfly2_tpu.cli.dfmodel publish ./llama-3-8b
   python -m dragonfly2_tpu.cli.dfmodel fetch ./llama-3-8b/dragonfly-checkpoint.json -O ./staged
+
+Rollout operations (ISSUE 11) talk straight to the MANAGER registry — no
+daemon involved:
+
+  dfmodel status   --manager host:port [--type gnn]
+  dfmodel promote  --manager host:port --version vNNN   (or --id N)
+  dfmodel rollback --manager host:port [--type gnn] [--reason why]
+
+`status` prints the active row, candidates mid-shadow (with their aggregate
+divergence windows), and recent rejects; `promote` pushes a candidate /
+shadowing version active (the manual gate when auto_promote is off);
+`rollback` rejects the current active version and re-activates the previous
+one — the registry half of what a scheduler's auto-rollback does on a
+post-swap health regression.
 """
 
 from __future__ import annotations
@@ -21,8 +36,90 @@ import sys
 from dragonfly2_tpu.cli.dfget import DEFAULT_SOCK, ensure_daemon
 from dragonfly2_tpu.rpc.core import RpcClient, RpcError
 
+ROLLOUT_CMDS = ("status", "promote", "rollback")
+
+
+async def _rollout_main(args: argparse.Namespace) -> int:
+    """Manager-registry subcommands (status/promote/rollback)."""
+    from dragonfly2_tpu.rpc.manager import RemoteManagerClient
+
+    mc = RemoteManagerClient(args.manager, timeout=args.timeout)
+    try:
+        if args.cmd == "status":
+            st = await mc.rollout_status(args.type, args.scheduler_id)
+            if args.json:
+                print(json.dumps(st, indent=2, default=str))
+                return 0
+            pol = st["policy"]
+            print(
+                f"rollout[{args.type}]: gated={pol['gated']} "
+                f"auto_promote={pol['auto_promote']} "
+                f"min_rounds={pol['gates']['min_rounds']}"
+            )
+            act = st["active"]
+            print(
+                "  active:    "
+                + (f"{act['version']} (id {act['id']})" if act else "<none>")
+            )
+            for c in st["candidates"]:
+                agg = (c.get("rollout") or {}).get("aggregate") or {}
+                print(
+                    f"  {c['state']:<9}  {c['version']} (id {c['id']})"
+                    f"  rounds={agg.get('rounds', 0)}"
+                    f" topk={agg.get('topk_overlap_mean', 0.0):.3f}"
+                    f" corr={agg.get('rank_corr_mean', 0.0):.3f}"
+                    f" delta={agg.get('abs_delta_mean', 0.0):.4f}"
+                    f" errors={agg.get('errors', 0)}"
+                )
+            for r in st["rejected"]:
+                reason = (r.get("rollout") or {}).get("rejected_reason", "")
+                print(f"  rejected:  {r['version']} (id {r['id']})  {reason}")
+            return 0
+        if args.cmd == "promote":
+            model_id = args.id
+            if model_id is None:
+                # scheduler_id is part of the row key (UNIQUE(type, version,
+                # scheduler_id)) — without it the lowest-id row of ANOTHER
+                # scheduler could be promoted instead of the one asked for
+                rows = await mc.list_models(
+                    type=args.type, version=args.version,
+                    scheduler_id=args.scheduler_id,
+                )
+                if not rows:
+                    print(
+                        f"error: no {args.type} model {args.version} "
+                        f"(scheduler_id {args.scheduler_id})",
+                        file=sys.stderr,
+                    )
+                    return 1
+                model_id = rows[0]["id"]
+            row = await mc.promote_model(model_id)
+            print(json.dumps({"id": row["id"], "version": row["version"], "state": row["state"]}))
+            return 0
+        # rollback
+        out = await mc.rollback_model(args.type, args.scheduler_id, reason=args.reason)
+        print(
+            json.dumps(
+                {
+                    "rolled_back": out["rolled_back"]["version"],
+                    "active": out["active"]["version"],
+                }
+            )
+        )
+        return 0
+    except RpcError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    finally:
+        await mc.close()
+
 
 async def _amain(args: argparse.Namespace) -> int:
+    if args.cmd in ROLLOUT_CMDS:
+        if not args.manager:
+            print(f"error: dfmodel {args.cmd} requires --manager", file=sys.stderr)
+            return 2
+        return await _rollout_main(args)
     if not await ensure_daemon(
         args.sock, args.scheduler, args.storage,
         no_spawn=args.no_spawn, spawn_timeout=args.spawn_timeout,
@@ -74,7 +171,24 @@ def main() -> None:
     p.add_argument("manifest", help="manifest path or URL")
     p.add_argument("-O", "--output", required=True)
     p.add_argument("--concurrency", type=int, default=4)
+
+    def rollout_parser(name: str, help_: str):
+        rp = sub.add_parser(name, help=help_)
+        rp.add_argument("--manager", required=True, help="manager address host:port")
+        rp.add_argument("--type", default="gnn", help="model type (default gnn)")
+        rp.add_argument("--scheduler-id", type=int, default=0)
+        return rp
+
+    p = rollout_parser("status", "rollout state: active / shadowing / rejected versions")
+    p.add_argument("--json", action="store_true")
+    p = rollout_parser("promote", "promote a candidate/shadowing version to active")
+    p.add_argument("--version", default=None)
+    p.add_argument("--id", type=int, default=None)
+    p = rollout_parser("rollback", "reject the active version, re-activate the previous")
+    p.add_argument("--reason", default="operator rollback")
     args = ap.parse_args()
+    if args.cmd == "promote" and args.version is None and args.id is None:
+        ap.error("promote needs --version or --id")
     sys.exit(asyncio.run(_amain(args)))
 
 

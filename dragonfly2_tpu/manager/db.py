@@ -106,6 +106,8 @@ CREATE TABLE IF NOT EXISTS models (
     state TEXT NOT NULL DEFAULT 'inactive',
     evaluation TEXT NOT NULL DEFAULT '{}',
     artifact_path TEXT NOT NULL DEFAULT '',
+    artifact_digest TEXT NOT NULL DEFAULT '',
+    rollout TEXT NOT NULL DEFAULT '{}',
     scheduler_id INTEGER NOT NULL DEFAULT 0,
     created_at REAL NOT NULL,
     updated_at REAL NOT NULL,
@@ -151,8 +153,16 @@ CREATE TABLE IF NOT EXISTS jobs (
 
 _JSON_COLS = {
     "config", "client_config", "scopes", "priority", "value", "evaluation",
-    "features", "args", "result", "scheduler_cluster_ids",
+    "features", "args", "result", "scheduler_cluster_ids", "rollout",
 }
+
+# Columns added after a table first shipped: CREATE TABLE IF NOT EXISTS
+# won't touch an existing on-disk DB, so boot applies these additively
+# (ALTER TABLE ADD COLUMN is a no-op failure when the column exists).
+_MIGRATIONS = (
+    "ALTER TABLE models ADD COLUMN artifact_digest TEXT NOT NULL DEFAULT ''",
+    "ALTER TABLE models ADD COLUMN rollout TEXT NOT NULL DEFAULT '{}'",
+)
 
 
 def _encode(fields: dict[str, Any]) -> dict[str, Any]:
@@ -193,6 +203,11 @@ class Database:
             if self.path != ":memory:":
                 self._conn.execute("PRAGMA journal_mode=WAL")
             self._conn.executescript(SCHEMA)
+            for mig in _MIGRATIONS:
+                try:
+                    self._conn.execute(mig)
+                except sqlite3.OperationalError:
+                    pass  # column already there (fresh schema or prior boot)
             self._conn.commit()
 
     def close(self) -> None:

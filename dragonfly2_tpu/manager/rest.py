@@ -111,6 +111,11 @@ class ManagerRest:
         r.add_post("/api/v1/models", self.create_model)
         r.add_post(r"/api/v1/models/{id:\d+}/activate", self.activate_model)
         r.add_delete(r"/api/v1/models/{id:\d+}", self.delete_model)
+        # rollout state machine (ISSUE 11): status / promote / rollback
+        r.add_get("/api/v1/models/rollout/{type}", self.rollout_status)
+        r.add_post(r"/api/v1/models/{id:\d+}/promote", self.promote_model)
+        r.add_post(r"/api/v1/models/{id:\d+}/reject", self.reject_model)
+        r.add_post("/api/v1/models/rollout/{type}/rollback", self.rollback_model)
         # jobs (preheat)
         r.add_post("/api/v1/jobs", self.create_job)
         r.add_get(r"/api/v1/jobs/{id:\d+}", self.get_job)
@@ -292,6 +297,42 @@ class ManagerRest:
     async def delete_model(self, req: web.Request) -> web.Response:
         ok = self.svc.delete_model(int(req.match_info["id"]))
         return _json({"deleted": ok}, status=200 if ok else 404)
+
+    async def rollout_status(self, req: web.Request) -> web.Response:
+        sid = int(req.query.get("scheduler_id", 0))
+        return _json(self.svc.rollout_status(req.match_info["type"], sid))
+
+    async def promote_model(self, req: web.Request) -> web.Response:
+        try:
+            return _json(self.svc.promote_model(int(req.match_info["id"])))
+        except KeyError:
+            return _json({"error": "not found"}, status=404)
+        except ValueError as e:
+            return _json({"error": str(e)}, status=409)
+
+    async def reject_model(self, req: web.Request) -> web.Response:
+        body = await req.json() if req.can_read_body else {}
+        try:
+            return _json(
+                self.svc.reject_model(int(req.match_info["id"]), body.get("reason", ""))
+            )
+        except KeyError:
+            return _json({"error": "not found"}, status=404)
+        except ValueError as e:
+            return _json({"error": str(e)}, status=409)
+
+    async def rollback_model(self, req: web.Request) -> web.Response:
+        body = await req.json() if req.can_read_body else {}
+        try:
+            return _json(
+                self.svc.rollback_model(
+                    req.match_info["type"],
+                    int(body.get("scheduler_id", 0)),
+                    reason=body.get("reason", ""),
+                )
+            )
+        except ValueError as e:
+            return _json({"error": str(e)}, status=409)
 
     # ---- jobs ----
 

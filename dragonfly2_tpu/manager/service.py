@@ -231,7 +231,23 @@ class ManagerService:
             ],
         }
 
-    # ---------- model registry (completes ref CreateModel TODO) ----------
+    # ---------- model registry + rollout state machine (ISSUE 11) ----------
+    #
+    # Completes ref CreateModel TODO, then adds the safe-rollout lifecycle:
+    #
+    #     candidate → shadowing → active | rejected       (shadow gate)
+    #     active → rejected, previous → active            (rollback)
+    #
+    # The policy (which types are gated, the divergence bounds, whether a
+    # passing window auto-promotes) lives in the `model_rollout` config row;
+    # with no policy configured publish_model() activates directly — the
+    # pre-ISSUE-11 behavior.
+
+    def rollout_policy(self):
+        from dragonfly2_tpu.scheduler.rollout import RolloutPolicy
+
+        row = self.get_config("model_rollout")
+        return RolloutPolicy.from_config(row["value"] if row else None)
 
     def create_model(
         self,
@@ -242,6 +258,7 @@ class ManagerService:
         bio: str = "",
         evaluation: dict | None = None,
         artifact_path: str = "",
+        artifact_digest: str = "",
     ) -> dict:
         if model_type not in (MODEL_GNN, MODEL_MLP):
             raise ValueError(f"unknown model type {model_type!r}")
@@ -251,12 +268,73 @@ class ManagerService:
             bio=bio,
             evaluation=evaluation or {},
             artifact_path=artifact_path,
+            artifact_digest=artifact_digest,
         )
+
+    def publish_model(
+        self,
+        model_type: str,
+        version: str,
+        *,
+        scheduler_id: int = 0,
+        bio: str = "",
+        evaluation: dict | None = None,
+        artifact_path: str = "",
+        artifact_digest: str = "",
+    ) -> dict:
+        """The trainer's registration entry: create the version row and route
+        it through the rollout policy — gated types start as CANDIDATE (the
+        schedulers' shadow reports drive promotion), ungated types activate
+        immediately (the pre-rollout behavior, and the default)."""
+        from dragonfly2_tpu.scheduler.rollout import STATE_CANDIDATE
+
+        row = self.create_model(
+            model_type, version, scheduler_id=scheduler_id, bio=bio,
+            evaluation=evaluation, artifact_path=artifact_path,
+            artifact_digest=artifact_digest,
+        )
+        policy = self.rollout_policy()
+        if not policy.gated(model_type):
+            return self.activate_model(row["id"])
+        from dragonfly2_tpu.scheduler.rollout import STATE_SHADOWING
+
+        # continual training: a NEWER candidate supersedes any still-pending
+        # one of the same (type, scheduler) — schedulers already shadow only
+        # the newest, so the displaced row would otherwise sit "shadowing"
+        # forever and the candidate list would grow with every train run
+        # (observed live under a 3 s upload cadence)
+        for state in (STATE_CANDIDATE, STATE_SHADOWING):
+            for stale in self.db.find(
+                "models", type=model_type, scheduler_id=scheduler_id, state=state
+            ):
+                if stale["id"] != row["id"]:
+                    self.reject_model(stale["id"], f"superseded by {version}")
+        rollout = dict(row.get("rollout") or {})
+        rollout.update(
+            gates=policy.gates.to_dict(),
+            auto_promote=policy.auto_promote,
+            schedulers={},
+        )
+        self._model_event(rollout, "published as candidate")
+        self.db.update("models", row["id"], state=STATE_CANDIDATE, rollout=rollout)
+        logger.info(
+            "model %s %s registered as rollout candidate (gate: >=%d shadow rounds)",
+            model_type, version, policy.gates.min_rounds,
+        )
+        return self.db.get("models", row["id"])
+
+    @staticmethod
+    def _model_event(rollout: dict, event: str) -> None:
+        history = rollout.setdefault("history", [])
+        history.append({"at": time.time(), "event": event})
+        del history[:-20]  # bounded operator breadcrumb trail
 
     def activate_model(self, model_id: int) -> dict:
         """Make this version active; deactivate siblings of the same
         (type, scheduler) — the reference's per-scheduler unique active
-        version semantics (models/model.go:19-27)."""
+        version semantics (models/model.go:19-27). Records the version it
+        displaced in the row's rollout state so rollback_model knows where
+        to return to."""
         from dragonfly2_tpu.observability.tracing import default_tracer
 
         row = self.db.get("models", model_id)
@@ -269,13 +347,219 @@ class ManagerService:
             "manager.activate_model",
             model_id=model_id, model_type=row["type"], version=row["version"],
         ):
+            previous = self.active_model(row["type"], row["scheduler_id"])
+            rollout = dict(row.get("rollout") or {})
+            if previous is not None and previous["id"] != model_id:
+                rollout["previous_active_id"] = previous["id"]
+                rollout["previous_active_version"] = previous["version"]
+            self._model_event(rollout, "activated")
             self.db.update_where(
                 "models",
                 {"type": row["type"], "scheduler_id": row["scheduler_id"], "state": STATE_ACTIVE},
                 state=STATE_INACTIVE,
             )
-            self.db.update("models", model_id, state=STATE_ACTIVE)
+            self.db.update("models", model_id, state=STATE_ACTIVE, rollout=rollout)
         return self.db.get("models", model_id)
+
+    def promote_model(self, model_id: int) -> dict:
+        """candidate | shadowing → active (operator `dfmodel promote`, or the
+        auto-promotion path when a shadow window passes its gates). Also
+        accepts an inactive row — the manual re-pin an operator needs after
+        a bad rollback. Rejected rows stay rejected: re-promoting a version
+        the gate (or a rollback) refused requires re-publishing it."""
+        from dragonfly2_tpu.scheduler.rollout import (
+            STATE_CANDIDATE, STATE_REJECTED, STATE_SHADOWING,
+        )
+
+        row = self.db.get("models", model_id)
+        if row is None:
+            raise KeyError(model_id)
+        if row["state"] == STATE_ACTIVE:
+            return row  # idempotent
+        if row["state"] == STATE_REJECTED:
+            raise ValueError(
+                f"model {row['version']} is rejected; republish it instead of promoting"
+            )
+        if row["state"] not in (STATE_CANDIDATE, STATE_SHADOWING, STATE_INACTIVE):
+            raise ValueError(f"cannot promote model in state {row['state']!r}")
+        return self.activate_model(model_id)
+
+    def reject_model(self, model_id: int, reason: str = "") -> dict:
+        """candidate | shadowing → rejected (failed gates, corrupt artifact,
+        or operator veto). Terminal: the version never serves."""
+        from dragonfly2_tpu.scheduler.rollout import (
+            STATE_CANDIDATE, STATE_REJECTED, STATE_SHADOWING,
+        )
+
+        row = self.db.get("models", model_id)
+        if row is None:
+            raise KeyError(model_id)
+        if row["state"] == STATE_REJECTED:
+            return row  # idempotent
+        if row["state"] not in (STATE_CANDIDATE, STATE_SHADOWING):
+            raise ValueError(f"cannot reject model in state {row['state']!r}")
+        rollout = dict(row.get("rollout") or {})
+        rollout["rejected_reason"] = reason
+        self._model_event(rollout, f"rejected: {reason}" if reason else "rejected")
+        self.db.update("models", model_id, state=STATE_REJECTED, rollout=rollout)
+        logger.warning("model %s %s REJECTED: %s", row["type"], row["version"], reason)
+        return self.db.get("models", model_id)
+
+    def rollback_model(
+        self, model_type: str, scheduler_id: int = 0, *, reason: str = ""
+    ) -> dict:
+        """active → rejected, previous active → active. The registry half of
+        the auto-rollback (the scheduler has already re-attached its warm
+        previous bundle when it calls this; operators reach it via `dfmodel
+        rollback`). The restored row's own previous-pointer is left
+        untouched so a second rollback keeps walking BACK, never bounces
+        onto the row just rejected."""
+        from dragonfly2_tpu.scheduler.rollout import STATE_REJECTED
+
+        bad = self.active_model(model_type, scheduler_id)
+        if bad is None:
+            raise ValueError(f"no active {model_type} model to roll back")
+        rollout = dict(bad.get("rollout") or {})
+        prev_id = rollout.get("previous_active_id")
+        if prev_id is None:
+            # fall back to the newest inactive sibling — a registry that
+            # predates rollout bookkeeping still has the displaced rows
+            siblings = [
+                r for r in self.db.find(
+                    "models", type=model_type, scheduler_id=scheduler_id,
+                    state=STATE_INACTIVE,
+                )
+                if r["id"] != bad["id"]
+            ]
+            if not siblings:
+                raise ValueError(
+                    f"active {model_type} model {bad['version']} has no previous "
+                    "version to roll back to"
+                )
+            prev_id = max(siblings, key=lambda r: r["updated_at"])["id"]
+        prev = self.db.get("models", prev_id)
+        if prev is None:
+            raise ValueError(f"previous model row {prev_id} is gone")
+        rollout["rejected_reason"] = reason or "rolled back"
+        self._model_event(rollout, f"rolled back: {reason}" if reason else "rolled back")
+        self.db.update("models", bad["id"], state=STATE_REJECTED, rollout=rollout)
+        prev_rollout = dict(prev.get("rollout") or {})
+        self._model_event(prev_rollout, f"re-activated by rollback of {bad['version']}")
+        self.db.update("models", prev["id"], state=STATE_ACTIVE, rollout=prev_rollout)
+        logger.warning(
+            "model %s ROLLED BACK: %s -> %s (%s)",
+            model_type, bad["version"], prev["version"], reason or "health regression",
+        )
+        return {
+            "rolled_back": self.db.get("models", bad["id"]),
+            "active": self.db.get("models", prev["id"]),
+        }
+
+    def report_shadow(self, model_id: int, hostname: str, report: dict) -> dict:
+        """One scheduler's shadow-window report for a candidate. Merges it
+        into the row (per-scheduler, cluster-wide aggregate recomputed),
+        drives candidate → shadowing on first contact, and — when the
+        aggregate window closes — promotes or rejects per the stored gates.
+        Returns {"state", "verdict", "reasons", "aggregate"} so the reporter
+        learns the decision on the same RPC.
+
+        A report carrying "error" (corrupt artifact, load failure) rejects
+        the candidate immediately: an artifact that cannot attach anywhere
+        must not keep the rollout pending forever."""
+        from dragonfly2_tpu.scheduler.rollout import (
+            DivergenceGates, STATE_CANDIDATE, STATE_SHADOWING, merge_reports,
+        )
+
+        row = self.db.get("models", model_id)
+        if row is None:
+            raise KeyError(model_id)
+        state = row["state"]
+        if state not in (STATE_CANDIDATE, STATE_SHADOWING):
+            # promotion/rejection raced this report — answer with the truth
+            return {"state": state, "verdict": None, "reasons": [], "aggregate": {}}
+        rollout = dict(row.get("rollout") or {})
+        if report.get("error"):
+            rejected = self.reject_model(
+                model_id, f"{hostname}: {report['error']}"
+            )
+            return {
+                "state": rejected["state"], "verdict": False,
+                "reasons": [report["error"]], "aggregate": {},
+            }
+        per_sched = dict(rollout.get("schedulers") or {})
+        per_sched[hostname or "scheduler"] = report
+        rollout["schedulers"] = per_sched
+        aggregate = merge_reports(list(per_sched.values()))
+        rollout["aggregate"] = aggregate
+        if state == STATE_CANDIDATE:
+            state = STATE_SHADOWING
+            self._model_event(rollout, f"shadowing started ({hostname})")
+        gates = DivergenceGates.from_dict(rollout.get("gates"))
+        verdict, reasons = gates.evaluate(aggregate)
+        if verdict is None or not rollout.get("auto_promote", True):
+            self.db.update("models", model_id, state=state, rollout=rollout)
+            if verdict is not None:
+                # window closed but promotion is manual — surface the verdict
+                rollout["gate_verdict"] = {"passed": verdict, "reasons": reasons}
+                self.db.update("models", model_id, rollout=rollout)
+            return {
+                "state": state, "verdict": verdict,
+                "reasons": reasons, "aggregate": aggregate,
+            }
+        self.db.update("models", model_id, state=state, rollout=rollout)
+        if verdict:
+            promoted = self.promote_model(model_id)
+            logger.info(
+                "model %s %s PROMOTED by shadow gate (%d rounds)",
+                row["type"], row["version"], aggregate.get("rounds", 0),
+            )
+            return {
+                "state": promoted["state"], "verdict": True,
+                "reasons": [], "aggregate": aggregate,
+            }
+        rejected = self.reject_model(model_id, "; ".join(reasons))
+        return {
+            "state": rejected["state"], "verdict": False,
+            "reasons": reasons, "aggregate": aggregate,
+        }
+
+    def rollout_status(self, model_type: str, scheduler_id: int = 0) -> dict:
+        """Everything the scheduler watch loop and `dfmodel status` need in
+        one call: the active row, candidate/shadowing rows (cluster-wide
+        scheduler_id-0 rows included, federation semantics), recent rejects,
+        and the effective policy."""
+        from dragonfly2_tpu.scheduler.rollout import (
+            STATE_CANDIDATE, STATE_REJECTED, STATE_SHADOWING,
+        )
+
+        active = self.active_model(model_type, scheduler_id)
+        if active is None and scheduler_id:
+            active = self.active_model(model_type, 0)
+        sids = {scheduler_id, 0}
+        candidates = [
+            r
+            for state in (STATE_CANDIDATE, STATE_SHADOWING)
+            for r in self.db.find("models", type=model_type, state=state)
+            if r["scheduler_id"] in sids
+        ]
+        candidates.sort(key=lambda r: r["id"])
+        rejected = [
+            r for r in self.db.find("models", type=model_type, state=STATE_REJECTED)
+            if r["scheduler_id"] in sids
+        ]
+        policy = self.rollout_policy()
+        return {
+            "type": model_type,
+            "active": active,
+            "candidates": candidates,
+            "rejected": rejected[-3:],
+            "policy": {
+                "enabled": policy.enabled,
+                "gated": policy.gated(model_type),
+                "auto_promote": policy.auto_promote,
+                "gates": policy.gates.to_dict(),
+            },
+        }
 
     def active_model(self, model_type: str, scheduler_id: int = 0) -> Optional[dict]:
         return self.db.find_one(

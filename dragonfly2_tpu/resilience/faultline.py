@@ -13,6 +13,8 @@ registry injects faults behind named points threaded through the hot paths:
     source.body        origin source chunk payload           truncate corrupt
     storage.write      storage piece writes            latency error
     storage.meta       metadata (save_metadata) flush  latency error
+    model.load         model artifact read + digest    latency error truncate corrupt
+    model.swap         evaluator scorer hot-swap             error drop
 
 Fault kinds:
     latency   sleep `param` seconds (default 0.05) before proceeding

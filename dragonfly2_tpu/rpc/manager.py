@@ -23,6 +23,12 @@ MANAGER_METHODS = [
     "activate_model",
     "active_model",
     "list_models",
+    "publish_model",
+    "promote_model",
+    "reject_model",
+    "rollback_model",
+    "report_shadow",
+    "rollout_status",
     "list_applications",
     "get_config",
     "set_config",
@@ -87,6 +93,37 @@ class ManagerRpcAdapter:
 
     async def active_model(self, p: dict) -> Optional[dict]:
         return self.svc.active_model(p["type"], p.get("scheduler_id", 0))
+
+    # ---- rollout state machine (ISSUE 11) ----
+
+    async def publish_model(self, p: dict) -> dict:
+        return self.svc.publish_model(
+            p["type"], p["version"],
+            scheduler_id=p.get("scheduler_id", 0),
+            bio=p.get("bio", ""),
+            evaluation=p.get("evaluation"),
+            artifact_path=p.get("artifact_path", ""),
+            artifact_digest=p.get("artifact_digest", ""),
+        )
+
+    async def promote_model(self, p: dict) -> dict:
+        return self.svc.promote_model(p["model_id"])
+
+    async def reject_model(self, p: dict) -> dict:
+        return self.svc.reject_model(p["model_id"], p.get("reason", ""))
+
+    async def rollback_model(self, p: dict) -> dict:
+        return self.svc.rollback_model(
+            p["type"], p.get("scheduler_id", 0), reason=p.get("reason", "")
+        )
+
+    async def report_shadow(self, p: dict) -> dict:
+        return self.svc.report_shadow(
+            p["model_id"], p.get("hostname", ""), p.get("report") or {}
+        )
+
+    async def rollout_status(self, p: dict) -> dict:
+        return self.svc.rollout_status(p["type"], p.get("scheduler_id", 0))
 
     async def list_models(self, p: dict) -> list[dict]:
         # allowlist filter keys: db.find interpolates keys as SQL identifiers
@@ -193,6 +230,34 @@ class RemoteManagerClient:
 
     async def active_model(self, model_type: str, scheduler_id: int = 0) -> Optional[dict]:
         return await self._c.call("active_model", {"type": model_type, "scheduler_id": scheduler_id})
+
+    async def publish_model(self, model_type: str, version: str, **kw: Any) -> dict:
+        return await self._c.call("publish_model", {"type": model_type, "version": version, **kw})
+
+    async def promote_model(self, model_id: int) -> dict:
+        return await self._c.call("promote_model", {"model_id": model_id})
+
+    async def reject_model(self, model_id: int, reason: str = "") -> dict:
+        return await self._c.call("reject_model", {"model_id": model_id, "reason": reason})
+
+    async def rollback_model(
+        self, model_type: str, scheduler_id: int = 0, *, reason: str = ""
+    ) -> dict:
+        return await self._c.call(
+            "rollback_model",
+            {"type": model_type, "scheduler_id": scheduler_id, "reason": reason},
+        )
+
+    async def report_shadow(self, model_id: int, hostname: str, report: dict) -> dict:
+        return await self._c.call(
+            "report_shadow",
+            {"model_id": model_id, "hostname": hostname, "report": report},
+        )
+
+    async def rollout_status(self, model_type: str, scheduler_id: int = 0) -> dict:
+        return await self._c.call(
+            "rollout_status", {"type": model_type, "scheduler_id": scheduler_id}
+        )
 
     async def list_models(self, **where: Any) -> list[dict]:
         return await self._c.call("list_models", where)

@@ -31,6 +31,34 @@ class SchedulingSection:
 
 
 @dataclass
+class RolloutSection:
+    """Scheduler-side live-model rollout knobs (ISSUE 11). The divergence
+    GATES are manager-side (`model_rollout` config row); these control this
+    scheduler's shadow-leg sampling and its post-swap health window."""
+
+    shadow_sample_rate: float = cfgfield(
+        1.0, minimum=0.001, maximum=1.0,
+        help="fraction of scheduling rounds the candidate shadow-scores",
+    )
+    health_window_s: float = cfgfield(60.0, minimum=0.1)
+    health_min_rounds: int = cfgfield(50, minimum=1)
+    max_fallback_rate_increase: float = cfgfield(0.2, minimum=0.0, maximum=1.0)
+    max_error_rate_increase: float = cfgfield(0.05, minimum=0.0, maximum=1.0)
+    max_latency_ratio: float = cfgfield(5.0, minimum=1.0)
+
+    def health_gates(self):
+        from dragonfly2_tpu.scheduler.rollout import HealthGates
+
+        return HealthGates(
+            window_s=self.health_window_s,
+            min_rounds=self.health_min_rounds,
+            max_fallback_rate_increase=self.max_fallback_rate_increase,
+            max_error_rate_increase=self.max_error_rate_increase,
+            max_latency_ratio=self.max_latency_ratio,
+        )
+
+
+@dataclass
 class GCSection:
     """Resource TTLs in seconds (ref constants.go:81-93)."""
 
@@ -59,6 +87,7 @@ class SchedulerYaml:
     )
     federation_interval: Optional[float] = cfgfield(None, minimum=0.1)
     scheduling: SchedulingSection = cfgfield(default_factory=SchedulingSection)
+    rollout: RolloutSection = cfgfield(default_factory=RolloutSection)
     gc: GCSection = cfgfield(default_factory=GCSection)
     tracing: TracingSection = cfgfield(default_factory=TracingSection)
 

@@ -271,22 +271,45 @@ class Evaluator:
         return bad
 
 
+class _ShadowSlot:
+    """Candidate model + its divergence tracker, published as ONE attribute
+    store so a shadow round never mixes one candidate's bundle with another's
+    tracker (same read-once discipline as the serving bundle)."""
+
+    __slots__ = ("bundle", "tracker")
+
+    def __init__(self, bundle, tracker):
+        self.bundle = bundle
+        self.tracker = tracker
+
+
 class MLEvaluator(Evaluator):
     """GNN-scored evaluator with base fallback (the reference's unfilled slot).
 
     node_index maps host_id -> row in the topology graph the scorer was
     refreshed with; hosts unknown to the graph fall back to the base score.
+
+    Serving state is ONE immutable rollout.ModelBundle published as a single
+    attribute (`_serving`): every scoring entry reads it once and scores
+    entirely through that reference, so a hot-swap mid-traffic can never
+    produce a round scored half on the old model and half on the new
+    (ISSUE 11's zero-torn-rounds property). Bundle begin/end refcounts tell
+    the swapper when a replaced bundle has drained and its native forks can
+    be freed. A second slot (`_shadow`) carries a CANDIDATE model that scores
+    the same rounds log-only, recording divergence against whatever was
+    actually served — the evidence the rollout gate promotes on.
     """
 
     name = "ml"
 
     def __init__(self, scorer=None, node_index: dict[str, int] | None = None):
-        self._scorer = scorer
-        self._node_index = node_index or {}
-        self._microbatch = None
-        self._handle_pool = None  # native.ScorerHandlePool when sharded serving is on
+        self._serving: "rollout.ModelBundle | None" = None
+        self._shadow: _ShadowSlot | None = None
         self.refreshed_at: float | None = None
-        self._set_serving_mode(self._mode_of(scorer) if scorer is not None else "base")
+        if scorer is not None:
+            self.attach_scorer(scorer, node_index or {})
+        else:
+            self._set_serving_mode("base")
 
     @staticmethod
     def _mode_of(scorer) -> str:
@@ -320,10 +343,14 @@ class MLEvaluator(Evaluator):
         metrics.ML_BASE_FALLBACK_TOTAL.inc(reason=reason)
 
     def attach_scorer(
-        self, scorer, node_index: dict[str, int], *, microbatch=None, handle_pool=None
-    ) -> None:
+        self, scorer, node_index: dict[str, int], *,
+        microbatch=None, handle_pool=None, version: str = "",
+    ):
         """Hot-swap the model (called when the trainer publishes a version);
-        until then evaluate() serves the base fallback.
+        until then evaluate() serves the base fallback. Returns the PREVIOUS
+        serving bundle (or None) — the caller owns its lifecycle: the
+        ManagerLink keeps it warm for instant rollback, everyone else can
+        drop it (native handles free on GC as before).
 
         microbatch: optional native.MicroBatchScorer wrapping `scorer` — when
         set, evaluate_async coalesces concurrent scheduling rounds into one
@@ -336,17 +363,145 @@ class MLEvaluator(Evaluator):
         serializes on an internal mutex), which is what lets the round
         dispatcher's workers overlap their FFI legs across cores.
         """
+        from dragonfly2_tpu.scheduler import rollout
+
+        return self.swap_bundle(
+            rollout.ModelBundle(
+                scorer, node_index, version=version,
+                microbatch=microbatch, handle_pool=handle_pool,
+            )
+        )
+
+    def swap_bundle(self, bundle):
+        """Publish `bundle` as the serving model in ONE attribute store (the
+        zero-drop swap primitive: in-flight rounds finish on the bundle they
+        read at entry; new rounds read this one). Returns the previous
+        bundle. Accepts None to drop to base serving."""
         import time
 
         from dragonfly2_tpu.scheduler import metrics
 
-        self._scorer = scorer
-        self._node_index = node_index
-        self._microbatch = microbatch
-        self._handle_pool = handle_pool
-        self.refreshed_at = time.time()
-        metrics.ML_EMBEDDINGS_REFRESH_TIMESTAMP.set(self.refreshed_at)
-        self._set_serving_mode(self._mode_of(scorer))
+        old, self._serving = self._serving, bundle
+        if bundle is not None:
+            self.refreshed_at = time.time()
+            metrics.ML_EMBEDDINGS_REFRESH_TIMESTAMP.set(self.refreshed_at)
+            self._set_serving_mode(self._mode_of(bundle.scorer))
+        else:
+            self._set_serving_mode("base")
+        return old
+
+    @property
+    def serving_bundle(self):
+        return self._serving
+
+    @property
+    def serving_version(self) -> str:
+        b = self._serving
+        return b.version if b is not None else ""
+
+    # ---- candidate (shadow) slot: ISSUE 11 shadow-scored rollout ----
+
+    def attach_candidate(
+        self, scorer, node_index: dict[str, int], *,
+        version: str, sample_rate: float = 1.0, topk: int = 4,
+        handle_pool=None,
+    ):
+        """Install a CANDIDATE model: every (sampled) scheduling round is
+        additionally scored by it, log-only, with per-round divergence
+        against the served scores recorded into the returned ShadowTracker.
+        Returns (tracker, previous_candidate_bundle_or_None); the caller
+        drains/frees the replaced bundle. Works under the round dispatcher:
+        candidate handle_pool forks give each worker thread its own handle,
+        and the tracker is thread-safe."""
+        from dragonfly2_tpu.scheduler import rollout
+
+        bundle = rollout.ModelBundle(
+            scorer, node_index, version=version, handle_pool=handle_pool
+        )
+        tracker = rollout.ShadowTracker(version, sample_rate=sample_rate, topk=topk)
+        old = self._shadow
+        self._shadow = _ShadowSlot(bundle, tracker)
+        logger.info(
+            "shadow scoring candidate %s (%d hosts, sample_rate=%.2f)",
+            version, len(node_index), sample_rate,
+        )
+        return tracker, (old.bundle if old is not None else None)
+
+    def detach_candidate(self):
+        """Stop shadow scoring; returns the candidate bundle (or None) for
+        the caller to drain and free."""
+        old, self._shadow = self._shadow, None
+        return old.bundle if old is not None else None
+
+    @property
+    def candidate_version(self) -> str:
+        s = self._shadow
+        return s.tracker.version if s is not None else ""
+
+    @property
+    def candidate_tracker(self):
+        s = self._shadow
+        return s.tracker if s is not None else None
+
+    def _shadow_score(self, child, parents, feats: np.ndarray, served: np.ndarray) -> None:
+        """Score the round with the candidate model and record divergence.
+        Never raises and never touches the served result — a broken
+        candidate shows up as tracker errors (gated on), not as traffic
+        impact. Subset comparison: parents unknown to the candidate's graph
+        are dropped from BOTH vectors; a round with <2 comparable parents
+        (or an unknown child) counts as uncovered."""
+        slot = self._shadow
+        if slot is None:
+            return
+        tracker = slot.tracker
+        try:
+            if not tracker.should_sample():
+                return
+            bundle = slot.bundle
+            if not bundle.ready:
+                tracker.record_uncovered()
+                return
+            idx = bundle.node_index
+            child_idx = idx.get(child.host.id)
+            if child_idx is None:
+                tracker.record_uncovered()
+                return
+            parent_idx = [idx.get(p.host.id) for p in parents]
+            keep = [i for i, pi in enumerate(parent_idx) if pi is not None]
+            if len(keep) < 2:
+                tracker.record_uncovered()
+                return
+            p = np.array([parent_idx[i] for i in keep], np.int32)
+            c = np.full(len(keep), child_idx, np.int32)
+            f = feats[keep] if len(keep) < len(parents) else feats
+            bundle.begin()
+            try:
+                cand = bundle.thread_scorer().score(f, child=c, parent=p)
+            finally:
+                bundle.end()
+            cand = np.asarray(cand, np.float64)
+            if not np.isfinite(cand).all():
+                # a model emitting NaN/inf scores is broken, full stop —
+                # count it as a candidate ERROR so the gate's error-rate
+                # bound rejects it (a NaN delta would silently PASS every
+                # `>` bound; found live: a diverged 12-step train run)
+                logger.warning(
+                    "candidate %s produced non-finite scores", tracker.version
+                )
+                tracker.record_error()
+                return
+            srv = np.asarray(served, np.float64)
+            if len(keep) < len(parents):
+                srv = srv[keep]
+            if not np.isfinite(srv).all():
+                # the SERVED scores are unusable as a comparison baseline;
+                # that is not the candidate's fault — no divergence evidence
+                tracker.record_uncovered()
+                return
+            tracker.record(srv, cand)
+        except Exception:
+            logger.exception("shadow scoring failed (candidate %s)", tracker.version)
+            tracker.record_error()
 
     def embeddings_age_s(self) -> float | None:
         """Seconds since the serving embeddings were refreshed (staleness);
@@ -355,7 +510,7 @@ class MLEvaluator(Evaluator):
 
         return None if self.refreshed_at is None else time.time() - self.refreshed_at
 
-    def _prepare(self, child: Peer, parents: Sequence[Peer]):
+    def _prepare(self, child: Peer, parents: Sequence[Peer], bundle=None):
         """Shared pre-scoring step: (feats, child_ids, parent_ids, known);
         feats is ALWAYS a real matrix — child_ids (c) is None when the ML
         path can't score this round (no host known to the graph), which is
@@ -365,12 +520,16 @@ class MLEvaluator(Evaluator):
         and `feats @ BASE_WEIGHTS` is pure so error paths derive it on demand
         (the base matmul was ~10% of the serving round at 10k-rounds/s).
         known is None when every host is known (the steady-state fast path:
-        no mask array, no np.where on return)."""
+        no mask array, no np.where on return). `bundle` is the round's
+        read-once serving bundle (defaults to the current one for external
+        probes like dfstress)."""
+        if bundle is None:
+            bundle = self._serving
         feats = self.feature_builder(child, parents, self.topology, self.bandwidth)
-        child_idx = self._node_index.get(child.host.id)
+        child_idx = bundle.node_index.get(child.host.id) if bundle is not None else None
         if child_idx is None:
             return feats, None, None, None
-        idx = self._node_index
+        idx = bundle.node_index
         parent_idx = [idx.get(p.host.id) for p in parents]
         if None in parent_idx:
             known = np.array([i is not None for i in parent_idx])
@@ -389,27 +548,43 @@ class MLEvaluator(Evaluator):
 
     def evaluate(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:
         if not parents:
-            return super().evaluate(child, parents)
-        if not getattr(self._scorer, "ready", False):
+            return np.zeros(0, dtype=np.float32)
+        # read the serving bundle ONCE: everything below scores through this
+        # reference, so a concurrent hot-swap can't produce a torn round
+        bundle = self._serving
+        if bundle is None or not bundle.ready:
             self._count_fallback("no_scorer")
-            return super().evaluate(child, parents)
-        feats, c, p, known = self._prepare(child, parents)
+            feats = self.feature_builder(child, parents, self.topology, self.bandwidth)
+            out = self._base_from(feats)
+            self._shadow_score(child, parents, feats, out)
+            return out
+        feats, c, p, known = self._prepare(child, parents, bundle)
         if c is None:
             self._count_fallback("unknown_hosts")
-            return self._base_from(feats)
+            out = self._base_from(feats)
+            self._shadow_score(child, parents, feats, out)
+            return out
         # Per-thread handle when a pool is attached: dispatcher workers each
         # score on their own native handle (the pool hands the constructing
         # thread the primary, so the serial path is byte-for-byte unchanged).
-        scorer = self._scorer if self._handle_pool is None else self._handle_pool.get()
+        bundle.begin()
         try:
-            ml = scorer.score(feats, child=c, parent=p)
-        except Exception:
-            logger.exception("ml scorer failed; using base evaluator")
-            self._count_fallback("scorer_error")
-            return self._base_from(feats)
+            try:
+                ml = bundle.thread_scorer().score(feats, child=c, parent=p)
+            except Exception:
+                logger.exception("ml scorer failed; using base evaluator")
+                self._count_fallback("scorer_error")
+                out = self._base_from(feats)
+                self._shadow_score(child, parents, feats, out)
+                return out
+        finally:
+            bundle.end()
         if known is None:
-            return np.asarray(ml, dtype=np.float32)
-        return np.where(known, ml, self._base_from(feats)).astype(np.float32)
+            out = np.asarray(ml, dtype=np.float32)
+        else:
+            out = np.where(known, ml, self._base_from(feats)).astype(np.float32)
+        self._shadow_score(child, parents, feats, out)
+        return out
 
     def evaluate_many(
         self, rounds: Sequence[tuple[Peer, Sequence[Peer]]]
@@ -425,7 +600,10 @@ class MLEvaluator(Evaluator):
         Fallback semantics per round match evaluate(): unknown hosts or a
         scorer failure degrade that round to the base score, never the
         batch."""
-        if not getattr(self._scorer, "ready", False):
+        # one bundle read for the WHOLE batch: every round in this call
+        # scores on the same model even if a swap lands mid-batch
+        bundle = self._serving
+        if bundle is None or not bundle.ready:
             return [self.evaluate(c, ps) for c, ps in rounds]
         outs: list[np.ndarray | None] = [None] * len(rounds)
         prepared = []
@@ -433,76 +611,99 @@ class MLEvaluator(Evaluator):
             if not parents:
                 outs[i] = np.zeros(0, dtype=np.float32)
                 continue
-            feats, c, p, known = self._prepare(child, parents)
+            feats, c, p, known = self._prepare(child, parents, bundle)
             if c is None:
                 self._count_fallback("unknown_hosts")
                 outs[i] = self._base_from(feats)
+                self._shadow_score(child, parents, feats, outs[i])
             else:
                 prepared.append((i, feats, c, p, known))
         if not prepared:
             return outs
-        scorer = self._scorer if self._handle_pool is None else self._handle_pool.get()
-        if len(prepared) == 1 or not hasattr(scorer, "score_rounds"):
-            single = True
-        else:
-            single = False
-            widths = [len(c) for _i, _f, c, _p, _k in prepared]
-            B = max(widths)
-            M = len(prepared)
-            fp = prepared[0][1].shape[1]
-            mf = np.zeros((M, B, fp), np.float32)
-            mc = np.zeros((M, B), np.int32)
-            mp = np.zeros((M, B), np.int32)
-            for m, (_i, f, c, p, _k) in enumerate(prepared):
-                mf[m, : widths[m]] = f
-                mc[m, : widths[m]] = c
-                mp[m, : widths[m]] = p
-            try:
-                ml_rounds = scorer.score_rounds(mf, child=mc, parent=mp)
-            except Exception:
-                # one bad round (stale node index) rejects the flat batch —
-                # retry per round below so the culprit degrades alone
-                logger.exception("batched ml scoring failed; retrying per round")
+        bundle.begin()
+        try:
+            scorer = bundle.thread_scorer()
+            if len(prepared) == 1 or not hasattr(scorer, "score_rounds"):
                 single = True
-        for m, (i, f, c, p, known) in enumerate(prepared):
-            if single:
+            else:
+                single = False
+                widths = [len(c) for _i, _f, c, _p, _k in prepared]
+                B = max(widths)
+                M = len(prepared)
+                fp = prepared[0][1].shape[1]
+                mf = np.zeros((M, B, fp), np.float32)
+                mc = np.zeros((M, B), np.int32)
+                mp = np.zeros((M, B), np.int32)
+                for m, (_i, f, c, p, _k) in enumerate(prepared):
+                    mf[m, : widths[m]] = f
+                    mc[m, : widths[m]] = c
+                    mp[m, : widths[m]] = p
                 try:
-                    ml = scorer.score(f, child=c, parent=p)
+                    ml_rounds = scorer.score_rounds(mf, child=mc, parent=mp)
                 except Exception:
-                    logger.exception("ml scorer failed; using base evaluator")
-                    self._count_fallback("scorer_error")
-                    outs[i] = self._base_from(f)
-                    continue
-            else:
-                ml = ml_rounds[m, : len(c)]
-            if known is None:
-                outs[i] = np.asarray(ml, dtype=np.float32)
-            else:
-                outs[i] = np.where(known, ml, self._base_from(f)).astype(np.float32)
+                    # one bad round (stale node index) rejects the flat batch —
+                    # retry per round below so the culprit degrades alone
+                    logger.exception("batched ml scoring failed; retrying per round")
+                    single = True
+            for m, (i, f, c, p, known) in enumerate(prepared):
+                if single:
+                    try:
+                        ml = scorer.score(f, child=c, parent=p)
+                    except Exception:
+                        logger.exception("ml scorer failed; using base evaluator")
+                        self._count_fallback("scorer_error")
+                        outs[i] = self._base_from(f)
+                        continue
+                else:
+                    ml = ml_rounds[m, : len(c)]
+                if known is None:
+                    outs[i] = np.asarray(ml, dtype=np.float32)
+                else:
+                    outs[i] = np.where(known, ml, self._base_from(f)).astype(np.float32)
+        finally:
+            bundle.end()
+        if self._shadow is not None:
+            for i, f, _c, _p, _known in prepared:
+                if outs[i] is not None:
+                    child, parents = rounds[i]
+                    self._shadow_score(child, parents, f, outs[i])
         return outs
 
     async def evaluate_async(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:
         """Micro-batched scoring: concurrent rounds on the event loop land in
         ONE native multi-round call; falls back to the sync path when no
         micro-batcher is attached, and to the base score on scorer errors."""
-        mb = self._microbatch
+        bundle = self._serving
+        mb = bundle.microbatch if bundle is not None else None
         if mb is None or not getattr(mb, "ready", False):
             return self.evaluate(child, parents)
         if not parents:
             return np.zeros(0, dtype=np.float32)
-        feats, c, p, known = self._prepare(child, parents)
+        feats, c, p, known = self._prepare(child, parents, bundle)
         if c is None:
             self._count_fallback("unknown_hosts")
-            return self._base_from(feats)
+            out = self._base_from(feats)
+            self._shadow_score(child, parents, feats, out)
+            return out
+        # the refcount spans the await: the coalesced flush scores on this
+        # bundle's primary scorer, which must not be freed under it
+        bundle.begin()
         try:
             ml = await mb.score(feats, child=c, parent=p)
         except Exception:
             logger.exception("micro-batched ml scorer failed; using base evaluator")
             self._count_fallback("scorer_error")
-            return self._base_from(feats)
+            out = self._base_from(feats)
+            self._shadow_score(child, parents, feats, out)
+            return out
+        finally:
+            bundle.end()
         if known is None:
-            return np.asarray(ml, dtype=np.float32)
-        return np.where(known, ml, self._base_from(feats)).astype(np.float32)
+            out = np.asarray(ml, dtype=np.float32)
+        else:
+            out = np.where(known, ml, self._base_from(feats)).astype(np.float32)
+        self._shadow_score(child, parents, feats, out)
+        return out
 
 
 def new_evaluator(algorithm: str = "base", **kw) -> Evaluator:

@@ -119,7 +119,12 @@ class ManagerLink:
         keepalive_interval: float = 20.0,
         dynconfig_interval: float = 60.0,
         model_watch_interval: float = 60.0,
+        shadow_sample_rate: float = 1.0,
+        health_gates=None,
     ):
+        from dragonfly2_tpu.resilience.backoff import BackoffPolicy
+        from dragonfly2_tpu.scheduler.rollout import HealthGates, HealthSample
+
         self.service = service
         self.manager = RemoteManagerClient(manager_addr)
         self.hostname = hostname or socket.gethostname()
@@ -130,6 +135,22 @@ class ManagerLink:
         self.keepalive_interval = keepalive_interval
         self.model_watch_interval = model_watch_interval
         self._active_model_version: str | None = None
+        # ---- live-model rollout state (ISSUE 11) ----
+        self.shadow_sample_rate = shadow_sample_rate
+        self.health_gates = health_gates if health_gates is not None else HealthGates()
+        self._warm_prev = None           # previous serving ModelBundle, kept WARM
+        self._draining: list = []        # replaced bundles awaiting quiesce+free
+        self._health = None              # PostSwapHealth after a rollback-able swap
+        self._shadow_row_id: int | None = None
+        self._rejected_versions: set[str] = set()
+        self._last_swap_sample = HealthSample.capture()
+        # persistent watch failure (manager down, active artifact corrupt)
+        # backs off exponentially instead of hammering every tick (DF024)
+        self._watch_failures = 0
+        self._watch_backoff = BackoffPolicy(
+            base=model_watch_interval, multiplier=2.0,
+            max_delay=model_watch_interval * 8, jitter=0.3,
+        )
         self.scheduler_id: int | None = None
         self.cluster_id: int | None = None
         # live scheduler address book from dynconfig — the federation layer's
@@ -263,56 +284,369 @@ class ManagerLink:
             logger.warning("job completion report failed: %s", e)
 
     async def _model_watch_loop(self) -> None:
-        """Hot-swap the ml evaluator's scorer when the trainer activates a new
-        GNN version in the registry (closes the reference's unfinished
-        telemetry→train→register→infer loop, SURVEY.md §3.4)."""
+        """Drive the serving-model rollout (ISSUE 11): verified hot-swap of
+        activated versions, candidate shadow scoring + divergence reporting,
+        and post-swap health with auto-rollback. Closes the reference's
+        unfinished telemetry→train→register→infer loop (SURVEY.md §3.4) at
+        production semantics. Persistent failure (manager down, corrupt
+        active artifact) backs off exponentially instead of retrying at the
+        fixed watch interval."""
         while True:
-            await asyncio.sleep(self.model_watch_interval)
+            if self._watch_failures:
+                await self._watch_backoff.sleep(self._watch_failures - 1)
+            else:
+                await asyncio.sleep(self.model_watch_interval)
             try:
                 await self._check_model()
+                self._watch_failures = 0
             except Exception as e:
-                logger.warning("model watch failed: %s", e)
+                self._watch_failures += 1
+                logger.warning(
+                    "model watch failed (%d consecutive): %s", self._watch_failures, e
+                )
+
+    # ---- rollout watch: swap / shadow / health (ISSUE 11 tentpole) ----
+
+    _SWAP_ERROR_KINDS = (
+        "missing", "digest_mismatch", "load_error", "swap_error", "rpc_error",
+        "rejected_version",
+    )
+
+    @classmethod
+    def _note_swap(cls, result: str) -> None:
+        """Count the swap outcome and keep model_swap_last_error one-hot on
+        the latest failure kind (all-zero after a success)."""
+        from dragonfly2_tpu.scheduler import metrics
+
+        metrics.MODEL_SWAP_TOTAL.inc(result=result)
+        err = result if result in cls._SWAP_ERROR_KINDS else None
+        for kind in cls._SWAP_ERROR_KINDS:
+            metrics.MODEL_SWAP_LAST_ERROR.set(1.0 if kind == err else 0.0, error=kind)
+
+    def _note_rollout_state(self) -> None:
+        from dragonfly2_tpu.scheduler import metrics
+
+        shadowing = bool(getattr(self.service.evaluator, "candidate_version", ""))
+        watching = self._health is not None
+        metrics.MODEL_ROLLOUT_STATE.set(float(shadowing), state="shadowing")
+        metrics.MODEL_ROLLOUT_STATE.set(float(watching), state="health_watch")
+        metrics.MODEL_ROLLOUT_STATE.set(
+            float(not (shadowing or watching)), state="idle"
+        )
+
+    @staticmethod
+    def _classify_swap_error(e: Exception) -> str:
+        from dragonfly2_tpu.trainer.artifacts import ArtifactIntegrityError
+
+        if isinstance(e, ArtifactIntegrityError):
+            return "digest_mismatch"
+        if isinstance(e, FileNotFoundError):
+            return "missing"
+        return "load_error"
 
     async def _check_model(self) -> None:
-        row = await self.manager.active_model("gnn", self.scheduler_id or 0)
-        if row is None and self.scheduler_id:
-            # federation: ONE trainer ingests every member's telemetry and
-            # publishes a single cluster-wide model under scheduler_id 0 —
-            # fall back to it when no per-scheduler version exists
-            row = await self.manager.active_model("gnn", 0)
+        """One rollout tick: free drained bundles, decide post-swap health
+        (may auto-rollback), pick up candidates + report shadow windows, and
+        hot-swap to the registry's active version. Every per-leg failure is
+        classified into model_swap_total{result}; only persistent conditions
+        (RPC down, corrupt ACTIVE artifact) propagate so the loop backs off —
+        a corrupt CANDIDATE is terminal (reported + rejected), never a wedge."""
+        self._drain_retired()
+        await self._maybe_rollback()
+        status = await self.manager.rollout_status("gnn", self.scheduler_id or 0)
+        ev = self.service.evaluator
+        if hasattr(ev, "attach_candidate"):
+            promoted = await self._check_candidate(status)
+            if promoted:
+                status = await self.manager.rollout_status("gnn", self.scheduler_id or 0)
+        await self._check_active(status.get("active"))
+        self._note_rollout_state()
+
+    async def _check_active(self, row: dict | None) -> None:
         if row is None or row["version"] == self._active_model_version:
             return
+        version = row["version"]
+        if version in self._rejected_versions:
+            # we rolled this version back (or refused its artifact) — never
+            # re-attach it, even while the registry still names it active
+            # (the rollback RPC may have failed; it retries via rollback or
+            # an operator promote of something else). Counted every tick:
+            # the per-tick rate IS the scheduler-vs-registry divergence
+            # heartbeat dashboards alert on.
+            self._note_swap("rejected_version")
+            logger.warning("registry active model %s is locally rejected; ignoring", version)
+            return
+        ev = self.service.evaluator
+        # Promotion fast path: the candidate we are ALREADY shadow-scoring
+        # just went active — swap to its loaded scorer in place, no disk.
+        if getattr(ev, "candidate_version", "") == version:
+            cand = ev.detach_candidate()
+            self._shadow_row_id = None
+            if cand is not None:
+                # the candidate bundle shares scorer+handles with the serving
+                # bundle built below — drop it without closing
+                self._install(
+                    cand.scorer, cand.node_index, row, handle_pool=cand.handle_pool
+                )
+                return
         path = row.get("artifact_path", "")
         try:
-            scorer, node_index = await asyncio.to_thread(self._load_scorer, path)
-        except FileNotFoundError:
-            logger.warning("active model %s artifact missing at %r", row["version"], path)
-            return
-        # Native scorers get the micro-batching facade: concurrent scheduling
-        # rounds on the service loop coalesce into one multi-round FFI call
-        # (native/microbatch.py) instead of crossing ctypes per round. When
-        # the sharded round dispatcher is serving, they ALSO get a handle
-        # pool: dispatcher workers score on per-thread forked handles
-        # (scorer.cc's one-handle-per-thread rule; a shared handle would
-        # serialize the workers on its internal mutex).
-        microbatch = None
-        handle_pool = None
-        if hasattr(scorer, "score_rounds"):
-            from dragonfly2_tpu.native import MicroBatchScorer, ScorerHandlePool
+            scorer, node_index = await asyncio.to_thread(
+                self._load_scorer_verified, path, row.get("artifact_digest", "")
+            )
+        except Exception as e:
+            kind = self._classify_swap_error(e)
+            self._note_swap(kind)
+            logger.warning("active model %s refused (%s): %s", version, kind, e)
+            # persistent: the registry keeps naming this version — back off
+            raise
+        self._install(scorer, node_index, row)
 
-            microbatch = MicroBatchScorer(scorer)
-            if getattr(self.service.scheduling, "dispatcher", None) is not None \
-                    and hasattr(scorer, "fork"):
-                handle_pool = ScorerHandlePool(scorer)
-        self.service.evaluator.attach_scorer(
-            scorer, node_index, microbatch=microbatch, handle_pool=handle_pool
-        )
-        self._active_model_version = row["version"]
+    def _install(self, scorer, node_index, row: dict, *, handle_pool=None) -> None:
+        """Publish a verified scorer as the serving model: build the serving
+        facades, swap the evaluator's bundle in one store (zero-drop: rounds
+        in flight finish on the old bundle, which is kept WARM for instant
+        rollback), and open the post-swap health window."""
+        from dragonfly2_tpu.resilience import faultline
+        from dragonfly2_tpu.scheduler.rollout import HealthSample, PostSwapHealth
+
+        version = row["version"]
+        try:
+            if faultline.ACTIVE is not None:
+                faultline.ACTIVE.check("model.swap")
+            # Native scorers get the micro-batching facade: concurrent
+            # scheduling rounds on the service loop coalesce into one
+            # multi-round FFI call (native/microbatch.py) instead of crossing
+            # ctypes per round. When the sharded round dispatcher is serving,
+            # they ALSO get a handle pool: dispatcher workers score on
+            # per-thread forked handles (scorer.cc's one-handle-per-thread
+            # rule; a shared handle would serialize the workers on its
+            # internal mutex).
+            microbatch = None
+            if hasattr(scorer, "score_rounds"):
+                from dragonfly2_tpu.native import MicroBatchScorer, ScorerHandlePool
+
+                microbatch = MicroBatchScorer(scorer)
+                if handle_pool is None \
+                        and getattr(self.service.scheduling, "dispatcher", None) is not None \
+                        and hasattr(scorer, "fork"):
+                    handle_pool = ScorerHandlePool(scorer)
+        except Exception as e:
+            self._note_swap("swap_error")
+            logger.warning("model %s swap failed: %s", version, e)
+            raise
+        ev = self.service.evaluator
+        if hasattr(ev, "swap_bundle"):
+            prev = ev.attach_scorer(
+                scorer, node_index,
+                microbatch=microbatch, handle_pool=handle_pool, version=version,
+            )
+            # previous serving bundle stays WARM (instant rollback target);
+            # whatever was warm before now drains and frees
+            if self._warm_prev is not None and self._warm_prev is not prev:
+                self._draining.append(self._warm_prev)
+            self._warm_prev = prev
+            now = HealthSample.capture()
+            baseline = PostSwapHealth.rates_of(self._last_swap_sample, now)
+            self._last_swap_sample = now
+            if prev is not None:
+                self._health = PostSwapHealth(
+                    self.health_gates, baseline_rates=baseline, at_swap=now
+                )
+        else:
+            # plugin evaluators keep the legacy attach (no bundle protocol —
+            # no warm previous, no auto-rollback)
+            ev.attach_scorer(
+                scorer, node_index, microbatch=microbatch, handle_pool=handle_pool
+            )
+        self._active_model_version = version
+        self._note_swap("ok")
         logger.info(
-            "ml evaluator upgraded to model %s (%d hosts, microbatch=%s, handle_pool=%s)",
-            row["version"], len(node_index), microbatch is not None,
+            "ml evaluator upgraded to model %s (%d hosts, microbatch=%s, "
+            "handle_pool=%s, warm_prev=%s)",
+            version, len(node_index), microbatch is not None,
             handle_pool is not None,
+            self._warm_prev.version if self._warm_prev is not None else None,
         )
+
+    async def _check_candidate(self, status: dict) -> bool:
+        """Shadow-scoring leg: attach the newest candidate (digest-verified;
+        a corrupt one is reported and rejected, never attached), and push
+        this scheduler's divergence window to the manager's rollout state
+        machine. Returns True when the manager's answer says the candidate
+        was PROMOTED (the caller refreshes and swaps in the same tick)."""
+        ev = self.service.evaluator
+        rows = status.get("candidates") or []
+        cand = None
+        for r in reversed(rows):  # newest first
+            if r["version"] not in self._rejected_versions \
+                    and r["version"] != self._active_model_version:
+                cand = r
+                break
+        current = ev.candidate_version
+        if cand is None:
+            if current:
+                # candidate vanished (rejected/promoted elsewhere, or the
+                # registry moved on) — stop shadowing and drain the bundle
+                logger.info("candidate %s no longer in rollout; detaching", current)
+                self._retire_candidate()
+            return False
+        if cand["version"] != current:
+            if current:
+                self._retire_candidate()
+            await self._attach_candidate(cand)
+            return False
+        # same candidate still shadowing: ship the divergence window
+        tracker = ev.candidate_tracker
+        if tracker is None or self._shadow_row_id is None:
+            return False
+        resp = await self.manager.report_shadow(
+            self._shadow_row_id, self.hostname, tracker.snapshot()
+        )
+        state = resp.get("state")
+        from dragonfly2_tpu.scheduler import rollout as R
+
+        if state == R.STATE_ACTIVE:
+            logger.info(
+                "candidate %s promoted by shadow gate (%s)",
+                cand["version"], resp.get("aggregate", {}).get("rounds"),
+            )
+            return True  # active leg swaps to it (fast path, already loaded)
+        if state == R.STATE_REJECTED:
+            logger.warning(
+                "candidate %s rejected by shadow gate: %s",
+                cand["version"], "; ".join(resp.get("reasons") or []),
+            )
+            self._rejected_versions.add(cand["version"])
+            self._retire_candidate()
+        return False
+
+    async def _attach_candidate(self, cand: dict) -> None:
+        ev = self.service.evaluator
+        version = cand["version"]
+        try:
+            scorer, node_index = await asyncio.to_thread(
+                self._load_scorer_verified,
+                cand.get("artifact_path", ""), cand.get("artifact_digest", ""),
+            )
+        except Exception as e:
+            # terminal for THIS candidate: report so the manager rejects it
+            # (the rollout must not hang on an artifact no scheduler can
+            # read) and never retry it locally — the watch loop stays live
+            kind = self._classify_swap_error(e)
+            self._note_swap(kind)
+            self._rejected_versions.add(version)
+            logger.warning("candidate %s refused (%s): %s", version, kind, e)
+            try:
+                await self.manager.report_shadow(
+                    cand["id"], self.hostname, {"error": f"{kind}: {e}"}
+                )
+            except Exception as rpc_err:
+                logger.warning("candidate rejection report failed: %s", rpc_err)
+            return
+        handle_pool = None
+        if getattr(self.service.scheduling, "dispatcher", None) is not None \
+                and hasattr(scorer, "fork"):
+            from dragonfly2_tpu.native import ScorerHandlePool
+
+            handle_pool = ScorerHandlePool(scorer)
+        ev.attach_candidate(
+            scorer, node_index, version=version,
+            sample_rate=self.shadow_sample_rate, handle_pool=handle_pool,
+        )
+        self._shadow_row_id = cand["id"]
+        logger.info(
+            "shadow-scoring candidate %s (sample_rate=%.2f, dispatcher=%s)",
+            version, self.shadow_sample_rate, handle_pool is not None,
+        )
+
+    def _retire_candidate(self) -> None:
+        bundle = self.service.evaluator.detach_candidate()
+        self._shadow_row_id = None
+        if bundle is not None:
+            self._draining.append(bundle)
+
+    async def _maybe_rollback(self) -> None:
+        """Post-swap health verdict; a regression swaps the WARM previous
+        bundle back instantly, then tells the registry."""
+        h = self._health
+        if h is None:
+            return
+        verdict = h.check()
+        if verdict is None:
+            return
+        ok, reasons = verdict
+        self._health = None
+        if ok:
+            logger.info(
+                "post-swap health clean for model %s", self._active_model_version
+            )
+            return
+        await self._rollback(reasons)
+
+    async def _rollback(self, reasons: list[str]) -> None:
+        from dragonfly2_tpu.scheduler import metrics
+
+        prev = self._warm_prev
+        ev = self.service.evaluator
+        if prev is None or not hasattr(ev, "swap_bundle"):
+            logger.error(
+                "health regression (%s) but no warm previous model to roll back to",
+                "; ".join(reasons),
+            )
+            return
+        bad = ev.swap_bundle(prev)  # instant: prev's handles are still warm
+        self._warm_prev = None
+        bad_version = self._active_model_version
+        if bad is not None:
+            if bad.version:
+                self._rejected_versions.add(bad.version)
+            self._draining.append(bad)
+        self._active_model_version = prev.version or None
+        # reset the baseline window anchor: the NEXT swap's baseline must
+        # measure the restored model's serving rates, not a window spanning
+        # the rolled-back model's regression (which would inflate the
+        # baseline and let an equally-bad successor pass the health gate)
+        from dragonfly2_tpu.scheduler.rollout import HealthSample
+
+        self._last_swap_sample = HealthSample.capture()
+        metrics.MODEL_ROLLBACK_TOTAL.inc()
+        self._note_swap("rollback")
+        logger.warning(
+            "AUTO-ROLLBACK: model %s -> %s (%s)",
+            bad_version, prev.version, "; ".join(reasons),
+        )
+        try:
+            await self.manager.rollback_model(
+                "gnn", self.scheduler_id or 0,
+                reason="; ".join(reasons) or "post-swap health regression",
+            )
+        except Exception as e:
+            # registry still names the bad version active; the local
+            # rejected-set stops us re-attaching it, and operators see the
+            # divergence via dfmodel status / model_rollback_total
+            logger.warning("registry rollback failed: %s", e)
+
+    def _drain_retired(self) -> None:
+        """Free bundles whose in-flight rounds have drained (ModelBundle
+        refuses to close while rounds are inside it — old forked handles on
+        the refcounted native model are only freed at quiesce)."""
+        self._draining = [b for b in self._draining if not b.close()]
+
+    @staticmethod
+    def _load_scorer_verified(path: str, digest: str = ""):
+        """Integrity-checked artifact load: faultline `model.load` fires
+        first (chaos: error/latency at load), then the registry digest is
+        recomputed over the artifact files (faultline mutates the read bytes,
+        so injected corruption == real disk corruption) — only a bit-exact
+        artifact reaches the scorer loaders."""
+        from dragonfly2_tpu.resilience import faultline
+        from dragonfly2_tpu.trainer import artifacts
+
+        if faultline.ACTIVE is not None:
+            faultline.ACTIVE.check("model.load", blocking_latency=True)
+        artifacts.verify_artifact(path, digest)
+        return ManagerLink._load_scorer(path)
 
     @staticmethod
     def _load_scorer(path: str):
@@ -338,6 +672,10 @@ class ManagerLink:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._tasks = []
+        # best-effort: free quiesced retired bundles now; anything still
+        # mid-round (or the warm previous) is left to GC, same as before
+        # rollout existed — the service teardown follows right behind
+        self._drain_retired()
         await self.dynconfig.stop()
         await self.seed_connector.close()
         await self.manager.close()

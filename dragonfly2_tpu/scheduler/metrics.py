@@ -90,6 +90,59 @@ ML_BASE_FALLBACK_TOTAL = _r.counter(
     subsystem="scheduler",
     labels=("reason",),
 )
+# Live-model safe rollout (ISSUE 11): hot-swap outcomes, shadow-scoring
+# divergence, and rollback accounting. model_swap_total{result} makes the
+# previously-silent _check_model failure paths (artifact missing, digest
+# mismatch, load error) first-class signals instead of buried warnings.
+MODEL_SWAP_TOTAL = _r.counter(
+    "model_swap_total",
+    "Model hot-swap attempts by outcome (ok|missing|digest_mismatch|"
+    "load_error|swap_error|rejected_version|rollback)",
+    subsystem="scheduler", labels=("result",),
+)
+# One-hot over the LAST swap error kind (cleared to all-zero on a successful
+# swap) — the "what is currently wrong" companion to the rate counter above.
+MODEL_SWAP_LAST_ERROR = _r.gauge(
+    "model_swap_last_error",
+    "Most recent model-swap failure kind (1 = this was the last error; "
+    "all zero after a successful swap)",
+    subsystem="scheduler", labels=("error",),
+)
+MODEL_ROLLBACK_TOTAL = _r.counter(
+    "model_rollback_total",
+    "Automatic rollbacks to the previous serving model after a post-swap "
+    "health regression",
+    subsystem="scheduler",
+)
+MODEL_ROLLOUT_STATE = _r.gauge(
+    "model_rollout_state",
+    "Scheduler-local rollout activity (1 = active): idle | shadowing | "
+    "health_watch",
+    subsystem="scheduler", labels=("state",),
+)
+SHADOW_ROUNDS_TOTAL = _r.counter(
+    "shadow_rounds_total",
+    "Scheduling rounds scored by both the active and the candidate model",
+    subsystem="scheduler",
+)
+SHADOW_SCORE_DELTA = _r.histogram(
+    "shadow_score_delta",
+    "Per-round mean |served - candidate| score delta (shadow scoring)",
+    subsystem="scheduler",
+    buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0),
+)
+SHADOW_TOPK_OVERLAP = _r.gauge(
+    "shadow_topk_overlap",
+    "Running mean top-k parent overlap between served and candidate scores "
+    "for the current shadow window",
+    subsystem="scheduler",
+)
+SHADOW_RANK_CORR = _r.gauge(
+    "shadow_rank_corr",
+    "Running mean rank correlation between served and candidate scores for "
+    "the current shadow window",
+    subsystem="scheduler",
+)
 # Scheduler federation (ISSUE 10): push-pull topology/bandwidth gossip
 # between ring members. Sent/received counts are DELTA entries (edges +
 # bandwidth pairs), so steady-state rates near zero are the health signal

@@ -32,6 +32,8 @@ async def run_scheduler(
     trainer_addr: str | None = None,
     trainer_interval: float | None = None,
     model_watch_interval: float | None = None,
+    shadow_sample_rate: float | None = None,
+    health_gates=None,
     federation_peers: str | None = None,
     federation_interval: float | None = None,
     hostname: str = "",
@@ -78,6 +80,10 @@ async def run_scheduler(
         link_kw = {}
         if model_watch_interval is not None:
             link_kw["model_watch_interval"] = model_watch_interval
+        if shadow_sample_rate is not None:
+            link_kw["shadow_sample_rate"] = shadow_sample_rate
+        if health_gates is not None:
+            link_kw["health_gates"] = health_gates
         link = ManagerLink(
             service, manager_addr,
             hostname=hostname, ip=host, port=server.port,
@@ -205,6 +211,9 @@ def main() -> None:
     ap.add_argument("--trainer", default=cfg.trainer, help="trainer address host:port")
     ap.add_argument("--model-watch-interval", type=float, default=None,
                     help="seconds between active-model registry polls (default 60)")
+    ap.add_argument("--shadow-sample-rate", type=float,
+                    default=cfg.rollout.shadow_sample_rate,
+                    help="fraction of rounds a rollout candidate shadow-scores")
     ap.add_argument("--trainer-interval", type=float, default=cfg.trainer_interval,
                     help="telemetry upload cadence in seconds (default 7 days)")
     ap.add_argument("--federation-peers", default=cfg.federation_peers,
@@ -242,6 +251,8 @@ def main() -> None:
             trainer_addr=args.trainer,
             trainer_interval=args.trainer_interval,
             model_watch_interval=args.model_watch_interval,
+            shadow_sample_rate=args.shadow_sample_rate,
+            health_gates=cfg.rollout.health_gates(),
             federation_peers=args.federation_peers,
             federation_interval=args.federation_interval,
             hostname=args.hostname,

@@ -33,6 +33,55 @@ class IncompatibleArtifact(Exception):
     pass
 
 
+class ArtifactIntegrityError(IOError):
+    """The on-disk artifact does not match the registry row's digest —
+    truncated/corrupt/partially-written files must never attach to a live
+    evaluator (ISSUE 11)."""
+
+
+def artifact_digest(directory: str | Path) -> str:
+    """Content digest of a whole artifact directory: sha256 over every
+    regular file (sorted relative path + contents, length-framed so file
+    boundaries can't alias). Computed by the trainer at publish time and
+    stored on the registry row; the scheduler recomputes it before attaching
+    a version. Injectable: each file's bytes pass the faultline
+    `model.load` mutate point, so chaos tests corrupt artifacts the same
+    seeded way they corrupt pieces."""
+    import hashlib
+
+    from dragonfly2_tpu.resilience import faultline
+
+    d = Path(directory)
+    h = hashlib.sha256()
+    for f in sorted(p for p in d.rglob("*") if p.is_file()):
+        data = f.read_bytes()
+        if faultline.ACTIVE is not None:
+            data = faultline.ACTIVE.mutate("model.load", data)
+        rel = f.relative_to(d).as_posix().encode()
+        h.update(len(rel).to_bytes(4, "big"))
+        h.update(rel)
+        h.update(len(data).to_bytes(8, "big"))
+        h.update(data)
+    return h.hexdigest()
+
+
+def verify_artifact(directory: str | Path, expected_digest: str) -> None:
+    """Raise ArtifactIntegrityError unless the directory's recomputed digest
+    matches the registry row's. Empty expected digest = unverified row
+    (pre-rollout registry) — allowed through, the caller decides policy."""
+    if not expected_digest:
+        return
+    d = Path(directory)
+    if not d.is_dir():
+        raise FileNotFoundError(f"artifact directory {d} missing")
+    got = artifact_digest(d)
+    if got != expected_digest:
+        raise ArtifactIntegrityError(
+            f"artifact {d} digest mismatch: registry {expected_digest[:16]}…, "
+            f"disk {got[:16]}… (truncated/corrupt artifact must not attach)"
+        )
+
+
 def save_artifact(
     directory: str | Path, *, model_type: str, version: str, params: Any, config: dict
 ) -> Path:

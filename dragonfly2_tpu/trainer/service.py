@@ -320,13 +320,16 @@ class TrainerService:
                     train_mlp.train, self.cfg.mlp, tr, eval_pairs=ev, log=logger.info
                 )
             evaluation["train_seconds"] = round(time.perf_counter() - t0, 2)
-            path = await asyncio.to_thread(
-                artifacts.save_artifact,
-                Path(self.cfg.model_dir) / f"mlp-{version}",
-                model_type="mlp", version=version, params=params,
-                config={"hidden": list(self.cfg.mlp.hidden)},
-            )
-            out["mlp"] = {"artifact": str(path), "evaluation": evaluation}
+            def _save_mlp() -> tuple[Path, str]:
+                path = artifacts.save_artifact(
+                    Path(self.cfg.model_dir) / f"mlp-{version}",
+                    model_type="mlp", version=version, params=params,
+                    config={"hidden": list(self.cfg.mlp.hidden)},
+                )
+                return path, artifacts.artifact_digest(path)
+
+            path, digest = await asyncio.to_thread(_save_mlp)
+            out["mlp"] = {"artifact": str(path), "digest": digest, "evaluation": evaluation}
 
         if ds.num_pairs >= self.cfg.min_pairs and acc.probe_rows >= self.cfg.min_probe_rows:
             cfg = self.cfg.gnn
@@ -346,7 +349,7 @@ class TrainerService:
                 "steps_per_sec": round(len(losses) / max(1e-9, train_seconds), 2),
             }
 
-            def _save_gnn() -> Path:
+            def _save_gnn() -> tuple[Path, str]:
                 path = artifacts.save_artifact(
                     Path(self.cfg.model_dir) / f"gnn-{version}",
                     model_type="gnn", version=version, params=state.params,
@@ -361,10 +364,11 @@ class TrainerService:
                 except Exception:
                     # native serving is an optimization; the flax artifact always works
                     logger.exception("native scorer export failed; flax artifact only")
-                return path
+                # digest LAST: it must cover every file the loader will read
+                return path, artifacts.artifact_digest(path)
 
-            path = await asyncio.to_thread(_save_gnn)
-            out["gnn"] = {"artifact": str(path), "evaluation": evaluation}
+            path, digest = await asyncio.to_thread(_save_gnn)
+            out["gnn"] = {"artifact": str(path), "digest": digest, "evaluation": evaluation}
         return out
 
     async def _register_models(self, sess: TrainSession, result: dict) -> None:
@@ -384,12 +388,22 @@ class TrainerService:
             if not info:
                 continue
             try:
-                row = await self.manager.create_model(
+                # publish_model routes through the manager's rollout policy:
+                # gated types land as CANDIDATE and earn activation through
+                # the shadow window; ungated types activate immediately (the
+                # pre-ISSUE-11 behavior, and the default with no policy).
+                # The artifact digest rides the row so schedulers verify
+                # integrity before attach.
+                row = await self.manager.publish_model(
                     mtype, result["version"],
                     scheduler_id=0,
                     evaluation={**info["evaluation"], "contributors": contributors},
                     artifact_path=info["artifact"],
+                    artifact_digest=info.get("digest", ""),
                 )
-                await self.manager.activate_model(row["id"])
+                logger.info(
+                    "model %s %s registered (state=%s)",
+                    mtype, result["version"], row.get("state"),
+                )
             except Exception:
                 logger.exception("model registry update failed for %s", mtype)

@@ -21,9 +21,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def test_sharded_convergence_1k_nodes():
-    """~50 sharded steps on the 1k-node synthetic: loss must decrease
-    strictly window-over-window and end well below the start (the dryrun's
-    one-step 'it executes' is not convergence evidence; this is)."""
+    """~50 sharded steps on the 1k-node synthetic: loss must collapse from
+    the start and STAY collapsed (the dryrun's one-step 'it executes' is not
+    convergence evidence; this is).
+
+    Root cause of the F carried since PR 6: the original assertion demanded
+    strictly-decreasing 10-step window means across all 50 steps, but this
+    config converges by ~step 15 (window means 0.080 → 0.018) and then sits
+    at the batch-sampling noise floor, where adjacent windows differ only by
+    noise (measured 0.0168 vs 0.0181 — a 7% wiggle failing a strict `>`).
+    Post-convergence monotonicity is not a property SGD has; the honest
+    convergence evidence is (a) the initial descent, (b) every later window
+    staying far below the start, (c) the final window at <50% of the first —
+    which still fails loudly on divergence, non-learning, or a loss blow-up."""
     cluster = synthetic.make_cluster(num_nodes=1024, num_neighbors=16, num_pairs=8192, seed=7)
     mesh = meshlib.make_mesh()  # 8 virtual devices: {data: 2, model: 4}
     assert mesh.shape["model"] == 4
@@ -45,7 +55,9 @@ def test_sharded_convergence_1k_nodes():
         losses.append(float(loss))
     assert all(np.isfinite(v) for v in losses)
     windows = [float(np.mean(losses[i : i + 10])) for i in range(0, 50, 10)]
-    assert all(a > b for a, b in zip(windows, windows[1:])), f"not decreasing: {windows}"
+    assert windows[1] < windows[0], f"no initial descent: {windows}"
+    # converged-and-stayed: every post-descent window well below the start
+    assert all(w < windows[0] * 0.6 for w in windows[1:]), f"regressed: {windows}"
     assert windows[-1] < windows[0] * 0.5, f"weak convergence: {windows}"
 
 
@@ -77,9 +89,19 @@ def test_mesh_shape_invariance_small():
     assert trajectories[0][-1] < trajectories[0][0]
 
 
+@pytest.mark.slow
 def test_dryrun_16_devices_subprocess():
     """16-device variant in a fresh process (device count is frozen at
-    backend init, so the in-process 8-device mesh can't be widened here)."""
+    backend init, so the in-process 8-device mesh can't be widened here).
+
+    Marked slow (ISSUE 11 wall-clock buy-back): XLA compiling the 2-layer
+    GNN step twice (tp mesh + pure-dp mesh) across 16 virtual CPU devices
+    costs ~470 s on the 2-core CI box — well over HALF the 870 s tier-1
+    budget for a pure 'it executes at 16 devices' smoke. The properties it
+    guards stay tier-1-covered in-process: sharded convergence at 8 devices
+    (test_sharded_convergence_1k_nodes) and mesh-shape invariance
+    (test_mesh_shape_invariance_small). The full (`slow`) suite still runs
+    it on capable hardware."""
     env = {k: v for k, v in os.environ.items() if k not in ("JAX_PLATFORMS", "XLA_FLAGS")}
     out = subprocess.run(
         [sys.executable, "-c", "import __graft_entry__; __graft_entry__.dryrun_multichip(16, steps=10)"],

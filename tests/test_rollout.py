@@ -1,0 +1,876 @@
+"""Live-model safe rollout (ISSUE 11): shadow-scored canary, zero-drop
+hot-swap, automatic rollback.
+
+Layers under test:
+  - rollout primitives: divergence math, shadow tracker, gates, report merge
+  - evaluator: candidate slot shadow-scores without touching served traffic;
+    the serving bundle is read-once (a mid-round swap can never produce a
+    torn old/new score mix) and drains before its handles free
+  - manager: candidate → shadowing → active | rejected state machine,
+    rollback bookkeeping
+  - ManagerLink watch: digest-verified swap, corrupt-candidate rejection
+    that never attaches and never wedges the loop, swap metrics + backoff,
+    and post-swap-health auto-rollback onto the warm previous bundle
+  - chaos: mid-traffic hot-swap under concurrent DISPATCHED rounds with an
+    injected corrupt candidate and a health-regressing promotion
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.manager.server import ManagerServer
+from dragonfly2_tpu.rpc.manager import RemoteManagerClient
+from dragonfly2_tpu.scheduler import metrics as sched_metrics
+from dragonfly2_tpu.scheduler import rollout as R
+from dragonfly2_tpu.scheduler.evaluator import new_evaluator
+from dragonfly2_tpu.scheduler.manager_link import ManagerLink
+from dragonfly2_tpu.scheduler.scheduling import SchedulingConfig
+from dragonfly2_tpu.scheduler.service import SchedulerService
+from dragonfly2_tpu.trainer import artifacts
+
+from test_scheduler import add_running_peer, make_pool_with_task
+
+
+class VersionScorer:
+    """score_rounds-shaped fake whose every score IS its version constant —
+    a torn old/new mix inside one round is then directly visible as a
+    non-constant score vector."""
+
+    ready = True
+    feature_dim = 16
+    num_nodes = 1_000_000  # microbatch facade validates indices against this
+
+    def __init__(self, value: float, *, boom: bool = False):
+        self.value = float(value)
+        self.boom = boom
+        self.calls = 0
+        self.closed = False
+
+    def score(self, feats, *, child, parent):
+        self.calls += 1
+        if self.boom:
+            raise RuntimeError("injected scorer failure")
+        return np.full(len(child), self.value, np.float32)
+
+    def score_rounds(self, feats, *, child, parent):
+        self.calls += 1
+        if self.boom:
+            raise RuntimeError("injected scorer failure")
+        return np.full(feats.shape[:2], self.value, np.float32)
+
+    def close(self):
+        self.closed = True
+
+
+def _metric(metric, **labels) -> float:
+    return float(metric.labels(**labels).value)
+
+
+# ---------------------------------------------------------------------------
+# rollout primitives
+# ---------------------------------------------------------------------------
+
+
+class TestDivergence:
+    def test_identical_scores_agree_fully(self):
+        s = np.array([0.9, 0.5, 0.7, 0.1, 0.3])
+        d = R.round_divergence(s, s.copy())
+        assert d["topk_overlap"] == 1.0
+        assert d["rank_corr"] == pytest.approx(1.0)
+        assert d["abs_delta_mean"] == 0.0
+
+    def test_reversed_ranking_is_anticorrelated(self):
+        s = np.arange(8, dtype=float)
+        d = R.round_divergence(s, -s)
+        assert d["rank_corr"] == pytest.approx(-1.0)
+        assert d["topk_overlap"] == 0.0
+
+    def test_constant_candidate_has_no_rank_signal(self):
+        s = np.array([0.1, 0.9, 0.4])
+        d = R.round_divergence(s, np.full(3, 0.5))
+        assert d["rank_corr"] == 0.0  # conservative: counts against the gate
+
+    def test_both_constant_agree(self):
+        d = R.round_divergence(np.full(4, 0.5), np.full(4, 0.8))
+        assert d["rank_corr"] == 1.0 and d["topk_overlap"] == 1.0
+        assert d["abs_delta_mean"] == pytest.approx(0.3)
+
+    def test_gates_window_then_verdict(self):
+        gates = R.DivergenceGates(min_rounds=10, min_topk_overlap=0.5)
+        verdict, reasons = gates.evaluate({"rounds": 4, "topk_overlap_mean": 1.0})
+        assert verdict is None and "4/10" in reasons[0]
+        good = {
+            "rounds": 12, "errors": 0, "uncovered": 0,
+            "topk_overlap_mean": 0.9, "rank_corr_mean": 0.8, "abs_delta_mean": 0.1,
+        }
+        assert gates.evaluate(good) == (True, [])
+        bad = dict(good, topk_overlap_mean=0.1, rank_corr_mean=-0.5)
+        verdict, reasons = gates.evaluate(bad)
+        assert verdict is False and len(reasons) == 2
+
+    def test_gates_reject_error_storm_and_uncovered_window(self):
+        gates = R.DivergenceGates(min_rounds=10, max_error_rate=0.05)
+        verdict, reasons = gates.evaluate(
+            {"rounds": 8, "errors": 4, "topk_overlap_mean": 1.0, "rank_corr_mean": 1.0}
+        )
+        assert verdict is False and "error_rate" in reasons[0]
+        # a window that was ALL uncovered carries no divergence evidence
+        verdict, reasons = gates.evaluate({"rounds": 0, "errors": 0, "uncovered": 20})
+        assert verdict is False
+
+    def test_tracker_sampling_and_snapshot(self):
+        t = R.ShadowTracker("v1", sample_rate=0.5)
+        picked = sum(t.should_sample() for _ in range(100))
+        assert picked == 50  # deterministic stride, exactly the rate
+        t.record(np.array([1.0, 2.0, 3.0]), np.array([1.0, 2.0, 3.0]))
+        t.record_uncovered()
+        t.record_error()
+        snap = t.snapshot()
+        assert snap["rounds"] == 1 and snap["uncovered"] == 1 and snap["errors"] == 1
+        assert snap["topk_overlap_mean"] == 1.0
+        assert sum(snap["delta_hist"]["counts"]) == 1
+
+    def test_merge_reports_weights_by_rounds(self):
+        a = {"rounds": 10, "topk_overlap_mean": 1.0, "rank_corr_mean": 1.0,
+             "abs_delta_mean": 0.0, "abs_delta_max": 0.1}
+        b = {"rounds": 30, "topk_overlap_mean": 0.0, "rank_corr_mean": 0.0,
+             "abs_delta_mean": 0.4, "abs_delta_max": 0.9, "errors": 2}
+        m = R.merge_reports([a, b])
+        assert m["rounds"] == 40 and m["errors"] == 2
+        assert m["topk_overlap_mean"] == pytest.approx(0.25)
+        assert m["abs_delta_mean"] == pytest.approx(0.3)
+        assert m["abs_delta_max"] == 0.9
+
+    def test_bundle_refcount_gates_close(self):
+        scorer = VersionScorer(1.0)
+        b = R.ModelBundle(scorer, {}, version="v1")
+        b.begin()
+        assert not b.close()  # round in flight: refuses
+        assert not scorer.closed
+        b.end()
+        assert b.quiesced and b.close() and scorer.closed
+        assert b.close()  # idempotent
+
+
+# ---------------------------------------------------------------------------
+# evaluator: shadow slot + read-once serving bundle
+# ---------------------------------------------------------------------------
+
+
+def _ml_with_pool(n_hosts=6):
+    pool, task, hosts = make_pool_with_task(n_hosts)
+    child = add_running_peer(pool, task, hosts[0])
+    parents = [add_running_peer(pool, task, h, pieces=2) for h in hosts[1:]]
+    ev = new_evaluator("ml")
+    node_index = {h.id: i for i, h in enumerate(hosts)}
+    return ev, child, parents, node_index
+
+
+class TestShadowScoring:
+    def test_candidate_shadow_scores_without_touching_traffic(self):
+        ev, child, parents, idx = _ml_with_pool()
+        served = VersionScorer(0.25)
+        ev.attach_scorer(served, idx, version="v1")
+        tracker, prev = ev.attach_candidate(VersionScorer(0.75), idx, version="v2")
+        assert prev is None and ev.candidate_version == "v2"
+        out = ev.evaluate(child, parents)
+        # traffic served by v1, untouched by the shadow leg
+        assert np.all(out == 0.25)
+        snap = tracker.snapshot()
+        assert snap["rounds"] == 1
+        assert snap["abs_delta_mean"] == pytest.approx(0.5)
+        assert snap["topk_overlap_mean"] == 1.0  # both constant: same order
+
+    def test_shadow_works_while_serving_base(self):
+        """Bootstrap: the first-ever candidate shadows against BASE serving
+        (no active model yet) — the gate works from day zero."""
+        ev, child, parents, idx = _ml_with_pool()
+        tracker, _ = ev.attach_candidate(VersionScorer(0.5), idx, version="v1")
+        out = ev.evaluate(child, parents)
+        assert out.dtype == np.float32  # base path served
+        assert tracker.snapshot()["rounds"] == 1
+
+    def test_candidate_errors_are_counted_not_served(self):
+        ev, child, parents, idx = _ml_with_pool()
+        ev.attach_scorer(VersionScorer(0.25), idx, version="v1")
+        tracker, _ = ev.attach_candidate(VersionScorer(0.0, boom=True), idx, version="v2")
+        out = ev.evaluate(child, parents)
+        assert np.all(out == 0.25)  # serving never sees the candidate blow up
+        assert tracker.snapshot()["errors"] == 1
+
+    def test_unknown_hosts_count_uncovered(self):
+        ev, child, parents, idx = _ml_with_pool()
+        ev.attach_scorer(VersionScorer(0.25), idx, version="v1")
+        tracker, _ = ev.attach_candidate(
+            VersionScorer(0.75), {child.host.id: 0}, version="v2"
+        )  # candidate knows the child but no parents
+        ev.evaluate(child, parents)
+        assert tracker.snapshot()["uncovered"] == 1
+
+    def test_evaluate_many_shadows_each_round(self):
+        ev, child, parents, idx = _ml_with_pool()
+        ev.attach_scorer(VersionScorer(0.25), idx, version="v1")
+        tracker, _ = ev.attach_candidate(VersionScorer(0.75), idx, version="v2")
+        outs = ev.evaluate_many([(child, parents), (child, parents[:2])])
+        assert all(np.all(o == 0.25) for o in outs)
+        assert tracker.snapshot()["rounds"] == 2
+
+    def test_nonfinite_candidate_scores_count_as_errors(self):
+        """Found live: a diverged train run whose scorer emits NaN recorded
+        delta=nan, and NaN silently PASSES every `>` gate bound. Non-finite
+        candidate scores are a candidate ERROR (the error-rate gate rejects
+        the model); a non-finite SERVED baseline is merely uncovered."""
+
+        class NaNScorer(VersionScorer):
+            def score(self, feats, *, child, parent):
+                return np.full(len(child), np.nan, np.float32)
+
+        ev, child, parents, idx = _ml_with_pool()
+        ev.attach_scorer(VersionScorer(0.25), idx, version="v1")
+        tracker, _ = ev.attach_candidate(NaNScorer(0.0), idx, version="vnan")
+        out = ev.evaluate(child, parents)
+        assert np.all(out == 0.25)  # serving untouched
+        snap = tracker.snapshot()
+        assert snap["errors"] == 1 and snap["rounds"] == 0
+        assert np.isfinite(snap["abs_delta_mean"])
+        # and the gate turns that into a rejection once the window closes
+        gates = R.DivergenceGates(min_rounds=1, max_error_rate=0.5)
+        verdict, reasons = gates.evaluate(
+            {"rounds": 0, "errors": 3, "uncovered": 0, "seen": 3}
+        )
+        assert verdict is False and "error_rate" in reasons[0]
+
+    def test_nonfinite_served_baseline_counts_uncovered(self):
+        class NaNServed(VersionScorer):
+            def score(self, feats, *, child, parent):
+                return np.full(len(child), np.nan, np.float32)
+
+        ev, child, parents, idx = _ml_with_pool()
+        ev.attach_scorer(NaNServed(0.0), idx, version="v1")  # serves NaN…
+        tracker, _ = ev.attach_candidate(VersionScorer(0.75), idx, version="v2")
+        ev.evaluate(child, parents)
+        snap = tracker.snapshot()
+        assert snap["uncovered"] == 1 and snap["errors"] == 0 and snap["rounds"] == 0
+
+    def test_detach_candidate_returns_bundle_for_drain(self):
+        ev, child, parents, idx = _ml_with_pool()
+        scorer = VersionScorer(0.75)
+        ev.attach_candidate(scorer, idx, version="v2")
+        bundle = ev.detach_candidate()
+        assert bundle is not None and ev.candidate_version == ""
+        assert bundle.close() and scorer.closed
+
+    def test_sampled_shadow_bounds_overhead(self):
+        ev, child, parents, idx = _ml_with_pool()
+        ev.attach_scorer(VersionScorer(0.25), idx, version="v1")
+        cand = VersionScorer(0.75)
+        tracker, _ = ev.attach_candidate(cand, idx, version="v2", sample_rate=0.25)
+        for _ in range(40):
+            ev.evaluate(child, parents)
+        assert cand.calls == 10  # exactly the sample rate
+        assert tracker.snapshot()["rounds"] == 10
+        assert tracker.snapshot()["seen"] == 40
+
+
+@pytest.mark.chaos
+class TestZeroDropHotSwap:
+    def test_no_torn_round_under_concurrent_swaps(self):
+        """Worker threads hammer evaluate_many while the main thread hot-swaps
+        versions: every returned round must be constant-valued (scored
+        entirely on ONE version) — the read-once bundle property — and every
+        replaced bundle must drain to quiesce and free."""
+        ev, child, parents, idx = _ml_with_pool()
+        scorers = [VersionScorer(float(v)) for v in (1.0, 2.0, 3.0, 4.0)]
+        ev.attach_scorer(scorers[0], idx, version="s0")
+        legal = {s.value for s in scorers}
+        violations: list = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                outs = ev.evaluate_many([(child, parents), (child, parents[:3])])
+                for o in outs:
+                    vals = set(np.asarray(o).tolist())  # dflint: disable=DF033 per-round torn-mix probe, not a hot path
+                    if len(vals) != 1 or not vals <= legal:
+                        violations.append(vals)
+
+        threads = [threading.Thread(target=hammer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        retired = []
+        for i in (1, 2, 3, 0, 2, 1, 3):  # swap back and forth mid-traffic
+            old = ev.attach_scorer(scorers[i], idx, version=f"s{i}")
+            if old is not None:
+                retired.append(old)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        assert not violations, f"torn/unknown rounds: {violations[:5]}"
+        # all replaced bundles quiesce once traffic stops, then close
+        for b in retired:
+            assert b.quiesced and b.close()
+
+    def test_dispatched_rounds_zero_dropped_across_swap(self, run):
+        """Scheduling-level: concurrent rounds through the sharded
+        RoundDispatcher while the model hot-swaps — every round completes
+        with parents (ZERO dropped), counter-asserted against the dispatch
+        metric."""
+        svc = SchedulerService(
+            evaluator=new_evaluator("ml"),
+            scheduling_config=SchedulingConfig(dispatch_workers=2),
+        )
+        task = svc.pool.load_or_create_task("t1", "http://o/f")
+        task.set_metadata(100 << 20)
+        hosts = [
+            svc.pool.load_or_create_host(f"h{i}", f"10.0.0.{i}", f"host{i}",
+                                         download_port=8000 + i)
+            for i in range(10)
+        ]
+        children = [add_running_peer(svc.pool, task, h) for h in hosts[:4]]
+        for h in hosts[4:]:
+            p = add_running_peer(svc.pool, task, h, pieces=4)
+            p.host.upload_limit = 1000
+        idx = {h.id: i for i, h in enumerate(hosts)}
+        v1, v2 = VersionScorer(1.0), VersionScorer(2.0)
+        svc.evaluator.attach_scorer(v1, idx, version="v1")
+
+        async def body():
+            before = sched_metrics.DISPATCHED_ROUNDS_TOTAL.value
+            rounds = []
+            for wave in range(6):
+                rounds += [
+                    asyncio.ensure_future(
+                        svc.scheduling.schedule_candidate_parents(c)
+                    )
+                    for c in children
+                ]
+                if wave == 2:  # swap mid-flight
+                    old = svc.evaluator.attach_scorer(v2, idx, version="v2")
+                    assert old is not None
+                await asyncio.sleep(0.01)
+            outs = await asyncio.gather(*rounds)
+            # ZERO dropped: every launched round completed with parents
+            assert len(outs) == 6 * len(children)
+            assert all(o.parents for o in outs), "round dropped/failed in swap window"
+            assert sched_metrics.DISPATCHED_ROUNDS_TOTAL.value - before >= len(outs)
+            svc.close()
+
+        run(body())
+
+
+def test_republish_resets_rejected_candidate(tmp_path):
+    """A candidate rejected for a load error is not a dead end: publishing
+    the SAME version again (fixed artifact) upserts the existing row —
+    UNIQUE(type, version, scheduler_id) never blocks the retry — and resets
+    it to candidate with the new digest."""
+    from dragonfly2_tpu.manager.service import ManagerService
+
+    svc = ManagerService()
+    svc.set_config("model_rollout", {"enabled": True, "types": ["gnn"],
+                                     "gates": {"min_rounds": 5}})
+    row = svc.publish_model("gnn", "v1", artifact_digest="d-broken")
+    svc.report_shadow(row["id"], "sch1", {"error": "digest_mismatch: …"})
+    assert svc.db.get("models", row["id"])["state"] == "rejected"
+    fixed = svc.publish_model("gnn", "v1", artifact_digest="d-fixed")
+    assert fixed["id"] == row["id"]
+    assert fixed["state"] == "candidate"
+    assert fixed["artifact_digest"] == "d-fixed"
+
+
+def test_new_candidate_supersedes_pending_one(tmp_path):
+    """Continual training (observed live at a 3 s upload cadence): each new
+    gated publish must retire the still-pending candidate of the same
+    (type, scheduler) — schedulers only ever shadow the newest, so the old
+    row would otherwise sit 'shadowing' forever and the list grows with
+    every train run."""
+    from dragonfly2_tpu.manager.service import ManagerService
+
+    svc = ManagerService()
+    svc.set_config("model_rollout", {"enabled": True, "types": ["gnn"],
+                                     "gates": {"min_rounds": 5}})
+    v1 = svc.publish_model("gnn", "v1", artifact_digest="d1")
+    assert v1["state"] == "candidate"
+    # v1 reaches shadowing via a first report
+    svc.report_shadow(v1["id"], "sch1", {"rounds": 1, "seen": 1,
+                                         "topk_overlap_mean": 1.0,
+                                         "rank_corr_mean": 1.0,
+                                         "abs_delta_mean": 0.0})
+    v2 = svc.publish_model("gnn", "v2", artifact_digest="d2")
+    assert v2["state"] == "candidate"
+    v1_now = svc.db.get("models", v1["id"])
+    assert v1_now["state"] == "rejected"
+    assert "superseded by v2" in v1_now["rollout"]["rejected_reason"]
+    st = svc.rollout_status("gnn", 0)
+    assert [r["version"] for r in st["candidates"]] == ["v2"]
+
+
+# ---------------------------------------------------------------------------
+# ManagerLink watch: verified swap / rejection / rollback / metrics+backoff
+# ---------------------------------------------------------------------------
+
+
+def make_artifact(tmp_path, version: str, payload: bytes = b"weights") -> tuple[str, str]:
+    d = tmp_path / f"gnn-{version}"
+    d.mkdir(parents=True)
+    (d / "params.msgpack").write_bytes(payload * 32)
+    (d / "config.json").write_text(json.dumps({"type": "gnn", "version": version}))
+    (d / "graph.npz").write_bytes(b"notagraph" * 8)
+    (d / "hosts.json").write_text("{}")
+    return str(d), artifacts.artifact_digest(d)
+
+
+class _LinkHarness:
+    """Manager (real RPC server) + ml SchedulerService + ManagerLink whose
+    watch ticks are driven MANUALLY (no sleeps): tests call tick()."""
+
+    def __init__(self, tmp_path, monkeypatch, **link_kw):
+        self.tmp_path = tmp_path
+        self.monkeypatch = monkeypatch
+        self.scorers: dict[str, object] = {}  # artifact_path -> (scorer, idx)
+        self.link_kw = link_kw
+
+    async def __aenter__(self):
+        self.manager = ManagerServer(db_path=str(self.tmp_path / "m.db"))
+        await self.manager.start()
+        self.mc = RemoteManagerClient(self.manager.address)
+        self.svc = SchedulerService(evaluator=new_evaluator("ml"))
+        pool, task, hosts = make_pool_with_task(6)
+        # the link's service drives real scheduling rounds through reschedule
+        self.svc.pool = pool
+        self.child = add_running_peer(pool, task, hosts[0])
+        self.parents = [add_running_peer(pool, task, h, pieces=2) for h in hosts[1:]]
+        for p in self.parents:
+            p.host.upload_limit = 1000
+        self.node_index = {h.id: i for i, h in enumerate(hosts)}
+        self.link = ManagerLink(
+            self.svc, self.manager.address, hostname="sch-test",
+            ip="127.0.0.1", port=1, **self.link_kw,
+        )
+        scorers = self.scorers
+
+        def fake_load(path):
+            entry = scorers[path]
+            if isinstance(entry, Exception):
+                raise entry
+            return entry
+
+        self.monkeypatch.setattr(ManagerLink, "_load_scorer", staticmethod(fake_load))
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.link.manager.close()
+        await self.mc.close()
+        await self.manager.stop()
+        self.svc.close()
+
+    async def tick(self):
+        await self.link._check_model()
+
+    async def publish(self, version: str, *, scorer=None, corrupt=False,
+                      digest=None, path=None) -> dict:
+        if path is None:
+            path, real_digest = make_artifact(self.tmp_path, version)
+            if digest is None:
+                digest = real_digest
+        if scorer is not None:
+            self.scorers[path] = (scorer, self.node_index)
+        if corrupt:
+            # flip bytes AFTER the digest was computed: torn/corrupt on disk
+            f = self.tmp_path / f"gnn-{version}" / "params.msgpack"
+            f.write_bytes(b"CORRUPTED" + f.read_bytes()[9:])
+        return await self.mc.publish_model(
+            "gnn", version, scheduler_id=0,
+            artifact_path=path, artifact_digest=digest,
+        )
+
+    async def drive_rounds(self, n: int):
+        for _ in range(n):
+            await self.svc.reschedule(self.child.id)  # dflint: disable=DF025 each call IS one scheduling round under test
+
+
+def test_gated_candidate_shadows_then_promotes_and_swaps(run, tmp_path, monkeypatch):
+    """The full happy path, manual ticks: publish → candidate → shadow N
+    rounds → gate passes → manager promotes → link hot-swaps in the SAME
+    tick using the already-loaded candidate scorer (no second disk load)."""
+
+    async def body():
+        async with _LinkHarness(tmp_path, monkeypatch) as h:
+            await h.mc.set_config("model_rollout", {
+                "enabled": True, "types": ["gnn"], "auto_promote": True,
+                "gates": {"min_rounds": 6, "min_topk_overlap": 0.0,
+                          "min_rank_corr": -1.0, "max_mean_abs_delta": 100.0},
+            })
+            row = await h.publish("v1", scorer=VersionScorer(0.5))
+            assert row["state"] == "candidate"
+            ok_before = _metric(sched_metrics.MODEL_SWAP_TOTAL, result="ok")
+            await h.tick()  # picks up the candidate
+            assert h.svc.evaluator.candidate_version == "v1"
+            assert h.svc.evaluator.serving_version == ""  # still base
+            await h.drive_rounds(8)  # shadow window fills vs base serving
+            await h.tick()  # report → gate passes → promote → fast swap
+            assert h.svc.evaluator.serving_version == "v1"
+            assert h.svc.evaluator.candidate_version == ""
+            reg = await h.mc.active_model("gnn", 0)
+            assert reg["version"] == "v1" and reg["state"] == "active"
+            assert _metric(sched_metrics.MODEL_SWAP_TOTAL, result="ok") == ok_before + 1
+            # a clean swap zeroes the last-error one-hot
+            assert _metric(sched_metrics.MODEL_SWAP_LAST_ERROR, error="digest_mismatch") == 0.0
+
+    run(body())
+
+
+def test_corrupt_candidate_rejected_never_attaches_never_wedges(run, tmp_path, monkeypatch):
+    """A truncated/corrupt candidate artifact: digest verification refuses it
+    BEFORE any load, the manager rejects the version, nothing attaches, and
+    the watch keeps running (a later good candidate still promotes)."""
+
+    async def body():
+        async with _LinkHarness(tmp_path, monkeypatch) as h:
+            await h.mc.set_config("model_rollout", {
+                "enabled": True, "types": ["gnn"], "auto_promote": True,
+                "gates": {"min_rounds": 4, "min_topk_overlap": 0.0,
+                          "min_rank_corr": -1.0, "max_mean_abs_delta": 100.0},
+            })
+            bad = await h.publish("vbad", scorer=VersionScorer(9.9), corrupt=True)
+            before = _metric(sched_metrics.MODEL_SWAP_TOTAL, result="digest_mismatch")
+            await h.tick()  # must not raise, must not attach
+            assert h.svc.evaluator.candidate_version == ""
+            assert h.svc.evaluator.serving_version == ""
+            assert _metric(
+                sched_metrics.MODEL_SWAP_TOTAL, result="digest_mismatch"
+            ) == before + 1
+            assert _metric(
+                sched_metrics.MODEL_SWAP_LAST_ERROR, error="digest_mismatch"
+            ) == 1.0
+            row = (await h.mc.list_models(type="gnn", version="vbad"))[0]
+            assert row["state"] == "rejected"
+            assert "digest_mismatch" in row["rollout"]["rejected_reason"]
+            # loop not wedged: the next good candidate goes all the way
+            await h.publish("vgood", scorer=VersionScorer(0.5))
+            await h.tick()
+            assert h.svc.evaluator.candidate_version == "vgood"
+            await h.drive_rounds(6)
+            await h.tick()
+            assert h.svc.evaluator.serving_version == "vgood"
+            assert bad["id"] == row["id"]  # same registry row, now rejected
+
+    run(body())
+
+
+def test_active_swap_verifies_digest_and_backs_off(run, tmp_path, monkeypatch):
+    """Ungated activation of a corrupt/missing artifact: the swap is refused
+    (classified in model_swap_total), the failure propagates so the watch
+    loop backs off exponentially instead of hammering the fixed interval."""
+
+    async def body():
+        async with _LinkHarness(tmp_path, monkeypatch) as h:
+            # no rollout config: publish activates directly (legacy path)
+            await h.publish("vcorrupt", scorer=VersionScorer(1.0), corrupt=True)
+            before = _metric(sched_metrics.MODEL_SWAP_TOTAL, result="digest_mismatch")
+            with pytest.raises(artifacts.ArtifactIntegrityError):
+                await h.tick()
+            assert h.svc.evaluator.serving_version == ""
+            assert _metric(
+                sched_metrics.MODEL_SWAP_TOTAL, result="digest_mismatch"
+            ) == before + 1
+            # missing artifact classifies separately
+            await h.mc.publish_model(
+                "gnn", "vmissing", artifact_path=str(tmp_path / "nope"),
+                artifact_digest="00ff",
+            )
+            before_missing = _metric(sched_metrics.MODEL_SWAP_TOTAL, result="missing")
+            with pytest.raises(FileNotFoundError):
+                await h.tick()
+            assert _metric(
+                sched_metrics.MODEL_SWAP_TOTAL, result="missing"
+            ) == before_missing + 1
+            assert _metric(
+                sched_metrics.MODEL_SWAP_LAST_ERROR, error="missing"
+            ) == 1.0
+            # the watch loop's backoff ladder grows with consecutive failures
+            # (DF024: no fixed-interval hammering of a persistent failure)
+            bo = h.link._watch_backoff
+            assert bo.base == h.link.model_watch_interval
+            assert bo.delay(5) >= bo.base  # capped at 8x base, jitter-down only
+            assert bo.max_delay == h.link.model_watch_interval * 8
+
+    run(body())
+
+
+def test_health_regression_auto_rolls_back_to_warm_previous(run, tmp_path, monkeypatch):
+    """v1 serves cleanly; v2 promotes and starts failing every score
+    (scorer_error base fallbacks). The post-swap health window trips,
+    serving snaps back to the WARM v1 bundle instantly, the registry flips
+    v2 → rejected / v1 → active, and model_rollback_total counts it."""
+
+    async def body():
+        gates = R.HealthGates(
+            window_s=30.0, min_rounds=6,
+            max_error_rate_increase=0.2, max_fallback_rate_increase=0.2,
+        )
+        async with _LinkHarness(tmp_path, monkeypatch, health_gates=gates) as h:
+            await h.mc.set_config("model_rollout", {
+                "enabled": True, "types": ["gnn"], "auto_promote": True,
+                "gates": {"min_rounds": 4, "min_topk_overlap": 0.0,
+                          "min_rank_corr": -1.0, "max_mean_abs_delta": 100.0,
+                          "max_error_rate": 1.0},
+            })
+            v1 = VersionScorer(0.5)
+            await h.publish("v1", scorer=v1)
+            await h.tick()
+            await h.drive_rounds(6)
+            await h.tick()  # v1 promoted + swapped
+            assert h.svc.evaluator.serving_version == "v1"
+            await h.drive_rounds(10)  # clean v1 baseline window
+
+            # v2: shadow window looks fine (constant scores), but SERVING it
+            # explodes — exactly the class of regression only post-swap
+            # health can catch
+            v2 = VersionScorer(0.9)
+            await h.publish("v2", scorer=v2)
+            await h.tick()  # candidate attached
+            assert h.svc.evaluator.candidate_version == "v2"
+            await h.drive_rounds(6)
+            await h.tick()  # promoted, hot-swapped; health window opens
+            assert h.svc.evaluator.serving_version == "v2"
+            assert h.link._warm_prev is not None
+            assert h.link._warm_prev.version == "v1"
+            v2.boom = True  # the regression begins
+            rollbacks = sched_metrics.MODEL_ROLLBACK_TOTAL.value
+            await h.drive_rounds(8)  # every round falls back on scorer_error
+            await h.tick()  # health verdict → auto-rollback
+            assert h.svc.evaluator.serving_version == "v1"
+            assert sched_metrics.MODEL_ROLLBACK_TOTAL.value == rollbacks + 1
+            reg = await h.mc.rollout_status("gnn", 0)
+            assert reg["active"]["version"] == "v1"
+            bad = (await h.mc.list_models(type="gnn", version="v2"))[0]
+            assert bad["state"] == "rejected"
+            # v1 serves instantly (warm bundle) and traffic is clean again
+            await h.drive_rounds(4)
+            out = h.svc.evaluator.evaluate(h.child, h.parents)
+            assert np.all(out == 0.5)
+            # the rejected version never re-attaches even though ticks
+            # continue — and while a stale registry keeps naming a
+            # locally-rejected version active, every tick counts the
+            # divergence in model_swap_total{rejected_version}
+            rej_before = _metric(
+                sched_metrics.MODEL_SWAP_TOTAL, result="rejected_version"
+            )
+            h.link._rejected_versions.add("vstale")
+            await h.link._check_active({"version": "vstale", "id": 999})
+            assert _metric(
+                sched_metrics.MODEL_SWAP_TOTAL, result="rejected_version"
+            ) == rej_before + 1
+            assert _metric(
+                sched_metrics.MODEL_SWAP_LAST_ERROR, error="rejected_version"
+            ) == 1.0
+            await h.tick()
+            assert h.svc.evaluator.serving_version == "v1"
+            # rollback re-anchored the health baseline window: the next
+            # swap's baseline starts at the rollback, not inside v2's
+            # regression window
+            post_rb = R.HealthSample.capture()
+            assert h.link._last_swap_sample.rounds >= post_rb.rounds - 8
+
+    run(body())
+
+
+@pytest.mark.chaos
+def test_chaos_hot_swap_under_dispatched_traffic(run, tmp_path, monkeypatch):
+    """ISSUE 11 acceptance: under CONTINUOUS dispatched scheduling rounds —
+    (1) a candidate is shadow-scored and promoted through the gate with a
+    zero-drop hot-swap (every launched round completes, no torn old/new
+    score mix, counter-asserted); (2) an injected corrupt candidate is
+    rejected before attach; (3) a health-regressing promotion auto-rolls
+    back to the prior version."""
+
+    async def body():
+        gates = R.HealthGates(
+            window_s=30.0, min_rounds=5,
+            max_error_rate_increase=0.2, max_fallback_rate_increase=0.2,
+        )
+        async with _LinkHarness(tmp_path, monkeypatch, health_gates=gates) as h:
+            # sharded serving: rounds run on dispatcher worker threads
+            h.svc.scheduling.config.dispatch_workers = 2
+            h.svc.scheduling.attach_dispatcher(2)
+            await h.mc.set_config("model_rollout", {
+                "enabled": True, "types": ["gnn"], "auto_promote": True,
+                "gates": {"min_rounds": 5, "min_topk_overlap": 0.0,
+                          "min_rank_corr": -1.0, "max_mean_abs_delta": 100.0,
+                          "max_error_rate": 1.0},
+            })
+            v1, v2, v3 = VersionScorer(1.0), VersionScorer(2.0), VersionScorer(3.0)
+            legal = {1.0, 2.0, 3.0}
+            torn: list = []
+            ev = h.svc.evaluator
+            real_many = ev.evaluate_many
+
+            def checked_many(rounds):
+                outs = real_many(rounds)
+                for o in outs:
+                    if o is None or len(o) == 0:
+                        continue
+                    vals = set(np.asarray(o).tolist())  # dflint: disable=DF033 per-round torn-mix probe, not a hot path
+                    # every ml-scored round is one constant; base-fallback
+                    # rounds (varying) are fine — only a MIX of ml constants
+                    # would be a torn round
+                    ml_vals = vals & legal
+                    if ml_vals and len(vals) > 1:
+                        torn.append(vals)
+                return outs
+
+            monkeypatch.setattr(ev, "evaluate_many", checked_many)
+
+            stop = asyncio.Event()
+            completed, dropped = [], []
+
+            async def traffic():
+                # through the SERVICE (reschedule): rounds land on dispatcher
+                # workers AND feed the schedule-duration health counters
+                while not stop.is_set():
+                    futs = [h.svc.reschedule(h.child.id) for _ in range(3)]
+                    for out in await asyncio.gather(*futs, return_exceptions=True):
+                        if isinstance(out, Exception) or not out.parents:
+                            dropped.append(out)
+                        else:
+                            completed.append(out)
+                    await asyncio.sleep(0)
+
+            t = asyncio.ensure_future(traffic())
+            try:
+                # (1) candidate v1 → shadow → promote → zero-drop swap
+                await h.publish("v1", scorer=v1)
+                await h.tick()
+                while (await h.mc.rollout_status("gnn", 0))["active"] is None:
+                    await asyncio.sleep(0.02)
+                    await h.tick()
+                assert ev.serving_version == "v1"
+
+                # (2) corrupt candidate injected mid-traffic: rejected, never
+                # attached, serving stays v1
+                await h.publish("vbad", scorer=VersionScorer(7.7), corrupt=True)
+                await h.tick()
+                assert ev.candidate_version == ""
+                assert ev.serving_version == "v1"
+                row = (await h.mc.list_models(type="gnn", version="vbad"))[0]
+                assert row["state"] == "rejected"
+
+                # (3) v2 promotes then regresses -> auto-rollback to v1
+                await h.publish("v2", scorer=v2)
+                await h.tick()
+                for _ in range(100):
+                    await asyncio.sleep(0.02)
+                    await h.tick()
+                    if ev.serving_version == "v2":
+                        break
+                assert ev.serving_version == "v2"
+                v2.boom = True
+                for _ in range(100):
+                    await asyncio.sleep(0.02)
+                    await h.tick()
+                    if ev.serving_version == "v1":
+                        break
+                assert ev.serving_version == "v1", "auto-rollback never fired"
+                bad = (await h.mc.list_models(type="gnn", version="v2"))[0]
+                assert bad["state"] == "rejected"
+                assert (await h.mc.rollout_status("gnn", 0))["active"]["version"] == "v1"
+
+                # (bonus) v3 rolls out cleanly after all that
+                await h.publish("v3", scorer=v3)
+                for _ in range(100):
+                    await asyncio.sleep(0.02)
+                    await h.tick()
+                    if ev.serving_version == "v3":
+                        break
+                assert ev.serving_version == "v3"
+            finally:
+                stop.set()
+                await t
+            # ZERO dropped or torn rounds across every swap/reject/rollback
+            assert not dropped, f"dropped rounds: {dropped[:3]}"
+            assert not torn, f"torn score mixes: {torn[:3]}"
+            assert len(completed) > 0
+            # replaced bundles drained and freed (v2 was rolled back, v1+v2
+            # were both displaced by v3's swap chain)
+            h.link._drain_retired()
+            assert h.link._draining == []
+
+    run(body())
+
+
+# ---------------------------------------------------------------------------
+# dfmodel CLI rollout subcommands (against the real manager RPC)
+# ---------------------------------------------------------------------------
+
+
+def test_dfmodel_status_promote_rollback(run, tmp_path, capsys):
+    async def body():
+        manager = ManagerServer(db_path=str(tmp_path / "m.db"))
+        await manager.start()
+        mc = RemoteManagerClient(manager.address)
+        try:
+            await mc.set_config("model_rollout", {
+                "enabled": True, "types": ["gnn"], "auto_promote": False,
+                "gates": {"min_rounds": 1},
+            })
+            a, da = make_artifact(tmp_path, "v1")
+            await mc.publish_model("gnn", "v1", artifact_path=a, artifact_digest=da)
+
+            # drive through the argparse entry exactly as the shell would;
+            # main() owns its own asyncio.run, so it rides a worker thread
+            import contextlib
+            import io
+            import sys as _sys
+
+            from dragonfly2_tpu.cli import dfmodel
+
+            def run_cli_sync(argv) -> tuple[int, str]:
+                old_argv = _sys.argv
+                _sys.argv = ["dfmodel", *argv]
+                buf = io.StringIO()
+                code = 0
+                try:
+                    with contextlib.redirect_stdout(buf):
+                        try:
+                            dfmodel.main()
+                        except SystemExit as e:
+                            code = int(e.code or 0)
+                finally:
+                    _sys.argv = old_argv
+                return code, buf.getvalue()
+
+            async def run_cli(argv):
+                return await asyncio.to_thread(run_cli_sync, argv)
+
+            code, out = await run_cli(["promote", "--manager", manager.address, "--version", "v1"])
+            assert code == 0, out
+            assert json.loads(out)["state"] == "active"
+            # v2 publishes AFTER v1 went active (a pending v1 would be
+            # superseded-rejected by the publish — pinned elsewhere)
+            b, db_ = make_artifact(tmp_path, "v2")
+            await mc.publish_model("gnn", "v2", artifact_path=b, artifact_digest=db_)
+            code, out = await run_cli(["promote", "--manager", manager.address, "--version", "v2"])
+            assert code == 0 and json.loads(out)["state"] == "active"
+            code, out = await run_cli(["status", "--manager", manager.address])
+            assert code == 0 and "active:    v2" in out and "rejected" not in out
+            code, out = await run_cli(["rollback", "--manager", manager.address,
+                                       "--reason", "bad placement"])
+            assert code == 0
+            payload = json.loads(out)
+            assert payload == {"rolled_back": "v2", "active": "v1"}
+            code, out = await run_cli(["status", "--manager", manager.address, "--json"])
+            assert code == 0
+            st = json.loads(out)
+            assert st["active"]["version"] == "v1"
+            assert st["rejected"][-1]["version"] == "v2"
+        finally:
+            await mc.close()
+            await manager.stop()
+
+    run(body())

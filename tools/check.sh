@@ -114,6 +114,13 @@ run_stage "concurrency-smoke" env JAX_PLATFORMS=cpu python -m pytest tests/test_
 run_stage "federation-smoke" env JAX_PLATFORMS=cpu python -m dragonfly2_tpu.cli.dfcluster \
     demo --payload-kb 6144 --verify-trace
 
+# rollout-smoke: the live-model safe-rollout loop against real seams —
+# publish a digest-verified candidate into the manager registry, shadow N
+# live scheduling rounds on an ml scheduler (divergence window reported +
+# aggregated), promote via the dfmodel CLI, and assert the serving-mode
+# metric flips with ZERO base-fallback growth after the zero-drop swap.
+run_stage "rollout-smoke" env JAX_PLATFORMS=cpu python tools/rollout_smoke.py
+
 # observability-smoke: one trace over the REAL rpc wire into two per-process
 # span files, reassembled by dftrace — propagation, all-or-nothing sampling,
 # and the critical-path identity (exclusive times sum to the root's wall)
