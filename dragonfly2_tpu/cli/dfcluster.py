@@ -79,7 +79,13 @@ class Cluster:
         return line
 
     def up(self, *, schedulers: int = 2, daemons: int = 2,
-           federation_interval: float = 1.0, probe_interval: float = 2.0) -> None:
+           federation_interval: float = 1.0, probe_interval: float = 2.0,
+           extra_scheduler_args: list[str] | None = None,
+           extra_daemon_args: list[str] | None = None) -> None:
+        """extra_*_args append raw flags to every scheduler/daemon spawn —
+        the hook harnesses (tools/metrics_smoke.py) use for fast keepalive
+        cadences or an alternate evaluator without widening this signature
+        per knob."""
         t0 = time.monotonic()
         line = self._spawn(
             "manager",
@@ -98,6 +104,7 @@ class Cluster:
             ]
             if self.scheduler_addrs:
                 args += ["--federation-peers", ",".join(self.scheduler_addrs)]
+            args += extra_scheduler_args or []
             line = self._spawn(f"scheduler-{i}", args, "SCHEDULER_READY")
             self.scheduler_addrs.append(line.split()[1])
         sched_spec = ",".join(self.scheduler_addrs)
@@ -111,7 +118,8 @@ class Cluster:
                  "--sock", sock,
                  "--storage", os.path.join(self.root, f"store-{i}"),
                  "--hostname", f"box-daemon-{i}",
-                 "--probe-interval", str(probe_interval)],
+                 "--probe-interval", str(probe_interval),
+                 *(extra_daemon_args or [])],
                 "DAEMON_READY",
             )
             self.daemon_socks.append(sock)

@@ -63,12 +63,23 @@ async def _rollout_main(args: argparse.Namespace) -> int:
             )
             for c in st["candidates"]:
                 agg = (c.get("rollout") or {}).get("aggregate") or {}
+                # worst-round slicing (ISSUE 12): min/p99 expose a candidate
+                # that is fine on average but catastrophic on a sliver of
+                # rounds — the aggregate means alone hid that
+                mn = agg.get("topk_overlap_min")
+                p99 = agg.get("abs_delta_p99")
+                topk = f"topk={agg.get('topk_overlap_mean', 0.0):.3f}"
+                if mn is not None:
+                    topk += f"(min={mn:.3f})"
+                delta = f"delta={agg.get('abs_delta_mean', 0.0):.4f}"
+                if p99 is not None:
+                    delta += f"(p99<={p99:.3f})"
                 print(
                     f"  {c['state']:<9}  {c['version']} (id {c['id']})"
                     f"  rounds={agg.get('rounds', 0)}"
-                    f" topk={agg.get('topk_overlap_mean', 0.0):.3f}"
+                    f" {topk}"
                     f" corr={agg.get('rank_corr_mean', 0.0):.3f}"
-                    f" delta={agg.get('abs_delta_mean', 0.0):.4f}"
+                    f" {delta}"
                     f" errors={agg.get('errors', 0)}"
                 )
             for r in st["rejected"]:

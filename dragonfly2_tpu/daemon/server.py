@@ -355,6 +355,16 @@ async def run_daemon(
 
     loop_monitor = default_monitor()
     loop_monitor.start()
+    # metrics plane (ISSUE 12): timeseries rings + SLO alerts, always on —
+    # the announce loop below ships a windowed stats frame to the manager
+    # when one is configured
+    from dragonfly2_tpu.observability.alerts import default_engine
+    from dragonfly2_tpu.observability.timeseries import default_recorder
+
+    recorder = default_recorder()
+    recorder.start()
+    alert_engine = default_engine()
+    alert_engine.start()
     debug = None
     if metrics_port is not None:
         from dragonfly2_tpu.observability.server import start_debug_server
@@ -396,6 +406,24 @@ async def run_daemon(
                         )
                 except Exception:
                     logger.warning("manager keepalive failed", exc_info=True)
+            if resolver_manager is not None:
+                # cluster metrics plane (ISSUE 12): every daemon that knows
+                # the manager ships its windowed stats frame on the same
+                # announce tick — the manager aggregates, dftop renders
+                try:
+                    from dragonfly2_tpu.observability.timeseries import (
+                        build_stats_frame,
+                    )
+
+                    frame = build_stats_frame(
+                        recorder, service="daemon", hostname=engine.hostname,
+                        alerts=alert_engine,
+                    )
+                    await resolver_manager.keepalive(
+                        "daemon", engine.hostname, stats=frame
+                    )
+                except Exception:
+                    logger.debug("stats frame push failed", exc_info=True)
             await asyncio.sleep(announce_interval)
 
     from dragonfly2_tpu.daemon.prober import DEFAULT_PROBE_INTERVAL, Prober
@@ -409,6 +437,8 @@ async def run_daemon(
         await run_until_signalled(ready_event)
     finally:
         loop_monitor.stop()
+        alert_engine.stop()
+        recorder.stop()
         announcer.cancel()
         await prober.stop()
         if sni_proxy is not None:
@@ -513,6 +543,9 @@ def main() -> None:
     ap.add_argument("--vsock-port", type=int, default=cfg.vsock_port,
                     help="AF_VSOCK RPC port for VM-isolated clients (Kata)")
     ap.add_argument("--manager", default=cfg.manager, help="manager address host:port")
+    ap.add_argument("--announce-interval", type=float, default=30.0,
+                    help="scheduler announce / manager stats-frame cadence "
+                         "in seconds (default 30)")
     ap.add_argument("--probe-interval", type=float, default=cfg.probe_interval,
                     help="RTT probe cadence in seconds (default 20 min)")
     ap.add_argument("--storage-ttl-hours", type=float, default=cfg.storage.ttl_hours,
@@ -574,6 +607,7 @@ def main() -> None:
             object_storage_root=args.object_storage_root,
             object_storage_backend=args.object_storage_backend,
             manager_addr=args.manager,
+            announce_interval=args.announce_interval,
             probe_interval=args.probe_interval,
             storage_ttl=args.storage_ttl_hours * 3600,
             storage_capacity_bytes=(

@@ -100,6 +100,9 @@ class ManagerRest:
         # schedulers / seed peers (instance registry, read-mostly)
         r.add_get("/api/v1/schedulers", self.list_schedulers)
         r.add_get("/api/v1/seed-peers", self.list_seed_peers)
+        # cluster metrics plane (ISSUE 12): REST mirror of the cluster_stats
+        # RPC — same JSON dftop renders, curl-able for dashboards
+        r.add_get("/api/v1/cluster/stats", self.cluster_stats)
         # applications
         r.add_get("/api/v1/applications", self.list_applications)
         r.add_post("/api/v1/applications", self.upsert_application)
@@ -242,6 +245,13 @@ class ManagerRest:
 
     async def list_seed_peers(self, req: web.Request) -> web.Response:
         return _json(self.svc.db.find("seed_peers"))
+
+    async def cluster_stats(self, req: web.Request) -> web.Response:
+        try:
+            history = min(64, int(req.query.get("history", "0")))
+        except ValueError:
+            return _json({"error": "history must be an integer"}, status=400)
+        return _json(self.svc.cluster_stats(history=history))
 
     # ---- applications / configs ----
 

@@ -28,6 +28,17 @@ MODEL_MLP = "mlp"
 
 DEFAULT_KEEPALIVE_TTL = 60.0  # reference reaps on stream close; we reap on TTL
 
+# cluster metrics plane (ISSUE 12): frames kept per member ring. At the 20 s
+# scheduler keepalive default this is ~20 min of history per member; frames
+# are a few hundred bytes each, so the whole plane is bounded at
+# members * STATS_FRAMES_KEPT * frame size. Members silent past
+# STATS_EVICT_TTL_FACTOR x keepalive_ttl are EVICTED from the ring entirely
+# (between 2x and that they show as "stale" so dftop names who went dark) —
+# without the eviction horizon, hostname churn (k8s pod names, chaos tests)
+# would grow _member_stats and every cluster_stats response forever.
+STATS_FRAMES_KEPT = 64
+STATS_EVICT_TTL_FACTOR = 10.0
+
 
 class ManagerService:
     def __init__(
@@ -42,6 +53,12 @@ class ManagerService:
         # cluster-scoring is plugin-overridable (ref searcher/plugin.go)
         self.searcher = searcher.new_searcher(searcher_spec)
         self._reaper_task: asyncio.Task | None = None
+        # cluster metrics plane (ISSUE 12): per-member stats-frame rings,
+        # keyed (source_type, hostname). Deliberately NOT in the DB: frames
+        # are ephemeral telemetry — a restarted manager rebuilds the view
+        # within one keepalive tick, exactly like the reference's in-memory
+        # KeepAlive stream state.
+        self._member_stats: dict[tuple[str, str], dict] = {}
 
     # ---------- scheduler clusters ----------
 
@@ -135,8 +152,24 @@ class ManagerService:
             last_keepalive=time.time(),
         )
 
-    def keepalive(self, source_type: str, hostname: str, cluster_id: int | None = None) -> bool:
-        """Refresh liveness (ref KeepAlive stream, manager_server_v2.go:746)."""
+    def keepalive(
+        self,
+        source_type: str,
+        hostname: str,
+        cluster_id: int | None = None,
+        stats: dict | None = None,
+    ) -> bool:
+        """Refresh liveness (ref KeepAlive stream, manager_server_v2.go:746).
+
+        `stats` is the optional compact stats frame (ISSUE 12) services
+        piggyback on their existing keepalive tick — recorded into the
+        member ring, zero extra RPCs. Daemons and the trainer have no
+        registry table; their keepalive is stats-only and liveness lives in
+        the member ring's last_seen."""
+        if stats is not None:
+            self.report_stats(source_type, hostname, stats)
+        if source_type not in ("scheduler", "seed_peer"):
+            return stats is not None
         table = "schedulers" if source_type == "scheduler" else "seed_peers"
         key = "scheduler_cluster_id" if source_type == "scheduler" else "seed_peer_cluster_id"
         where: dict[str, Any] = {"hostname": hostname}
@@ -146,6 +179,90 @@ class ManagerService:
             table, where, state=STATE_ACTIVE, last_keepalive=time.time()
         )
         return n > 0
+
+    # ---------- cluster metrics plane (ISSUE 12) ----------
+
+    def report_stats(self, source_type: str, hostname: str, frame: dict) -> bool:
+        """Record one member's stats frame (rides keepalive, or stands alone
+        via the report_stats RPC)."""
+        from collections import deque
+
+        if not isinstance(frame, dict):
+            raise ValueError("stats frame must be a dict")
+        self._evict_silent_members(time.time())
+        key = (str(source_type), str(hostname or "unknown"))
+        entry = self._member_stats.get(key)
+        if entry is None:
+            entry = self._member_stats[key] = {
+                "frames": deque(maxlen=STATS_FRAMES_KEPT),
+            }
+        entry["frames"].append(frame)
+        entry["last_seen"] = time.time()
+        return True
+
+    def _evict_silent_members(self, now: float) -> None:
+        """Drop members silent past the eviction horizon — runs on both the
+        report path and the read path so a manager nobody queries still
+        doesn't accumulate churned hostnames forever. O(members) per call,
+        noise at control-plane rates."""
+        evict_after = max(
+            self.keepalive_ttl * STATS_EVICT_TTL_FACTOR, self.keepalive_ttl * 2
+        )
+        for key in [
+            k for k, e in self._member_stats.items()
+            if now - e.get("last_seen", 0.0) > evict_after
+        ]:
+            del self._member_stats[key]
+
+    def cluster_stats(self, *, history: int = 0) -> dict:
+        """The whole cluster as one view: per-member latest frames plus
+        cluster rollups (summed rates, alert union). Members silent past
+        2x the keepalive TTL are marked stale and excluded from rollups —
+        their last frame stays visible so dftop shows WHO went dark, not
+        just a shorter table. `history` > 0 additionally returns the last N
+        frames per member (sparklines / debugging)."""
+        now = time.time()
+        stale_after = self.keepalive_ttl * 2
+        self._evict_silent_members(now)
+        members: list[dict] = []
+        rollup_rates: dict[str, float] = {}
+        alerts: list[dict] = []
+        live = 0
+        for (source_type, hostname), entry in sorted(self._member_stats.items()):
+            frames = entry["frames"]
+            if not frames:
+                continue
+            latest = frames[-1]
+            age = now - entry["last_seen"]
+            stale = age > stale_after
+            m: dict[str, Any] = {
+                "source_type": source_type,
+                "hostname": hostname,
+                "age_s": round(age, 1),
+                "stale": stale,
+                "frame": latest,
+            }
+            if history > 0:
+                m["history"] = list(frames)[-history:]
+            members.append(m)
+            if stale:
+                continue
+            live += 1
+            for k, v in (latest.get("rates") or {}).items():
+                if isinstance(v, (int, float)):
+                    rollup_rates[k] = rollup_rates.get(k, 0.0) + float(v)
+            for name in latest.get("alerts") or ():
+                alerts.append({"name": name, "member": hostname, "source_type": source_type})
+        return {
+            "ts": now,
+            "members": members,
+            "cluster": {
+                "members_live": live,
+                "members_stale": len(members) - live,
+                "rates": {k: round(v, 3) for k, v in sorted(rollup_rates.items())},
+                "alerts": alerts,
+            },
+        }
 
     def reap_stale(self) -> int:
         """Mark instances inactive when keepalives stop."""

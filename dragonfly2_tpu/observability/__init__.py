@@ -7,6 +7,7 @@ bootstrap) — built dependency-free: a small typed registry with text
 exposition, and a contextvar-based tracer writing JSON-lines spans.
 """
 
+from dragonfly2_tpu.observability.alerts import AlertEngine, AlertRule, default_engine
 from dragonfly2_tpu.observability.metrics import (
     Counter,
     Gauge,
@@ -14,13 +15,24 @@ from dragonfly2_tpu.observability.metrics import (
     MetricsRegistry,
     default_registry,
 )
+from dragonfly2_tpu.observability.timeseries import (
+    MetricsRecorder,
+    build_stats_frame,
+    default_recorder,
+)
 from dragonfly2_tpu.observability.tracing import Span, Tracer, default_tracer
 
 __all__ = [
+    "AlertEngine",
+    "AlertRule",
     "Counter",
     "Gauge",
     "Histogram",
+    "MetricsRecorder",
     "MetricsRegistry",
+    "build_stats_frame",
+    "default_engine",
+    "default_recorder",
     "default_registry",
     "Span",
     "Tracer",

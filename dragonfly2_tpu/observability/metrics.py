@@ -295,6 +295,13 @@ class MetricsRegistry:
     def get(self, full_name: str) -> Optional[_Metric]:
         return self._metrics.get(full_name)
 
+    def families(self) -> list[_Metric]:
+        """Snapshot of the registered families (the timeseries recorder walks
+        this every sample tick; a service registering a NEW family mid-walk
+        must not raise RuntimeError under it)."""
+        with self._lock:
+            return list(self._metrics.values())
+
     def render_text(self) -> str:
         lines: list[str] = []
         for name in sorted(self._metrics):

@@ -7,6 +7,12 @@ The reference gives every service a dedicated metrics port plus pprof/statsview
   GET /debug/spans        last finished tracing spans as JSON
   GET /debug/loop         event-loop lag + dispatcher-worker utilization
                           (observability.loophealth)
+  GET /debug/ts[?name=N&window=S]
+                          timeseries recorder (observability.timeseries):
+                          no name → recorder stats + series catalog; with a
+                          family name → raw ring points plus the windowed
+                          rate / histogram summary
+  GET /debug/alerts       SLO rule engine state (observability.alerts)
   GET /debug/stacks       every thread's stack + every asyncio task's frame
                           (the /debug/pprof/goroutine analogue)
   GET /debug/profile?seconds=N[&mode=sample&hz=H]
@@ -113,12 +119,18 @@ def make_debug_app(
     registry: MetricsRegistry | None = None,
     tracer: Tracer | None = None,
     loophealth: LoopHealthMonitor | None = None,
+    recorder=None,
+    alerts=None,
 ) -> web.Application:
+    from dragonfly2_tpu.observability.alerts import default_engine
     from dragonfly2_tpu.observability.metrics import metrics_http_handler
+    from dragonfly2_tpu.observability.timeseries import default_recorder
 
     reg = registry or default_registry()
     tr = tracer or default_tracer()
     lh = loophealth or default_monitor()
+    rec = recorder or default_recorder()
+    eng = alerts or default_engine()
     app = web.Application()
     metrics = metrics_http_handler(reg)
     profiling = {"active": False}
@@ -131,6 +143,30 @@ def make_debug_app(
 
     async def loop_health(_req: web.Request) -> web.Response:
         return web.json_response(lh.stats())
+
+    async def timeseries(req: web.Request) -> web.Response:
+        name = req.query.get("name")
+        if not name:
+            return web.json_response(
+                {"recorder": rec.stats(), "series": rec.series()}
+            )
+        try:
+            window = min(
+                rec.retention_s, max(1.0, float(req.query.get("window", "60")))
+            )
+        except ValueError:
+            raise web.HTTPBadRequest(text="window must be a number of seconds")
+        out = {
+            "name": name,
+            "rate_per_s": rec.rate(name, window_s=window),
+            "latest": rec.latest(name),
+            "histogram": rec.hist_window(name, window_s=window),
+            "series": rec.query(name),
+        }
+        return web.json_response(out)
+
+    async def alerts_status(_req: web.Request) -> web.Response:
+        return web.json_response(eng.status())
 
     async def stacks(_req: web.Request) -> web.Response:
         return web.Response(text=_dump_stacks(), content_type="text/plain")
@@ -170,6 +206,8 @@ def make_debug_app(
     app.router.add_get("/healthz", healthz)
     app.router.add_get("/debug/spans", spans)
     app.router.add_get("/debug/loop", loop_health)
+    app.router.add_get("/debug/ts", timeseries)
+    app.router.add_get("/debug/alerts", alerts_status)
     app.router.add_get("/debug/stacks", stacks)
     app.router.add_get("/debug/profile", profile)
     return app
@@ -184,10 +222,12 @@ class DebugServer:
         registry: MetricsRegistry | None = None,
         tracer: Tracer | None = None,
         loophealth: LoopHealthMonitor | None = None,
+        recorder=None,
+        alerts=None,
     ):
         self.host = host
         self.port = port
-        self._app = make_debug_app(registry, tracer, loophealth)
+        self._app = make_debug_app(registry, tracer, loophealth, recorder, alerts)
         self._runner: web.AppRunner | None = None
 
     async def start(self) -> None:
@@ -211,9 +251,12 @@ async def start_debug_server(
     registry: MetricsRegistry | None = None,
     tracer: Tracer | None = None,
     loophealth: LoopHealthMonitor | None = None,
+    recorder=None,
+    alerts=None,
 ) -> DebugServer:
     srv = DebugServer(
-        host=host, port=port, registry=registry, tracer=tracer, loophealth=loophealth
+        host=host, port=port, registry=registry, tracer=tracer,
+        loophealth=loophealth, recorder=recorder, alerts=alerts,
     )
     await srv.start()
     return srv

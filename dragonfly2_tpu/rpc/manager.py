@@ -19,6 +19,8 @@ MANAGER_METHODS = [
     "update_seed_peer",
     "keepalive",
     "cluster_config",
+    "report_stats",
+    "cluster_stats",
     "create_model",
     "activate_model",
     "active_model",
@@ -73,11 +75,23 @@ class ManagerRpcAdapter:
 
     async def keepalive(self, p: dict) -> bool:
         return self.svc.keepalive(
-            p["source_type"], p["hostname"], p.get("cluster_id")
+            p["source_type"], p["hostname"], p.get("cluster_id"),
+            stats=p.get("stats"),
         )
 
     async def cluster_config(self, p: dict) -> dict:
         return self.svc.cluster_config(p["scheduler_cluster_id"])
+
+    # ---- cluster metrics plane (ISSUE 12) ----
+
+    async def report_stats(self, p: dict) -> bool:
+        return self.svc.report_stats(
+            p.get("source_type", ""), p.get("hostname", ""), p.get("frame") or {}
+        )
+
+    async def cluster_stats(self, p: dict | None) -> dict:
+        history = int((p or {}).get("history", 0))
+        return self.svc.cluster_stats(history=min(history, 64))
 
     async def create_model(self, p: dict) -> dict:
         return self.svc.create_model(
@@ -213,11 +227,29 @@ class RemoteManagerClient:
             "update_seed_peer", {"hostname": hostname, "ip": ip, "port": port, **kw}
         )
 
-    async def keepalive(self, source_type: str, hostname: str, cluster_id: int | None = None) -> bool:
+    async def keepalive(
+        self,
+        source_type: str,
+        hostname: str,
+        cluster_id: int | None = None,
+        *,
+        stats: dict | None = None,
+    ) -> bool:
+        payload: dict[str, Any] = {
+            "source_type": source_type, "hostname": hostname, "cluster_id": cluster_id,
+        }
+        if stats is not None:
+            payload["stats"] = stats
+        return await self._c.call("keepalive", payload)
+
+    async def report_stats(self, source_type: str, hostname: str, frame: dict) -> bool:
         return await self._c.call(
-            "keepalive",
-            {"source_type": source_type, "hostname": hostname, "cluster_id": cluster_id},
+            "report_stats",
+            {"source_type": source_type, "hostname": hostname, "frame": frame},
         )
+
+    async def cluster_stats(self, *, history: int = 0) -> dict:
+        return await self._c.call("cluster_stats", {"history": history})
 
     async def cluster_config(self, scheduler_cluster_id: int) -> dict:
         return await self._c.call("cluster_config", {"scheduler_cluster_id": scheduler_cluster_id})

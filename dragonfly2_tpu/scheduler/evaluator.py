@@ -336,11 +336,16 @@ class MLEvaluator(Evaluator):
                  " ~1.5k calls/s, base is numpy)",
         )
 
-    @staticmethod
-    def _count_fallback(reason: str) -> None:
+    def _count_fallback(self, reason: str) -> None:
         from dragonfly2_tpu.scheduler import metrics
 
         metrics.ML_BASE_FALLBACK_TOTAL.inc(reason=reason)
+        # registry-scoped twin (ISSUE 12): SchedulerService wires its
+        # ServiceMetrics here so rollout health baselines window THIS
+        # service's fallbacks, not every service's in the process
+        local = getattr(self, "local_metrics", None)
+        if local is not None:
+            local.base_fallback.inc(reason=reason)
 
     def attach_scorer(
         self, scorer, node_index: dict[str, int], *,

@@ -121,6 +121,8 @@ class ManagerLink:
         model_watch_interval: float = 60.0,
         shadow_sample_rate: float = 1.0,
         health_gates=None,
+        recorder=None,
+        alert_engine=None,
     ):
         from dragonfly2_tpu.resilience.backoff import BackoffPolicy
         from dragonfly2_tpu.scheduler.rollout import HealthGates, HealthSample
@@ -135,6 +137,12 @@ class ManagerLink:
         self.keepalive_interval = keepalive_interval
         self.model_watch_interval = model_watch_interval
         self._active_model_version: str | None = None
+        # ---- cluster metrics plane (ISSUE 12) ----
+        # stats frames ride the keepalive tick when a recorder is wired
+        # (scheduler/server.py boots the default one); the alert engine's
+        # active set travels inside the frame
+        self.recorder = recorder
+        self.alert_engine = alert_engine
         # ---- live-model rollout state (ISSUE 11) ----
         self.shadow_sample_rate = shadow_sample_rate
         self.health_gates = health_gates if health_gates is not None else HealthGates()
@@ -143,7 +151,12 @@ class ManagerLink:
         self._health = None              # PostSwapHealth after a rollback-able swap
         self._shadow_row_id: int | None = None
         self._rejected_versions: set[str] = set()
-        self._last_swap_sample = HealthSample.capture()
+        # health baselines read the SERVICE's registry-scoped serving
+        # counters (scheduler/metrics.ServiceMetrics), not the process-global
+        # families — a multi-service test process no longer shares baselines
+        # (ROADMAP #4 follow-up closed by ISSUE 12)
+        self._health_source = getattr(service, "local_metrics", None)
+        self._last_swap_sample = HealthSample.capture(self._health_source)
         # persistent watch failure (manager down, active artifact corrupt)
         # backs off exponentially instead of hammering every tick (DF024)
         self._watch_failures = 0
@@ -217,9 +230,29 @@ class ManagerLink:
         while True:
             await asyncio.sleep(self.keepalive_interval)
             try:
-                await self.manager.keepalive("scheduler", self.hostname, self.cluster_id)
+                await self.manager.keepalive(
+                    "scheduler", self.hostname, self.cluster_id,
+                    stats=self._stats_frame(),
+                )
             except Exception as e:
                 logger.warning("manager keepalive failed: %s", e)
+
+    def _stats_frame(self) -> dict | None:
+        """The compact windowed-health frame riding each keepalive (ISSUE
+        12). None (frameless keepalive, the pre-metrics-plane wire shape)
+        when no recorder is wired."""
+        if self.recorder is None:
+            return None
+        from dragonfly2_tpu.observability.timeseries import build_stats_frame
+
+        try:
+            return build_stats_frame(
+                self.recorder, service="scheduler", hostname=self.hostname,
+                alerts=self.alert_engine,
+            )
+        except Exception:
+            logger.exception("stats frame build failed")
+            return None
 
     async def _job_loop(self) -> None:
         """Preheat consumer (ref scheduler/job preheat handler)."""
@@ -446,12 +479,13 @@ class ManagerLink:
             if self._warm_prev is not None and self._warm_prev is not prev:
                 self._draining.append(self._warm_prev)
             self._warm_prev = prev
-            now = HealthSample.capture()
+            now = HealthSample.capture(self._health_source)
             baseline = PostSwapHealth.rates_of(self._last_swap_sample, now)
             self._last_swap_sample = now
             if prev is not None:
                 self._health = PostSwapHealth(
-                    self.health_gates, baseline_rates=baseline, at_swap=now
+                    self.health_gates, baseline_rates=baseline, at_swap=now,
+                    source=self._health_source,
                 )
         else:
             # plugin evaluators keep the legacy attach (no bundle protocol —
@@ -609,7 +643,7 @@ class ManagerLink:
         # baseline and let an equally-bad successor pass the health gate)
         from dragonfly2_tpu.scheduler.rollout import HealthSample
 
-        self._last_swap_sample = HealthSample.capture()
+        self._last_swap_sample = HealthSample.capture(self._health_source)
         metrics.MODEL_ROLLBACK_TOTAL.inc()
         self._note_swap("rollback")
         logger.warning(

@@ -171,3 +171,32 @@ FEDERATION_LAST_SYNC_TIMESTAMP = _r.gauge(
     "Unix time of the last successful federation sync (0 = never)",
     subsystem="scheduler",
 )
+
+
+class ServiceMetrics:
+    """Registry-scoped serving-health twins for ONE SchedulerService.
+
+    The families above are process-global — right for a production process
+    (one scheduler per process, one scrape endpoint), wrong for rollout
+    HEALTH BASELINES: a test/dfcluster process running several services
+    shared one set of counters, so service A's traffic moved service B's
+    post-swap baseline (PR 11's named follow-up, ROADMAP #4). Each service
+    now owns this private registry; the hot sites record into BOTH (the
+    extra observe is one lock + few adds, noise next to the round), and
+    rollout.HealthSample.capture(source=...) windows the private one.
+    """
+
+    def __init__(self):
+        from dragonfly2_tpu.observability.metrics import MetricsRegistry
+
+        self.registry = MetricsRegistry()
+        self.schedule_duration = self.registry.histogram(
+            "schedule_duration_seconds",
+            "Latency of one scheduling round (this service instance only)",
+            subsystem="scheduler",
+        )
+        self.base_fallback = self.registry.counter(
+            "ml_base_fallback_total",
+            "Base-fallback rounds (this service instance only)",
+            subsystem="scheduler", labels=("reason",),
+        )

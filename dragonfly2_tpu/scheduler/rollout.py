@@ -45,7 +45,7 @@ from __future__ import annotations
 
 import threading
 from dataclasses import dataclass, field
-from typing import Any
+from typing import Any, Sequence
 
 import numpy as np
 
@@ -287,6 +287,11 @@ class ShadowTracker:
         self._sum_corr = 0.0
         self._sum_delta = 0.0
         self._max_delta = 0.0
+        # worst-round slicing (ISSUE 12 satellite): the aggregate means hide
+        # a candidate that is fine on average but catastrophic on 1% of
+        # rounds — track the single worst top-k overlap, and derive a
+        # per-round delta p99 from the bucketed histogram at snapshot time
+        self._min_overlap: float | None = None
         self._delta_counts = [0] * (len(DELTA_BUCKETS) + 1)
 
     def should_sample(self) -> bool:
@@ -313,6 +318,8 @@ class ShadowTracker:
             self._sum_corr += d["rank_corr"]
             self._sum_delta += delta
             self._max_delta = max(self._max_delta, delta)
+            ov = d["topk_overlap"]
+            self._min_overlap = ov if self._min_overlap is None else min(self._min_overlap, ov)
             self._delta_counts[bucket] += 1
         self._export_metrics(d)
         return d
@@ -348,14 +355,37 @@ class ShadowTracker:
                 "uncovered": self.uncovered,
                 "errors": self.errors,
                 "topk_overlap_mean": self._sum_overlap / n if n else 0.0,
+                # worst single round: 0.0 here means at least one round where
+                # served and candidate agreed on NO top-k parent
+                "topk_overlap_min": self._min_overlap if n else None,
                 "rank_corr_mean": self._sum_corr / n if n else 0.0,
                 "abs_delta_mean": self._sum_delta / n if n else 0.0,
+                "abs_delta_p99": delta_hist_quantile(self._delta_counts, 0.99),
                 "abs_delta_max": self._max_delta,
                 "delta_hist": {
                     "buckets": list(DELTA_BUCKETS),
                     "counts": list(self._delta_counts),
                 },
             }
+
+
+def delta_hist_quantile(counts: Sequence[int], q: float) -> float | None:
+    """Per-round |delta| quantile from the DELTA_BUCKETS histogram counts
+    (last slot = overflow past the final bucket, answered with the final
+    bucket bound). Delegates to the ONE shared bucket-quantile
+    (observability/timeseries.bucket_quantile) so the same distribution
+    never reads differently from `dfmodel status` vs /debug/ts. None when
+    the histogram is empty."""
+    from dragonfly2_tpu.observability.timeseries import bucket_quantile
+
+    total = sum(counts)
+    if total <= 0:
+        return None
+    # the shared helper takes finite-bucket counts; mass in the overflow
+    # slot pushes the quantile past them and answers the last bucket bound
+    return bucket_quantile(
+        DELTA_BUCKETS, [float(c) for c in counts[: len(DELTA_BUCKETS)]], total, q
+    )
 
 
 def merge_reports(reports: list[dict]) -> dict:
@@ -365,8 +395,9 @@ def merge_reports(reports: list[dict]) -> dict:
     member's traffic counts toward the same window."""
     out: dict[str, Any] = {
         "rounds": 0, "uncovered": 0, "errors": 0, "seen": 0,
-        "topk_overlap_mean": 0.0, "rank_corr_mean": 0.0,
-        "abs_delta_mean": 0.0, "abs_delta_max": 0.0,
+        "topk_overlap_mean": 0.0, "topk_overlap_min": None,
+        "rank_corr_mean": 0.0,
+        "abs_delta_mean": 0.0, "abs_delta_p99": None, "abs_delta_max": 0.0,
         "delta_hist": {"buckets": list(DELTA_BUCKETS),
                        "counts": [0] * (len(DELTA_BUCKETS) + 1)},
     }
@@ -377,6 +408,11 @@ def merge_reports(reports: list[dict]) -> dict:
         out["errors"] += int(r.get("errors", 0))
         out["seen"] += int(r.get("seen", 0))
         out["topk_overlap_mean"] += r.get("topk_overlap_mean", 0.0) * n
+        # cluster-wide worst round = min over every member's worst round
+        mn = r.get("topk_overlap_min")
+        if mn is not None:
+            cur = out["topk_overlap_min"]
+            out["topk_overlap_min"] = mn if cur is None else min(cur, mn)
         out["rank_corr_mean"] += r.get("rank_corr_mean", 0.0) * n
         out["abs_delta_mean"] += r.get("abs_delta_mean", 0.0) * n
         out["abs_delta_max"] = max(out["abs_delta_max"], r.get("abs_delta_max", 0.0))
@@ -390,6 +426,9 @@ def merge_reports(reports: list[dict]) -> dict:
         out["topk_overlap_mean"] /= n
         out["rank_corr_mean"] /= n
         out["abs_delta_mean"] /= n
+    # per-round p99 recomputed from the MERGED histogram, not averaged from
+    # members' p99s (a quantile of quantiles is not a quantile)
+    out["abs_delta_p99"] = delta_hist_quantile(out["delta_hist"]["counts"], 0.99)
     return out
 
 
@@ -413,7 +452,13 @@ class HealthGates:
 
 @dataclass
 class HealthSample:
-    """One reading of the serving-health counters (deltas drive the gates)."""
+    """One reading of the serving-health counters (deltas drive the gates).
+
+    `source` is a registry-scoped counter set — scheduler/metrics.py
+    ServiceMetrics, owned by ONE SchedulerService — so two services in the
+    same process (federation tests, dfcluster-in-pytest) each window their
+    OWN traffic; the PR 11 process-global read survives as the source=None
+    fallback for external probes."""
 
     rounds: float = 0.0        # scheduling rounds observed (histogram count)
     latency_total: float = 0.0  # histogram sum (seconds)
@@ -421,7 +466,15 @@ class HealthSample:
     errors: float = 0.0        # scorer_error fallbacks
 
     @classmethod
-    def capture(cls) -> "HealthSample":
+    def capture(cls, source=None) -> "HealthSample":
+        if source is not None:
+            sd = source.schedule_duration.labels()
+            return cls(
+                rounds=float(sd.count),
+                latency_total=float(sd.total),
+                fallbacks=float(source.base_fallback.value),
+                errors=float(source.base_fallback.labels(reason="scorer_error").value),
+            )
         from dragonfly2_tpu.scheduler import metrics
 
         sd = metrics.SCHEDULE_DURATION.labels()
@@ -449,12 +502,14 @@ class PostSwapHealth:
         baseline_rates: dict[str, float] | None = None,
         at_swap: HealthSample | None = None,
         now: float | None = None,
+        source=None,
     ):
         import time
 
         self.gates = gates
         self.baseline = baseline_rates or {}
-        self.at_swap = at_swap or HealthSample.capture()
+        self.source = source  # registry-scoped ServiceMetrics (or None = global)
+        self.at_swap = at_swap or HealthSample.capture(source)
         self.started = now if now is not None else time.monotonic()
         self.decided: bool | None = None
 
@@ -477,7 +532,7 @@ class PostSwapHealth:
         if self.decided is not None:
             return self.decided, []
         now = now if now is not None else time.monotonic()
-        cur = HealthSample.capture()
+        cur = HealthSample.capture(self.source)
         rates = self.rates_of(self.at_swap, cur)
         rounds = rates.get("rounds", 0.0)
         window_done = rounds >= self.gates.min_rounds or (

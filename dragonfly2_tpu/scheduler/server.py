@@ -29,6 +29,7 @@ async def run_scheduler(
     metrics_port: int | None = None,
     gc_interval: float = 10.0,
     manager_addr: str | None = None,
+    keepalive_interval: float | None = None,
     trainer_addr: str | None = None,
     trainer_interval: float | None = None,
     model_watch_interval: float | None = None,
@@ -66,6 +67,17 @@ async def run_scheduler(
     if service.scheduling.dispatcher is not None:
         loop_monitor.attach_dispatcher(service.scheduling.dispatcher)
     loop_monitor.start()
+    # metrics plane (ISSUE 12): the timeseries recorder + SLO alert engine
+    # are always on — sampling is one registry walk per ~2 s, and every
+    # consumer (rollout health, stats frames, /debug/ts, dftop) needs the
+    # history to COVER the incident, not start after it
+    from dragonfly2_tpu.observability.alerts import default_engine
+    from dragonfly2_tpu.observability.timeseries import default_recorder
+
+    recorder = default_recorder()
+    recorder.start()
+    alert_engine = default_engine()
+    alert_engine.start()
     debug = None
     if metrics_port is not None:
         from dragonfly2_tpu.observability.server import start_debug_server
@@ -78,6 +90,8 @@ async def run_scheduler(
         from dragonfly2_tpu.scheduler.manager_link import ManagerLink
 
         link_kw = {}
+        if keepalive_interval is not None:
+            link_kw["keepalive_interval"] = keepalive_interval
         if model_watch_interval is not None:
             link_kw["model_watch_interval"] = model_watch_interval
         if shadow_sample_rate is not None:
@@ -87,7 +101,8 @@ async def run_scheduler(
         link = ManagerLink(
             service, manager_addr,
             hostname=hostname, ip=host, port=server.port,
-            idc=idc, location=location, **link_kw,
+            idc=idc, location=location,
+            recorder=recorder, alert_engine=alert_engine, **link_kw,
         )
         try:
             await link.start()
@@ -154,6 +169,8 @@ async def run_scheduler(
     finally:
         gc.stop()
         loop_monitor.stop()
+        alert_engine.stop()
+        recorder.stop()
         if debug is not None:
             await debug.stop()
         if federation is not None:
@@ -208,6 +225,9 @@ def main() -> None:
     ap.add_argument("--evaluator", default=cfg.evaluator,
                     help='"base", "ml", or "plugin:pkg.mod:attr"')
     ap.add_argument("--manager", default=cfg.manager, help="manager address host:port")
+    ap.add_argument("--keepalive-interval", type=float, default=None,
+                    help="seconds between manager keepalives (stats frames "
+                         "ride this tick; default 20)")
     ap.add_argument("--trainer", default=cfg.trainer, help="trainer address host:port")
     ap.add_argument("--model-watch-interval", type=float, default=None,
                     help="seconds between active-model registry polls (default 60)")
@@ -248,6 +268,7 @@ def main() -> None:
             metrics_port=args.metrics_port,
             gc_interval=cfg.gc.interval,
             manager_addr=args.manager,
+            keepalive_interval=args.keepalive_interval,
             trainer_addr=args.trainer,
             trainer_interval=args.trainer_interval,
             model_watch_interval=args.model_watch_interval,

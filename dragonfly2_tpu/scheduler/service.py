@@ -103,6 +103,11 @@ class SchedulerService:
 
         self.pool = ResourcePool(gc_policy)
         self.evaluator = evaluator or new_evaluator("base")
+        # registry-scoped serving-health counters (ISSUE 12): rollout health
+        # baselines window THESE, so N services in one process never share a
+        # baseline; the process-global families keep serving /metrics
+        self.local_metrics = metrics.ServiceMetrics()
+        self.evaluator.local_metrics = self.local_metrics
         self.scheduling = Scheduling(self.evaluator, scheduling_config)
         # Scheduler state lock (see Scheduling.state_lock): every mutator
         # below holds it around its mutating block so the round dispatcher's
@@ -251,7 +256,8 @@ class SchedulerService:
         # NORMAL (or SMALL fallback): full scheduling round
         ensure_received()
         with default_tracer().span("scheduler.schedule", task_id=task.id, peer_id=peer.id), \
-                metrics.SCHEDULE_DURATION.time():
+                metrics.SCHEDULE_DURATION.time(), \
+                self.local_metrics.schedule_duration.time():
             outcome = await self.scheduling.schedule_candidate_parents(peer)
         if outcome.back_to_source:
             metrics.BACK_TO_SOURCE_TOTAL.inc()
@@ -454,7 +460,8 @@ class SchedulerService:
             raise KeyError(peer_id)
         task = peer.task
         with default_tracer().span("scheduler.reschedule", task_id=task.id, peer_id=peer.id), \
-                metrics.SCHEDULE_DURATION.time():
+                metrics.SCHEDULE_DURATION.time(), \
+                self.local_metrics.schedule_duration.time():
             outcome = await self.scheduling.schedule_candidate_parents(peer, blocklist=peer.block_parents)
         if outcome.back_to_source:
             metrics.BACK_TO_SOURCE_TOTAL.inc()

@@ -29,6 +29,7 @@ async def run_trainer(
     mlp_steps: int | None = None,
     min_pairs: int | None = None,
     min_probe_rows: int | None = None,
+    stats_interval: float = 20.0,
     ready_event: asyncio.Event | None = None,
 ) -> None:
     import dataclasses
@@ -57,10 +58,41 @@ async def run_trainer(
     register_trainer(server, service)
     await server.start()
     logger.info("trainer listening on %s", server.address)
+    # cluster metrics plane (ISSUE 12): the trainer is a member of the
+    # cluster view too — its frame (loop lag + whatever trainer families
+    # exist) rides a keepalive tick like every other service
+    from dragonfly2_tpu.observability.timeseries import (
+        build_stats_frame,
+        default_recorder,
+    )
+
+    recorder = default_recorder()
+    recorder.start()
+    stats_task = None
+    if manager is not None:
+        import socket as _socket
+
+        trainer_host = _socket.gethostname()
+
+        async def stats_loop() -> None:
+            while True:
+                await asyncio.sleep(stats_interval)
+                try:
+                    frame = build_stats_frame(
+                        recorder, service="trainer", hostname=trainer_host
+                    )
+                    await manager.keepalive("trainer", trainer_host, stats=frame)
+                except Exception:
+                    logger.debug("stats frame push failed", exc_info=True)
+
+        stats_task = asyncio.ensure_future(stats_loop())
     print(f"TRAINER_READY {server.address}", flush=True)
     try:
         await run_until_signalled(ready_event)
     finally:
+        recorder.stop()
+        if stats_task is not None:
+            stats_task.cancel()
         await server.stop()
         if manager is not None:
             await manager.close()

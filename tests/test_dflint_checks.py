@@ -889,6 +889,97 @@ def test_df033_suppression_with_reason():
 
 
 # ---------------------------------------------------------------------------
+# DF028 dead metric family (cross-file: run_sources, not lint_source)
+
+
+def xids(sources: dict[str, str]) -> list[str]:
+    return sorted(
+        {v.check for v in dflint.run_sources(
+            {p: textwrap.dedent(s) for p, s in sources.items()}
+        )}
+    )
+
+
+_DECL = """
+from dragonfly2_tpu.observability.metrics import default_registry
+
+_r = default_registry()
+DEAD_TOTAL = _r.counter("dead_total", "never moved")
+LIVE_TOTAL = _r.counter("live_total", "moved below")
+LIVE_TOTAL.inc()
+"""
+
+
+def test_df028_fires_on_module_scope_family_never_touched():
+    assert xids({"dragonfly2_tpu/x/metrics.py": _DECL}) == ["DF028"]
+    vs = dflint.run_sources({"m.py": textwrap.dedent(_DECL)})
+    assert len(vs) == 1 and "DEAD_TOTAL" in vs[0].message
+
+
+def test_df028_cleared_by_touch_in_another_file():
+    user = """
+    from dragonfly2_tpu.x import metrics
+
+    def f():
+        metrics.DEAD_TOTAL.inc()
+    """
+    assert xids({"dragonfly2_tpu/x/metrics.py": _DECL, "dragonfly2_tpu/x/user.py": user}) == []
+
+
+def test_df028_cleared_by_labels_and_by_helper_argument():
+    labels_user = """
+    import metrics
+    metrics.DEAD_TOTAL.labels(kind="a").inc()
+    """
+    assert xids({"m.py": _DECL, "u.py": labels_user}) == []
+    # a family passed bare into a helper (the test-suite idiom
+    # `_metric(sched_metrics.X, ...)`) counts as touched
+    arg_user = """
+    import metrics
+    def probe(m):
+        return m.labels().value
+    probe(metrics.DEAD_TOTAL)
+    """
+    assert xids({"m.py": _DECL, "u.py": arg_user}) == []
+
+
+def test_df028_direct_ctor_fires_but_collections_counter_does_not():
+    src = """
+    from dragonfly2_tpu.observability.metrics import Counter
+    from collections import Counter as CCounter
+
+    ORPHAN = Counter("orphan_total", "never moved", ())
+    WORDS = CCounter()
+    WORDS.update("abc")
+    """
+    vs = dflint.run_sources({"m.py": textwrap.dedent(src)})
+    assert [v.check for v in vs] == ["DF028"]
+    assert "ORPHAN" in vs[0].message
+
+
+def test_df028_ignores_instance_scope_and_honors_suppression():
+    inst = """
+    from dragonfly2_tpu.observability.metrics import default_registry
+
+    class M:
+        def __init__(self):
+            self.h = default_registry().histogram("h_seconds")
+    """
+    assert xids({"m.py": inst}) == []
+    sup = _DECL.replace(
+        'DEAD_TOTAL = _r.counter("dead_total", "never moved")',
+        'DEAD_TOTAL = _r.counter("dead_total", "x")  # dflint: disable=DF028 exported for plugins',
+    )
+    assert xids({"m.py": sup}) == []
+
+
+def test_df028_not_run_per_file():
+    # lint_source is the per-file API; the cross-file pass must not fire
+    # there (a lone metrics.py would false-positive on every family)
+    assert "DF028" not in ids(_DECL)
+
+
+# ---------------------------------------------------------------------------
 # suppression handling
 
 

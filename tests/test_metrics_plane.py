@@ -1,0 +1,510 @@
+"""Cluster metrics plane (ISSUE 12): timeseries recorder, SLO alerts,
+manager-wide aggregation, and the dftop dashboard.
+
+Everything here is in-process and clock-driven (explicit `now=` timestamps,
+no sleeps): tier-1 wall-clock is a first-class budget. The subprocess path
+is covered once by tools/check.sh's metrics-smoke leg.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from dragonfly2_tpu.manager.server import ManagerServer
+from dragonfly2_tpu.manager.service import ManagerService
+from dragonfly2_tpu.observability.alerts import AlertEngine, AlertRule, default_rules
+from dragonfly2_tpu.observability.metrics import MetricsRegistry
+from dragonfly2_tpu.observability.timeseries import (
+    MetricsRecorder,
+    build_stats_frame,
+)
+from dragonfly2_tpu.rpc.manager import RemoteManagerClient
+
+
+def make_registry():
+    # same family names the production modules register (the registry
+    # prefixes its namespace, so these render dragonfly_scheduler_*)
+    reg = MetricsRegistry()
+    c = reg.counter("ml_base_fallback_total", subsystem="scheduler", labels=("reason",))
+    h = reg.histogram(
+        "schedule_duration_seconds", subsystem="scheduler",
+        buckets=(0.001, 0.01, 0.1, 1.0),
+    )
+    g = reg.gauge("peers", subsystem="scheduler")
+    return reg, c, h, g
+
+
+# ---------------------------------------------------------------------------
+# recorder: rings, rates, windowed quantiles, bounds
+# ---------------------------------------------------------------------------
+
+
+class TestRecorder:
+    def test_counter_delta_becomes_rate(self):
+        reg, c, _h, _g = make_registry()
+        rec = MetricsRecorder(reg, interval=2.0, retention_s=60.0)
+        t0 = 1000.0
+        for i in range(6):
+            c.inc(10.0, reason="no_scorer")
+            rec.sample_once(now=t0 + i * 2.0)
+        # 5 intervals x 10 increments over 10 s = 5/s
+        assert rec.rate(
+            "dragonfly_scheduler_ml_base_fallback_total",
+            window_s=60.0, now=t0 + 10.0,
+        ) == pytest.approx(5.0)
+        # label-filtered rate sees only its child
+        assert rec.rate(
+            "dragonfly_scheduler_ml_base_fallback_total", {"reason": "scorer_error"},
+            window_s=60.0, now=t0 + 10.0,
+        ) is None  # that child never appeared
+
+    def test_counter_reset_never_yields_negative_rate(self):
+        reg, c, _h, _g = make_registry()
+        rec = MetricsRecorder(reg, interval=2.0)
+        child = c.labels(reason="no_scorer")
+        child.inc(100.0)
+        rec.sample_once(now=0.0)
+        child.value = 0.0  # in-process service restart resets the family
+        child.inc(10.0)
+        rec.sample_once(now=2.0)
+        child.inc(10.0)
+        rec.sample_once(now=4.0)
+        r = rec.rate(
+            "dragonfly_scheduler_ml_base_fallback_total", window_s=60.0, now=4.0
+        )
+        # the reset interval contributes 0 (clamped), the live one 10/2s
+        assert r == pytest.approx(10.0 / 4.0)
+
+    def test_histogram_windowed_quantiles_move_with_traffic(self):
+        reg, _c, h, _g = make_registry()
+        rec = MetricsRecorder(reg, interval=2.0)
+        for _ in range(100):
+            h.observe(0.005)  # old traffic: fast rounds
+        rec.sample_once(now=0.0)
+        rec.sample_once(now=2.0)
+        hw_old = rec.hist_window(
+            "dragonfly_scheduler_schedule_duration_seconds", window_s=10.0, now=2.0
+        )
+        assert hw_old["count"] == 0  # nothing moved inside the window
+        for _ in range(100):
+            h.observe(0.5)  # the incident: slow rounds
+        rec.sample_once(now=4.0)
+        hw = rec.hist_window(
+            "dragonfly_scheduler_schedule_duration_seconds", window_s=10.0, now=4.0
+        )
+        assert hw["count"] == 100
+        # windowed p95 reflects ONLY the incident traffic — the lifetime
+        # histogram (200 obs, half fast) would put p95 in a lower bucket
+        assert 0.1 < hw["p95"] <= 1.0
+        assert hw["rate_per_s"] == pytest.approx(100 / 4.0)
+        assert hw["mean"] == pytest.approx(0.5)
+
+    def test_hist_window_quantiles_across_cumulative_buckets(self):
+        """Regression: Histogram bucket counts are CUMULATIVE-le (observe
+        increments every covering bucket) — hist_window must difference
+        them into disjoint masses before the quantile walk, or a window
+        spanning buckets deflates p95 (50 fast + 50 slow obs read ~0.09
+        instead of ~0.9, and the loop-lag SLO alert stays silent)."""
+        reg, _c, h, _g = make_registry()  # buckets (0.001, 0.01, 0.1, 1.0)
+        rec = MetricsRecorder(reg, interval=2.0)
+        h.observe(0.005)
+        rec.sample_once(now=0.0)
+        for _ in range(50):
+            h.observe(0.005)  # lands in le=0.01 AND every higher bucket
+        for _ in range(50):
+            h.observe(0.5)    # lands in le=1.0 only
+        rec.sample_once(now=2.0)
+        hw = rec.hist_window(
+            "dragonfly_scheduler_schedule_duration_seconds",
+            window_s=10.0, now=2.0, q=0.99,
+        )
+        assert hw["count"] == 100
+        # p50 sits in the fast bucket, p95/p99 in the slow one
+        assert hw["p50"] <= 0.01 + 1e-9
+        assert 0.1 < hw["p95"] <= 1.0
+        assert 0.1 < hw["pq"] <= 1.0 and hw["pq"] >= hw["p95"]
+
+    def test_gauge_latest_and_retention_bound(self):
+        reg, _c, _h, g = make_registry()
+        rec = MetricsRecorder(reg, interval=1.0, retention_s=5.0)
+        for i in range(50):
+            g.set(float(i))
+            rec.sample_once(now=float(i))
+        assert rec.latest("dragonfly_scheduler_peers") == 49.0
+        series = rec.query("dragonfly_scheduler_peers")[0]
+        # hard ring bound: retention/interval + 1
+        assert len(series["points"]) == 6
+
+    def test_max_series_cap_counts_drops(self):
+        reg = MetricsRegistry()
+        fam = reg.counter("dragonfly_x_total", labels=("k",))
+        rec = MetricsRecorder(reg, interval=1.0, max_series=3)
+        for i in range(10):
+            fam.inc(k=f"v{i}")
+        rec.sample_once(now=0.0)
+        st = rec.stats()
+        assert st["series"] == 3
+        assert rec.dropped_series == 7
+        # the cap holds across ticks AND the drop count stays DISTINCT
+        # series, not refusals-per-tick (re-sampling the same 7 over-cap
+        # label sets must not read as a growing cardinality explosion)
+        for t in range(1, 5):
+            rec.sample_once(now=float(t))
+        assert rec.stats()["series"] == 3
+        assert rec.dropped_series == 7
+        assert rec.stats()["dropped_overflow"] is False
+
+    def test_absent_metric_answers_none_not_zero(self):
+        rec = MetricsRecorder(MetricsRegistry())
+        rec.sample_once(now=0.0)
+        assert rec.rate("dragonfly_nope_total", now=0.0) is None
+        assert rec.latest("dragonfly_nope_total") is None
+        assert rec.hist_window("dragonfly_nope_seconds", now=0.0) is None
+
+
+# ---------------------------------------------------------------------------
+# stats frame
+# ---------------------------------------------------------------------------
+
+
+class TestStatsFrame:
+    def test_frame_carries_windowed_rates_and_only_present_families(self):
+        import time as _time
+
+        reg, c, h, _g = make_registry()
+        rec = MetricsRecorder(reg, interval=2.0)
+        # build_stats_frame windows against wall-clock now, so the synthetic
+        # samples must sit just behind it
+        t0 = _time.time() - 6.0
+        for i in range(4):
+            for _ in range(20):
+                h.observe(0.01)
+            c.inc(2.0, reason="scorer_error")
+            rec.sample_once(now=t0 + i * 2.0)
+        frame = build_stats_frame(rec, service="scheduler", hostname="s1")
+        assert frame["service"] == "scheduler" and frame["hostname"] == "s1"
+        r = frame["rates"]
+        assert r["rounds_per_s"] == pytest.approx(10.0, rel=0.01)
+        assert r["scorer_errors_per_s"] == pytest.approx(1.0, rel=0.01)
+        # daemon families absent from this registry → keys absent, not 0.0
+        assert "piece_down_mb_per_s" not in r
+        assert "loop_lag_p95_ms" not in r
+
+    def test_frame_resolves_one_hot_serving_mode_and_is_compact_json(self):
+        reg = MetricsRegistry()
+        mode = reg.gauge("ml_serving_mode", subsystem="scheduler", labels=("mode",))
+        for m in ("native", "jax", "base"):
+            mode.set(1.0 if m == "native" else 0.0, mode=m)
+        rec = MetricsRecorder(reg)
+        rec.sample_once(now=0.0)
+        frame = build_stats_frame(rec, service="scheduler")
+        assert frame["serving_mode"] == "native"
+        encoded = json.dumps(frame)
+        assert len(encoded) < 4096  # compact: rides every keepalive
+
+    def test_frame_carries_active_alerts(self):
+        import time as _time
+
+        reg, c, h, _g = make_registry()
+        rec = MetricsRecorder(reg, interval=2.0)
+        rule = AlertRule(
+            name="burst", kind="rate",
+            metric="dragonfly_scheduler_ml_base_fallback_total",
+            bound=0.5, window_s=30.0,
+        )
+        eng = AlertEngine(rec, [rule])
+        t0 = _time.time() - 4.0
+        for i in range(3):
+            c.inc(10.0, reason="no_scorer")
+            rec.sample_once(now=t0 + i * 2.0)
+        eng.evaluate_once(now=t0 + 4.0)
+        frame = build_stats_frame(rec, service="scheduler", alerts=eng)
+        assert frame["alerts"] == ["burst"]
+
+
+# ---------------------------------------------------------------------------
+# alert engine
+# ---------------------------------------------------------------------------
+
+
+class TestAlerts:
+    def _recorder_with_errors(self, error_per_round: float, rounds_per_tick: int = 20):
+        reg, c, h, _g = make_registry()
+        rec = MetricsRecorder(reg, interval=2.0)
+        for i in range(4):
+            for _ in range(rounds_per_tick):
+                h.observe(0.01)
+            c.inc(rounds_per_tick * error_per_round, reason="scorer_error")
+            rec.sample_once(now=i * 2.0)
+        return rec
+
+    def test_ratio_rule_flips_within_one_evaluation(self):
+        rec = self._recorder_with_errors(0.5)
+        rule = AlertRule(
+            name="scorer_error_rate", kind="ratio",
+            metric="dragonfly_scheduler_ml_base_fallback_total",
+            labels={"reason": "scorer_error"},
+            denom="dragonfly_scheduler_schedule_duration_seconds",
+            bound=0.05, window_s=30.0,
+        )
+        eng = AlertEngine(rec, [rule])
+        assert eng.evaluate_once(now=6.0) == ["scorer_error_rate"]
+        active = eng.active()[0]
+        assert active["value"] == pytest.approx(0.5, rel=0.01)
+        from dragonfly2_tpu.observability.alerts import ALERT_ACTIVE
+
+        assert float(ALERT_ACTIVE.labels(name="scorer_error_rate").value) == 1.0
+
+    def test_ratio_guard_no_traffic_no_alert(self):
+        reg, c, _h, _g = make_registry()
+        rec = MetricsRecorder(reg, interval=2.0)
+        # errors exist but ZERO rounds: the denominator guard must hold
+        for i in range(3):
+            c.inc(5.0, reason="scorer_error")
+            rec.sample_once(now=i * 2.0)
+        rule = AlertRule(
+            name="scorer_error_rate", kind="ratio",
+            metric="dragonfly_scheduler_ml_base_fallback_total",
+            labels={"reason": "scorer_error"},
+            denom="dragonfly_scheduler_schedule_duration_seconds",
+            bound=0.05, window_s=30.0,
+        )
+        eng = AlertEngine(rec, [rule])
+        assert eng.evaluate_once(now=4.0) == []
+
+    def test_for_duration_must_persist_and_alert_clears(self):
+        rec = self._recorder_with_errors(1.0)
+        rule = AlertRule(
+            name="err", kind="ratio",
+            metric="dragonfly_scheduler_ml_base_fallback_total",
+            labels={"reason": "scorer_error"},
+            denom="dragonfly_scheduler_schedule_duration_seconds",
+            bound=0.05, window_s=30.0, for_s=5.0,
+        )
+        eng = AlertEngine(rec, [rule])
+        assert eng.evaluate_once(now=6.0) == []      # breached, not yet for_s
+        assert eng.evaluate_once(now=12.0) == ["err"]  # persisted past for_s
+        # recovery: a quiet window clears the alert and the gauge
+        quiet = self._recorder_with_errors(0.0)
+        eng.recorder = quiet
+        assert eng.evaluate_once(now=6.0) == []
+        from dragonfly2_tpu.observability.alerts import ALERT_ACTIVE
+
+        assert float(ALERT_ACTIVE.labels(name="err").value) == 0.0
+
+    def test_quantile_rule_on_loop_lag(self):
+        reg = MetricsRegistry()
+        lag = reg.histogram(
+            "lag_seconds", subsystem="loop", buckets=(0.001, 0.01, 0.1, 1.0, 5.0)
+        )
+        rec = MetricsRecorder(reg, interval=2.0)
+        lag.observe(0.0005)  # healthy tick creates the series
+        rec.sample_once(now=0.0)
+        for _ in range(100):
+            lag.observe(0.9)  # a badly stalled loop
+        rec.sample_once(now=2.0)
+        rule = AlertRule(
+            name="loop_lag_p95", kind="quantile", q=0.95,
+            metric="dragonfly_loop_lag_seconds", bound=0.25, window_s=30.0,
+        )
+        eng = AlertEngine(rec, [rule])
+        assert eng.evaluate_once(now=2.0) == ["loop_lag_p95"]
+
+    def test_default_rules_inactive_on_empty_recorder(self):
+        rec = MetricsRecorder(MetricsRegistry())
+        rec.sample_once(now=0.0)
+        eng = AlertEngine(rec)
+        assert eng.evaluate_once(now=0.0) == []
+        names = {r["name"] for r in eng.status()["rules"]}
+        assert {
+            "loop_lag_p95", "scorer_error_rate", "base_fallback_rate",
+            "piece_failure_ratio", "federation_sync_failures",
+        } <= names
+
+    def test_default_rules_are_fully_declarative(self):
+        for rule in default_rules():
+            assert rule.kind in ("rate", "ratio", "quantile", "value")
+            assert rule.metric.startswith("dragonfly_")
+
+
+# ---------------------------------------------------------------------------
+# manager aggregation
+# ---------------------------------------------------------------------------
+
+
+def _frame(service: str, host: str, **rates) -> dict:
+    return {"service": service, "hostname": host, "ts": 0.0, "window_s": 60.0,
+            "rates": {k: float(v) for k, v in rates.items()}}
+
+
+class TestClusterStats:
+    def test_keepalive_stats_land_in_member_ring_and_rollup(self):
+        svc = ManagerService(keepalive_ttl=60.0)
+        svc.update_scheduler("s1", "127.0.0.1", 9000)
+        assert svc.keepalive(
+            "scheduler", "s1", stats=_frame("scheduler", "s1", rounds_per_s=10.0)
+        )
+        # daemons/trainer have no registry table; keepalive is stats-only
+        assert svc.keepalive(
+            "daemon", "d1", stats=_frame("daemon", "d1", piece_down_mb_per_s=5.0)
+        )
+        assert svc.keepalive(
+            "daemon", "d2", stats=_frame("daemon", "d2", piece_down_mb_per_s=7.0)
+        )
+        out = svc.cluster_stats()
+        assert len(out["members"]) == 3
+        assert out["cluster"]["members_live"] == 3
+        assert out["cluster"]["rates"]["rounds_per_s"] == 10.0
+        assert out["cluster"]["rates"]["piece_down_mb_per_s"] == 12.0
+
+    def test_frameless_keepalive_of_unknown_type_is_false(self):
+        svc = ManagerService()
+        assert svc.keepalive("daemon", "d1") is False  # nothing recorded
+        assert svc.cluster_stats()["members"] == []
+
+    def test_stale_member_excluded_from_rollups_but_visible(self, monkeypatch):
+        import time as _time
+
+        svc = ManagerService(keepalive_ttl=10.0)
+        svc.report_stats("daemon", "d1", _frame("daemon", "d1", piece_up_mb_per_s=3.0))
+        svc.report_stats("daemon", "d2", _frame("daemon", "d2", piece_up_mb_per_s=4.0))
+        # d1 goes dark: past 2x TTL (stale) but inside the eviction horizon
+        svc._member_stats[("daemon", "d1")]["last_seen"] = _time.time() - 50.0
+        out = svc.cluster_stats()
+        stale = [m for m in out["members"] if m["stale"]]
+        assert [m["hostname"] for m in stale] == ["d1"]
+        assert out["cluster"]["members_live"] == 1
+        assert out["cluster"]["rates"]["piece_up_mb_per_s"] == 4.0
+        # past the eviction horizon (10x TTL) the churned hostname is
+        # dropped entirely — _member_stats must not grow forever
+        svc._member_stats[("daemon", "d1")]["last_seen"] = _time.time() - 150.0
+        out = svc.cluster_stats()
+        assert [m["hostname"] for m in out["members"]] == ["d2"]
+        assert ("daemon", "d1") not in svc._member_stats
+        # the write path evicts too (a manager nobody queries stays bounded)
+        svc._member_stats[("daemon", "d2")]["last_seen"] = _time.time() - 150.0
+        svc.report_stats("daemon", "d3", _frame("daemon", "d3"))
+        assert set(svc._member_stats) == {("daemon", "d3")}
+
+    def test_member_ring_is_bounded_and_alerts_attributed(self):
+        from dragonfly2_tpu.manager.service import STATS_FRAMES_KEPT
+
+        svc = ManagerService()
+        for i in range(STATS_FRAMES_KEPT + 50):
+            f = _frame("scheduler", "s1", rounds_per_s=float(i))
+            if i % 2:
+                f["alerts"] = ["base_fallback_rate"]
+            svc.report_stats("scheduler", "s1", f)
+        entry = svc._member_stats[("scheduler", "s1")]
+        assert len(entry["frames"]) == STATS_FRAMES_KEPT
+        out = svc.cluster_stats(history=5)
+        m = out["members"][0]
+        assert len(m["history"]) == 5
+        assert out["cluster"]["alerts"] == [
+            {"name": "base_fallback_rate", "member": "s1", "source_type": "scheduler"}
+        ]
+
+    def test_cluster_stats_rpc_and_rest_mirror(self, run, tmp_path):
+        async def body():
+            server = ManagerServer(db_path=str(tmp_path / "m.db"))
+            await server.start()
+            try:
+                mc = RemoteManagerClient(server.address)
+                await mc.update_scheduler("s1", "127.0.0.1", 9000)
+                await mc.keepalive(
+                    "scheduler", "s1",
+                    stats=_frame("scheduler", "s1", rounds_per_s=2.5),
+                )
+                await mc.report_stats(
+                    "daemon", "d1", _frame("daemon", "d1", piece_down_mb_per_s=1.0)
+                )
+                out = await mc.cluster_stats()
+                assert {m["hostname"] for m in out["members"]} == {"s1", "d1"}
+                assert out["cluster"]["rates"]["rounds_per_s"] == 2.5
+                import aiohttp
+
+                async with aiohttp.ClientSession() as sess:
+                    base = f"http://127.0.0.1:{server.rest_port}"
+                    async with sess.get(f"{base}/api/v1/cluster/stats") as r:
+                        assert r.status == 200
+                        mirrored = await r.json()
+                assert {m["hostname"] for m in mirrored["members"]} == {"s1", "d1"}
+                await mc.close()
+            finally:
+                await server.stop()
+
+        run(body())
+
+
+# ---------------------------------------------------------------------------
+# dftop
+# ---------------------------------------------------------------------------
+
+
+class TestDftop:
+    def _stats(self) -> dict:
+        return {
+            "ts": 0.0,
+            "members": [
+                {"source_type": "scheduler", "hostname": "sched-0", "age_s": 2.0,
+                 "stale": False,
+                 "frame": {"rates": {"rounds_per_s": 12.5, "round_p95_ms": 3.1},
+                           "serving_mode": "native", "alerts": ["loop_lag_p95"]}},
+                {"source_type": "daemon", "hostname": "box-daemon-0", "age_s": 90.0,
+                 "stale": True,
+                 "frame": {"rates": {"piece_down_mb_per_s": 44.0}}},
+            ],
+            "cluster": {"members_live": 1, "members_stale": 1,
+                        "rates": {"rounds_per_s": 12.5},
+                        "alerts": [{"name": "loop_lag_p95", "member": "sched-0",
+                                    "source_type": "scheduler"}]},
+        }
+
+    def test_render_shows_members_rates_and_alerts(self):
+        from dragonfly2_tpu.cli import dftop
+
+        text = dftop.render(self._stats())
+        assert "sched-0" in text and "12.50" in text and "native" in text
+        assert "box-daemon-0 (stale)" in text and "44.00" in text
+        assert "loop_lag_p95@sched-0" in text
+
+    def test_members_healthy_contract(self):
+        from dragonfly2_tpu.cli import dftop
+
+        stats = self._stats()
+        assert dftop.members_healthy(stats)  # stale member doesn't count
+        stats["members"][0]["frame"] = {}    # live member without rates
+        assert not dftop.members_healthy(stats)
+        assert not dftop.members_healthy({"members": []})
+
+    def test_dftop_once_json_against_live_manager(self, run, tmp_path, capsys):
+        # run the CLI against a live manager inside one loop: boot, push a
+        # frame, and call main() on a worker thread (dfmodel-test idiom)
+        async def full():
+            server = ManagerServer(db_path=str(tmp_path / "m2.db"))
+            await server.start()
+            try:
+                mc = RemoteManagerClient(server.address)
+                await mc.keepalive(
+                    "daemon", "d1", stats=_frame("daemon", "d1", tasks_per_s=1.0)
+                )
+                await mc.close()
+                import asyncio
+
+                from dragonfly2_tpu.cli import dftop
+
+                rc = await asyncio.to_thread(
+                    dftop.main, ["--manager", server.address, "--once", "--json"]
+                )
+                return rc
+            finally:
+                await server.stop()
+
+        rc = run(full())
+        assert rc == 0
+        out = capsys.readouterr().out
+        doc = json.loads(out)
+        assert doc["members"][0]["hostname"] == "d1"
+        assert doc["members"][0]["frame"]["rates"]["tasks_per_s"] == 1.0

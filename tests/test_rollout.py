@@ -157,6 +157,61 @@ class TestDivergence:
         assert b.quiesced and b.close() and scorer.closed
         assert b.close()  # idempotent
 
+    def test_worst_round_slicing_min_overlap_and_p99(self):
+        """ISSUE 12 satellite: a candidate fine on average but catastrophic
+        on 1% of rounds must be VISIBLE — the tracker carries the single
+        worst top-k overlap and a per-round delta p99 from the histogram."""
+        t = R.ShadowTracker("v1", topk=3)
+        agree = np.array([0.9, 0.5, 0.7, 0.1])
+        for _ in range(98):
+            t.record(agree, agree + 0.001)  # near-identical rounds
+        # TWO catastrophic rounds (2%): reordered top-k, huge delta
+        for _ in range(2):
+            t.record(np.array([4.0, 3.0, 2.0, 1.0]), np.array([1.0, 2.0, 3.0, 104.0]))
+        snap = t.snapshot()
+        assert snap["topk_overlap_mean"] > 0.95  # the mean hides it
+        assert snap["topk_overlap_min"] == pytest.approx(2.0 / 3.0)
+        # p99 lands at the top of the histogram (the bad rounds' delta is
+        # past the last bucket; the mean stays near the noise floor)
+        assert snap["abs_delta_p99"] == R.DELTA_BUCKETS[-1]
+        assert snap["abs_delta_mean"] < 1.0
+
+    def test_merge_reports_carries_worst_round_slicing(self):
+        a = {"rounds": 10, "topk_overlap_min": 0.75,
+             "delta_hist": {"buckets": list(R.DELTA_BUCKETS),
+                            "counts": [10] + [0] * len(R.DELTA_BUCKETS)}}
+        b = {"rounds": 10, "topk_overlap_min": 0.25,
+             "delta_hist": {"buckets": list(R.DELTA_BUCKETS),
+                            "counts": [0] * len(R.DELTA_BUCKETS) + [10]}}
+        m = R.merge_reports([a, b])
+        assert m["topk_overlap_min"] == 0.25  # cluster-wide worst round
+        # merged histogram: half noise-floor, half overflow → p99 at the top
+        assert m["abs_delta_p99"] == R.DELTA_BUCKETS[-1]
+        # a member that predates the key (rolling upgrade) doesn't poison it
+        m2 = R.merge_reports([a, {"rounds": 5}])
+        assert m2["topk_overlap_min"] == 0.75
+
+    def test_health_sample_is_registry_scoped_per_service(self):
+        """ISSUE 12 satellite (ROADMAP #4 follow-up): two SchedulerServices
+        in ONE process must not share health baselines — rounds and
+        fallbacks on service A are invisible to B's HealthSample window."""
+        svc_a = SchedulerService(evaluator=new_evaluator("ml"))
+        svc_b = SchedulerService(evaluator=new_evaluator("ml"))
+        before_b = R.HealthSample.capture(svc_b.local_metrics)
+        # traffic on A only: rounds + fallbacks through the real sites
+        with svc_a.local_metrics.schedule_duration.time():
+            pass
+        svc_a.evaluator._count_fallback("scorer_error")
+        svc_a.evaluator._count_fallback("no_scorer")
+        after_a = R.HealthSample.capture(svc_a.local_metrics)
+        after_b = R.HealthSample.capture(svc_b.local_metrics)
+        assert after_a.rounds == 1 and after_a.fallbacks == 2 and after_a.errors == 1
+        assert (after_b.rounds, after_b.fallbacks, after_b.errors) == (
+            before_b.rounds, before_b.fallbacks, before_b.errors,
+        )
+        # while the process-global families moved for BOTH services' traffic
+        assert R.HealthSample.capture().fallbacks >= after_a.fallbacks
+
 
 # ---------------------------------------------------------------------------
 # evaluator: shadow slot + read-once serving bundle
@@ -674,8 +729,10 @@ def test_health_regression_auto_rolls_back_to_warm_previous(run, tmp_path, monke
             assert h.svc.evaluator.serving_version == "v1"
             # rollback re-anchored the health baseline window: the next
             # swap's baseline starts at the rollback, not inside v2's
-            # regression window
-            post_rb = R.HealthSample.capture()
+            # regression window. Captured from the SERVICE's registry-scoped
+            # counters (ISSUE 12): the link windows h.svc.local_metrics, so
+            # other services' traffic in this process is invisible here.
+            post_rb = R.HealthSample.capture(h.svc.local_metrics)
             assert h.link._last_swap_sample.rounds >= post_rb.rounds - 8
 
     run(body())

@@ -114,6 +114,13 @@ run_stage "concurrency-smoke" env JAX_PLATFORMS=cpu python -m pytest tests/test_
 run_stage "federation-smoke" env JAX_PLATFORMS=cpu python -m dragonfly2_tpu.cli.dfcluster \
     demo --payload-kb 6144 --verify-trace
 
+# metrics-smoke: the cluster metrics plane against the live box — boots
+# manager + 2 ml schedulers + 2 daemons, real dfget traffic, asserts
+# `dftop --once --json` shows every member with live windowed rates, then
+# that the induced base-fallback burst (ml evaluator, no model) raises its
+# SLO alert through recorder → rule engine → stats frame → manager → dftop.
+run_stage "metrics-smoke" env JAX_PLATFORMS=cpu python tools/metrics_smoke.py
+
 # rollout-smoke: the live-model safe-rollout loop against real seams —
 # publish a digest-verified candidate into the manager registry, shadow N
 # live scheduling rounds on an ml scheduler (divergence window reported +

@@ -43,6 +43,14 @@ Checks (see README.md "Static analysis" for the catalog):
          ~100µs+ and unbounded churn; bind workers to WORK (a long-lived
          pool owned by the object, built in __init__), not to items (the
          PieceReportBuffer timer-task and PR 3 per-pump-thread lessons)
+  DF028  a module-scope metric family (registry.counter/gauge/histogram or a
+         direct observability.metrics constructor) whose name is never
+         touched by .inc/.dec/.set/.observe/.labels/.time — nor passed to
+         any call — anywhere in the linted tree: a declared-but-never-
+         incremented family renders as a frozen 0 forever, which dashboards
+         and alert rules read as "healthy" (the PR 11 heartbeat bug class).
+         This is dflint's first CROSS-FILE check: declarations in one module
+         are cleared by touches in any other.
   DF031  silent exception swallow: bare/overbroad except whose body is only
          pass/continue/... (no log, no narrowing)
   DF032  mutable default argument (list/dict/set literal or constructor)
@@ -85,6 +93,7 @@ CHECKS: dict[str, str] = {
     "DF025": "awaited per-item RPC call inside a loop outside rpc/ (batch it)",
     "DF026": "Thread/ThreadPoolExecutor constructed on a hot path (pool churn)",
     "DF027": "Tracer.span(...) not used as a `with` context manager (leaked span)",
+    "DF028": "module-scope metric family never incremented/observed anywhere (dead metric)",
     "DF031": "bare/overbroad except silently swallowing the error",
     "DF032": "mutable default argument",
     "DF033": "per-row numpy array construction inside a for loop (vectorize)",
@@ -1019,6 +1028,108 @@ def check_mutable_defaults(tree: ast.Module, path: str) -> Iterator[Violation]:
                 )
 
 
+# ---------------------------------------------------------------------------
+# DF028: dead metric families (cross-file)
+
+# Mutating/labeling touches that prove a family is live. Reads (.value,
+# .render) deliberately do NOT count — the bug class is a family that is
+# scraped (read) forever but never moved (PR 11 shipped exactly that
+# heartbeat shape).
+_METRIC_TOUCH = {"inc", "dec", "set", "observe", "labels", "time"}
+_METRIC_FACTORY_METHODS = {"counter", "gauge", "histogram"}
+_METRIC_CTORS = {
+    "dragonfly2_tpu.observability.metrics.Counter",
+    "dragonfly2_tpu.observability.metrics.Gauge",
+    "dragonfly2_tpu.observability.metrics.Histogram",
+}
+
+
+def _registryish(recv: ast.AST, aliases: dict[str, str]) -> bool:
+    """Heuristic for 'this receiver is a MetricsRegistry': a call to
+    default_registry()/MetricsRegistry(...), or a name whose last segment
+    mentions 'registry'/'reg' or is the conventional `_r`."""
+    if isinstance(recv, ast.Call):
+        name = _resolved_call_name(recv, aliases).rsplit(".", 1)[-1]
+        return name in {"default_registry", "MetricsRegistry"}
+    name = dotted(recv).rsplit(".", 1)[-1].lower()
+    return "registry" in name or name in {"_r", "reg", "r"}
+
+
+def metric_family_decls(tree: ast.Module, aliases: dict[str, str]) -> list[tuple[str, int, int]]:
+    """(name, line, col) for module-scope `NAME = registry.counter(...)` /
+    `NAME = Counter(...)` (observability.metrics constructors, resolved
+    through import aliases so collections.Counter never matches)."""
+    out: list[tuple[str, int, int]] = []
+    for stmt in tree.body:
+        if isinstance(stmt, ast.AnnAssign):
+            targets = [stmt.target] if stmt.target is not None else []
+            value = stmt.value
+        elif isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+            value = stmt.value
+        else:
+            continue
+        if value is None or not isinstance(value, ast.Call):
+            continue
+        if len(targets) != 1 or not isinstance(targets[0], ast.Name):
+            continue
+        func = value.func
+        is_family = (
+            isinstance(func, ast.Attribute)
+            and func.attr in _METRIC_FACTORY_METHODS
+            and _registryish(func.value, aliases)
+        ) or (_resolved_call_name(value, aliases) in _METRIC_CTORS)
+        if is_family:
+            out.append((targets[0].id, stmt.lineno, stmt.col_offset))
+    return out
+
+
+def metric_family_touches(tree: ast.Module) -> set[str]:
+    """Names that look metric-touched anywhere in this file: the receiver of
+    an .inc/.dec/.set/.observe/.labels/.time attribute (``metrics.X.inc``,
+    ``X.labels``), or a bare Name/Attribute passed as a call argument (test
+    helpers take the family itself: ``_metric(sched_metrics.X, ...)``)."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Attribute) and node.attr in _METRIC_TOUCH:
+            name = dotted(node.value).rsplit(".", 1)[-1]
+            if name:
+                out.add(name)
+        elif isinstance(node, ast.Call):
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(a, (ast.Name, ast.Attribute)):
+                    name = dotted(a).rsplit(".", 1)[-1]
+                    if name:
+                        out.add(name)
+    return out
+
+
+def check_unused_metric_families(
+    parsed: list[tuple[str, ast.Module]],
+) -> Iterator[Violation]:
+    """DF028 over the WHOLE run: a family declared at module scope in any
+    file, whose name no file touches, is dead. Matching is by bare name
+    (the same family is reached as `metrics.X`, `sched_metrics.X`, or a
+    from-import `X`), which over-approves same-named families across
+    modules — the safe direction for a linter."""
+    touches: set[str] = set()
+    decls: list[tuple[str, str, int, int]] = []
+    for path, tree in parsed:
+        aliases = import_aliases(tree)
+        for name, line, col in metric_family_decls(tree, aliases):
+            decls.append((path, name, line, col))
+        touches |= metric_family_touches(tree)
+    for path, name, line, col in decls:
+        if name not in touches:
+            yield Violation(
+                path, line, col, "DF028",
+                f"metric family {name!r} is declared but never touched by "
+                ".inc/.dec/.set/.observe/.labels/.time anywhere in the "
+                "linted tree — it renders as a frozen 0 dashboards read as "
+                "healthy; wire it up or delete it",
+            )
+
+
 ALL_CHECKS = (
     check_tracer_coercion,
     check_jnp_in_loop,
@@ -1041,26 +1152,38 @@ ALL_CHECKS = (
 # driver
 
 
-def lint_source(source: str, path: str = "<string>") -> list[Violation]:
-    """All violations for one file's source, suppressions applied."""
-    sup = Suppressions(source)
-    if sup.skip_file:  # full opt-out, including DF001 (fixture/vendored files)
-        return []
+def _per_file_violations(
+    tree: ast.Module, sup: Suppressions, path: str
+) -> list[Violation]:
+    """DF001 + every per-file check against an already-parsed tree."""
     out: list[Violation] = [
         Violation(path, line, 0, "DF001", f"unknown check id {check_id!r} in suppression")
         for line, check_id in sup.unknown
     ]
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        out.append(
-            Violation(path, e.lineno or 1, e.offset or 0, "DF002", f"syntax error: {e.msg}")
-        )
-        return out
     for check in ALL_CHECKS:
         for v in check(tree, path):
             if not sup.allows(v):
                 out.append(v)
+    return out
+
+
+def lint_source(source: str, path: str = "<string>") -> list[Violation]:
+    """All PER-FILE violations for one file's source, suppressions applied.
+    DF028 is cross-file (a family declared here may be incremented anywhere)
+    and only runs in run_sources()/the CLI driver."""
+    sup = Suppressions(source)
+    if sup.skip_file:  # full opt-out, including DF001 (fixture/vendored files)
+        return []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [
+            Violation(path, line, 0, "DF001", f"unknown check id {check_id!r} in suppression")
+            for line, check_id in sup.unknown
+        ] + [
+            Violation(path, e.lineno or 1, e.offset or 0, "DF002", f"syntax error: {e.msg}")
+        ]
+    out = _per_file_violations(tree, sup, path)
     out.sort(key=lambda v: (v.line, v.col, v.check))
     return out
 
@@ -1082,11 +1205,48 @@ def discover(paths: list[str]) -> list[Path]:
     return files
 
 
-def run_paths(paths: list[str]) -> list[Violation]:
+def run_sources(sources: dict[str, str]) -> list[Violation]:
+    """Per-file checks plus the cross-file passes (DF028) over one run's
+    worth of sources — each file parsed ONCE, the tree shared by both
+    passes. skip-file sources contribute their metric TOUCHES to the
+    cross-file pass (a fixture may legitimately be the only caller) but are
+    never flagged themselves."""
     out: list[Violation] = []
-    for f in discover(paths):
-        out.extend(lint_source(f.read_text(encoding="utf-8"), str(f)))
+    parsed: list[tuple[str, ast.Module]] = []
+    flaggable: dict[str, Suppressions] = {}
+    for path, source in sources.items():
+        sup = Suppressions(source)
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            if not sup.skip_file:
+                out.extend(
+                    Violation(path, line, 0, "DF001",
+                              f"unknown check id {check_id!r} in suppression")
+                    for line, check_id in sup.unknown
+                )
+                out.append(Violation(
+                    path, e.lineno or 1, e.offset or 0, "DF002",
+                    f"syntax error: {e.msg}",
+                ))
+            continue
+        parsed.append((path, tree))
+        if sup.skip_file:
+            continue
+        flaggable[path] = sup
+        out.extend(_per_file_violations(tree, sup, path))
+    for v in check_unused_metric_families(parsed):
+        sup = flaggable.get(v.path)
+        if sup is not None and not sup.allows(v):
+            out.append(v)
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.check))
     return out
+
+
+def run_paths(paths: list[str]) -> list[Violation]:
+    return run_sources(
+        {str(f): f.read_text(encoding="utf-8") for f in discover(paths)}
+    )
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -1116,9 +1276,9 @@ def main(argv: list[str] | None = None) -> int:
     except FileNotFoundError as e:
         print(f"dflint: error: {e}", file=sys.stderr)
         return 2
-    violations: list[Violation] = []
-    for f in files:
-        violations.extend(lint_source(f.read_text(encoding="utf-8"), str(f)))
+    violations = run_sources(
+        {str(f): f.read_text(encoding="utf-8") for f in files}
+    )
 
     if not args.quiet:
         for v in violations:
