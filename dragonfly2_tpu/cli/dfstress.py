@@ -62,7 +62,7 @@ async def run_stress(args: argparse.Namespace) -> dict:
             return f"{args.url}{sep}stress={counter}"
         return args.url
 
-    async def worker() -> None:
+    async def worker(priority: float) -> None:
         nonlocal errors
         while True:
             url = next_url()
@@ -71,14 +71,22 @@ async def run_stress(args: argparse.Namespace) -> dict:
             t0 = time.monotonic()
             try:
                 await client.call(  # dflint: disable=DF025 load generator: one RPC per iteration IS the workload being measured
-                    "download", {"url": url, "output": None}, timeout=args.timeout
+                    "download",
+                    {"url": url, "output": None, "priority": priority},
+                    timeout=args.timeout,
                 )
                 latencies.append(time.monotonic() - t0)
             except Exception:
                 errors += 1
 
+    # mixed tenant load: --priority-split N gives the first N workers the
+    # high priority (--priority, default 3.0) and the rest weight 1.0, so the
+    # traffic shaper's weighted fairness is drivable from the CLI (getattr:
+    # programmatic callers predating the flags keep working)
+    split = min(getattr(args, "priority_split", 0), args.concurrency)
+    weights = [getattr(args, "priority", 1.0)] * split + [1.0] * (args.concurrency - split)
     t0 = time.monotonic()
-    await asyncio.gather(*(worker() for _ in range(args.concurrency)))
+    await asyncio.gather(*(worker(w) for w in weights))
     elapsed = time.monotonic() - t0
     await client.close()
 
@@ -92,6 +100,7 @@ async def run_stress(args: argparse.Namespace) -> dict:
             "errors": errors,
             "elapsed_s": round(elapsed, 2),
             "concurrency": args.concurrency,
+            "priority_split": split,
             "unique_tasks": bool(args.unique),
             "p50_ms": round(float(np.percentile(lat, 50)), 2) if len(lat) else None,
             "p90_ms": round(float(np.percentile(lat, 90)), 2) if len(lat) else None,
@@ -562,6 +571,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--timeout", type=float, default=60.0)
     ap.add_argument("--unique", action="store_true",
                     help="unique task per request (full scheduler+piece path)")
+    ap.add_argument("--priority", type=float, default=3.0,
+                    help="tenant weight for the high-priority worker class")
+    ap.add_argument("--priority-split", type=int, default=0,
+                    help="first N workers request at --priority (rest at 1.0): "
+                         "drives the traffic shaper's weighted fairness")
     ap.add_argument("--scoring", action="store_true",
                     help="stress the ml scoring serving path instead of downloads")
     ap.add_argument("--swarm", action="store_true",

@@ -59,7 +59,8 @@ def render(stats: dict, *, clear: bool = False) -> str:
     header = (
         f"{'member':<18} {'type':<9} {'age':>5} "
         f"{'rounds/s':>9} {'p95ms':>7} {'down MB/s':>10} {'up MB/s':>9} "
-        f"{'lag p95':>8} {'util':>5} {'serving':>8} {'rollout':>12} alerts"
+        f"{'cipher':>8} {'lag p95':>8} {'util':>5} {'serving':>8} "
+        f"{'rollout':>12} alerts"
     )
     lines.append(header)
     lines.append("-" * len(header))
@@ -77,6 +78,7 @@ def render(stats: dict, *, clear: bool = False) -> str:
             f"{_fmt(r.get('round_p95_ms'), 2, 7)} "
             f"{_fmt(r.get('piece_down_mb_per_s'), 2, 10)} "
             f"{_fmt(r.get('piece_up_mb_per_s'), 2, 9)} "
+            f"{str(frame.get('piece_cipher', '-')):>8} "
             f"{_fmt(r.get('loop_lag_p95_ms'), 1, 8)} "
             f"{_fmt(r.get('dispatcher_utilization'), 2, 5)} "
             f"{str(frame.get('serving_mode', '-')):>8} "

@@ -242,6 +242,9 @@ class ParentState:
     failures: int = 0
     cost_ewma_ms: float = 0.0
     blocked: bool = False
+    # fetches currently riding this parent (striped mode's per-parent
+    # window); maintained by PieceDispatcher.begin/end around each fetch
+    in_flight: int = 0
 
     def score(self) -> float:
         """Higher is better: success rate shaded by recent piece cost."""
@@ -265,11 +268,31 @@ class ParentState:
 
 class PieceDispatcher:
     """Pick the parent for each piece: best score with ε-random exploration
-    (ref piece_dispatcher.go:103-124 exploration/exploitation split)."""
+    (ref piece_dispatcher.go:103-124 exploration/exploitation split).
 
-    def __init__(self, epsilon: float = 0.1, rng: random.Random | None = None):
+    Striped mode (`pick(..., striped=True)`) turns the pick into a
+    load-balancing decision: among the parents that hold the piece, prefer
+    the one with the fewest fetches in flight (score breaks ties), and keep
+    each parent's concurrent fetches under `stripe_window`. Assignment
+    happens at FETCH time, so the stripes are emergent, not precomputed — a
+    slow parent's window stays full longer and it naturally receives fewer
+    pieces, which is exactly the tail-aware split the GNN-training paper
+    applies to its straggler stage (PAPERS.md: parallelize the slowest
+    stage, not just the aggregate). When every window is full the pick
+    falls back to least-loaded (never returns None just because the task is
+    briefly window-bound — the piece queue provides the real backpressure).
+    """
+
+    def __init__(
+        self,
+        epsilon: float = 0.1,
+        rng: random.Random | None = None,
+        *,
+        stripe_window: int = 4,
+    ):
         self.parents: dict[str, ParentState] = {}
         self.epsilon = epsilon
+        self.stripe_window = stripe_window
         self._rng = rng or random.Random()
 
     def update_parents(self, parents: list[ParentInfo]) -> None:
@@ -285,15 +308,32 @@ class PieceDispatcher:
         if parent_id in self.parents:
             self.parents[parent_id].pieces = pieces
 
-    def pick(self, piece_index: int) -> ParentState | None:
+    def pick(
+        self,
+        piece_index: int,
+        *,
+        striped: bool = False,
+        exclude: "frozenset[str] | set[str] | tuple" = (),
+    ) -> ParentState | None:
         candidates = [
-            s for s in self.parents.values() if not s.blocked and piece_index in s.pieces
+            s for s in self.parents.values()
+            if not s.blocked and piece_index in s.pieces and s.info.peer_id not in exclude
         ]
         if not candidates:
             return None
         if self._rng.random() < self.epsilon:
             return self._rng.choice(candidates)
-        return max(candidates, key=ParentState.score)
+        if not striped or len(candidates) == 1:
+            return max(candidates, key=ParentState.score)
+        windowed = [s for s in candidates if s.in_flight < self.stripe_window]
+        pool = windowed or candidates
+        return min(pool, key=lambda s: (s.in_flight, -s.score()))
+
+    def begin(self, state: ParentState) -> None:
+        state.in_flight += 1
+
+    def end(self, state: ParentState) -> None:
+        state.in_flight = max(0, state.in_flight - 1)
 
     def usable(self) -> list[ParentState]:
         return [s for s in self.parents.values() if not s.blocked]
@@ -337,13 +377,130 @@ class ConductorConfig:
     report_flush_interval: float = 0.25
     # Hand filled piece buffers to writer tasks WITHOUT awaiting them, so one
     # worker pipelines recv of piece N+1 into the store write of piece N.
-    # Default OFF: on the 2-core CI image the piece-worker pool already
-    # overlaps recv/hash/write across workers on both cores, and the extra
-    # in-flight write tasks measured ~10% SLOWER (343 vs 311 MB/s in the
-    # 4-worker pipeline A/B); on hosts with cores to spare the deferral buys
-    # single-worker pipelining. Backpressure either way: the buffer pool's
-    # bounded leases park recv when writers fall behind.
-    defer_piece_writes: bool = False
+    # On the 2-core CI image the piece-worker pool already overlaps
+    # recv/hash/write across workers on both cores and the extra in-flight
+    # write tasks measured ~10% SLOWER (343 vs 311 MB/s in the 4-worker
+    # pipeline A/B); on hosts with cores to spare the deferral buys
+    # single-worker pipelining. That inversion is why the default is now
+    # None = ADAPTIVE: the first dispatch round runs inline while measuring
+    # its recv/write stage totals, and WriteBehindGovernor flips deferral on
+    # only where the measurement says it pays (spare cores + writes a real
+    # fraction of the round). True/False force the static modes (the A/B
+    # legs and the chaos equivalence baseline). Backpressure either way: the
+    # buffer pool's bounded leases park recv when writers fall behind.
+    defer_piece_writes: "bool | None" = None
+    # Multi-parent striped fetch: when a hot task has several ready parents,
+    # balance piece assignment across them (per-parent in-flight windows)
+    # instead of funneling ~everything to the single best-scored parent, so
+    # single-task fetch bandwidth aggregates across parents' per-peer
+    # serving ceilings. Scheduler accounting is unchanged — every piece
+    # still reports with its parent id.
+    striped_fetch: bool = True
+    stripe_window: int = 4
+    # Slowest-stripe steal: when the piece queue is empty but pieces are
+    # still in flight (the tail), an idle worker re-fetches a piece that has
+    # been riding a slow parent for > max(steal_min_ms, steal_cost_factor *
+    # that parent's cost EWMA) from a different parent, and the first copy
+    # to land wins (the loser's fetch is cancelled; landing + accounting are
+    # guarded so bytes/pieces never double-count).
+    tail_steal: bool = True
+    steal_min_ms: float = 400.0
+    steal_cost_factor: float = 3.0
+
+
+class WriteBehindGovernor:
+    """Runtime write-behind decision (ConductorConfig.defer_piece_writes=None).
+
+    PR 3 measured the static trade-off inverting with core count, so the
+    default can't be a constant. The first dispatch round runs INLINE while
+    `note()` accumulates the round's recv and write stage totals (two clock
+    reads per piece, only while measuring); `decide()` then flips deferral
+    on iff (a) there are cores beyond the two the recv+hash overlap already
+    uses, and (b) writes are a real fraction of the measured round — on a
+    2-core host, or when writes vanish into page cache, deferral only adds
+    task churn. The decision and both measurements export as metrics
+    (`write_behind_mode{mode}` one-hot, `write_behind_stage_ms{stage}`), so
+    the PR 12 timeseries plane records what was decided and from what.
+    """
+
+    # writes below this fraction of recv+write don't buy enough overlap to
+    # pay for per-piece writer tasks
+    MIN_WRITE_FRAC = 0.10
+    MIN_SAMPLES = 2
+
+    def __init__(self, forced: "bool | None", *, cpu_count: int | None = None):
+        import os
+
+        self.forced = forced
+        self.cpus = cpu_count if cpu_count is not None else (os.cpu_count() or 1)
+        self.recv_s = 0.0
+        self.write_s = 0.0
+        self.samples = 0
+        self.decided: bool | None = forced
+        self._exported = False
+        if forced is not None:
+            self._export("forced_deferred" if forced else "forced_inline")
+
+    @property
+    def measuring(self) -> bool:
+        return self.decided is None
+
+    @property
+    def defer(self) -> bool:
+        return bool(self.decided)
+
+    def note(self, recv_s: float, write_s: float) -> None:
+        if self.decided is None:
+            self.recv_s += recv_s
+            self.write_s += write_s
+            self.samples += 1
+
+    def decide(self) -> bool:
+        """Called at first-round end; keeps measuring if the round was too
+        small to mean anything (a 1-piece task decides nothing)."""
+        if self.decided is not None:
+            return self.decided
+        if self.samples < self.MIN_SAMPLES:
+            return False  # stay inline, keep measuring next round
+        total = self.recv_s + self.write_s
+        write_frac = self.write_s / total if total > 0 else 0.0
+        self.decided = self.cpus > 2 and write_frac >= self.MIN_WRITE_FRAC
+        self._export("deferred" if self.decided else "inline")
+        return self.decided
+
+    def _export(self, mode: str) -> None:
+        from dragonfly2_tpu.daemon import metrics
+
+        for m in ("inline", "deferred", "forced_inline", "forced_deferred"):
+            metrics.WRITE_BEHIND_MODE.set(1.0 if m == mode else 0.0, mode=m)
+        metrics.WRITE_BEHIND_STAGE_MS.set(round(self.recv_s * 1e3, 3), stage="recv")
+        metrics.WRITE_BEHIND_STAGE_MS.set(round(self.write_s * 1e3, 3), stage="write")
+        self._exported = True
+
+    def snapshot(self) -> dict:
+        return {
+            "mode": (
+                "measuring" if self.decided is None
+                else {True: "deferred", False: "inline"}[self.decided]
+            ),
+            "forced": self.forced,
+            "recv_ms": round(self.recv_s * 1e3, 3),
+            "write_ms": round(self.write_s * 1e3, 3),
+            "samples": self.samples,
+        }
+
+
+@dataclass
+class _InflightFetch:
+    """One piece fetch in flight (striped mode): enough state for the tail
+    steal to judge slowness and cancel the loser."""
+
+    idx: int
+    task: "asyncio.Task | None"  # set right after creation (fetch needs the entry)
+    started: float
+    parent_id: str = ""
+    stolen: bool = False
+    steal_attempts: int = 0  # bounded: a failing steal must not retry forever
 
 
 class PeerTaskConductor:
@@ -362,6 +519,8 @@ class PeerTaskConductor:
         shaper=None,
         raw_client=None,
         pipeline=None,
+        data_tls=None,
+        flow_weight: float = 1.0,
     ):
         from dragonfly2_tpu.utils.dflog import with_context
 
@@ -376,13 +535,19 @@ class PeerTaskConductor:
         self.sources = sources
         self.headers = headers or None  # origin request headers (auth etc.)
         self.cfg = config or ConductorConfig()
-        self.dispatcher = PieceDispatcher()
+        self.dispatcher = PieceDispatcher(stripe_window=self.cfg.stripe_window)
+        # DataPlaneTls bundle: parents' metadata + piece endpoints speak
+        # https/mTLS (the shared raw client carries its own copy; this one
+        # drives the aiohttp session + URL scheme)
+        self._data_tls = data_tls
+        self._scheme = "https" if data_tls is not None else "http"
         # With a node-wide shaper (daemon/traffic_shaper.py) the conductor
         # draws from a dynamically-allocated slice of the HOST budget; the
         # standalone per-task bucket is the no-engine fallback (tests, direct
-        # conductor use).
+        # conductor use). flow_weight is the task's tenant priority: the
+        # shaper splits contended bandwidth weight-proportionally.
         if shaper is not None:
-            self.bucket = shaper.open_flow(peer_id)
+            self.bucket = shaper.open_flow(peer_id, weight=flow_weight)
         else:
             self.bucket = TokenBucket(self.cfg.download_rate_bps, burst=64 << 20)
         self._session = http_session
@@ -399,6 +564,24 @@ class PeerTaskConductor:
         # writer task and immediately recycles a fresh buffer into recv; the
         # dispatch loop drains these at round end (see _spawn_piece_write)
         self._pending_writes: set[asyncio.Task] = set()
+        # adaptive write-behind: measures the first dispatch round, then
+        # decides (ConductorConfig.defer_piece_writes documents the why)
+        self._write_behind = WriteBehindGovernor(self.cfg.defer_piece_writes)
+        # striped-fetch state: fetches in flight (tail-steal registry) and
+        # which parents actually landed pieces (stripe-parents histogram +
+        # the stripe smoke's both-parents-served proof)
+        self._inflight: dict[int, _InflightFetch] = {}
+        self.pieces_by_parent: dict[str, int] = {}
+        self.steals_attempted = 0
+        self.steals_won = 0
+        # pieces this conductor has ACCOUNTED (bytes/metrics/report): the
+        # exactly-once guard for duplicate landings. storage._land_piece
+        # dedups the WRITE of racing copies but returns success to both
+        # writers — without this set, a steal and its original racing into
+        # the landing path would both reach _account_piece_success and
+        # double-count DOWNLOAD_TRAFFIC_BYTES (the invariant the chaos
+        # suite and stripe smoke pin).
+        self._accounted: set[int] = set()
         self.ts: TaskStorage | None = None
         self.bytes_from_parents = 0
         self.bytes_from_source = 0
@@ -823,6 +1006,10 @@ class PeerTaskConductor:
                     # re-reads the bitset, or still-in-flight pieces would look
                     # missing and be refetched
                     await self._drain_writes()
+                    # adaptive write-behind: the first measured round decides
+                    # the mode for the rest of the task (no-op once decided)
+                    if self._write_behind.measuring:
+                        self._write_behind.decide()
                     # dispatch-round-end flush: the scheduler learns this
                     # round's pieces in ONE report_pieces RPC (≤1 flush per
                     # round unless the size/interval triggers fired mid-round)
@@ -920,7 +1107,7 @@ class PeerTaskConductor:
         version = -1
         errors = 0  # consecutive failures feed the shared backoff ladder
         url = (
-            f"http://{_url_host(state.info.ip)}:{state.info.download_port}"
+            f"{self._scheme}://{_url_host(state.info.ip)}:{state.info.download_port}"
             f"/metadata/{self.meta.task_id}"
         )
         while not state.blocked:
@@ -996,12 +1183,132 @@ class PeerTaskConductor:
                 continue
             self._update_event.set()
 
+    # ---- striped fetch: per-parent windows + slowest-stripe tail steal ----
+
+    def _steal_active(self) -> bool:
+        return (
+            self.cfg.tail_steal
+            and self.cfg.striped_fetch
+            and len(self.dispatcher.usable()) > 1
+        )
+
+    def _steal_candidate(self) -> "tuple[_InflightFetch | None, float]":
+        """(entry, seconds-until-mature): the most overdue in-flight fetch
+        that has an alternative parent, or (None, 0) when nothing in flight
+        is stealable at all. A fetch matures for stealing after
+        max(steal_min_ms, steal_cost_factor * its parent's cost EWMA)."""
+        now = time.monotonic()
+        best: _InflightFetch | None = None
+        best_delay = float("inf")
+        for entry in self._inflight.values():
+            if entry.stolen or not entry.parent_id or entry.steal_attempts >= 2:
+                continue
+            alt = self.dispatcher.pick(
+                entry.idx, striped=True, exclude=frozenset((entry.parent_id,))
+            )
+            if alt is None:
+                continue  # nobody else holds this piece: nothing to steal to
+            st = self.dispatcher.parents.get(entry.parent_id)
+            ewma = st.cost_ewma_ms if st is not None else 0.0
+            mature_s = max(
+                self.cfg.steal_min_ms, self.cfg.steal_cost_factor * ewma
+            ) / 1e3
+            delay = (entry.started + mature_s) - now
+            if delay < best_delay:
+                best, best_delay = entry, delay
+        if best is None:
+            return None, 0.0
+        return best, max(0.0, best_delay)
+
+    async def _steal_piece(self, session, entry: _InflightFetch) -> None:
+        """Duplicate-fetch a tail piece from a different parent; first copy
+        to LAND wins (the landing path's has_piece guard makes the loser's
+        write+accounting a no-op, so DOWNLOAD_TRAFFIC_BYTES never double
+        counts). A winning steal cancels the loser's fetch so the round
+        doesn't wait out the slow parent anyway."""
+        from dragonfly2_tpu.daemon import metrics
+
+        entry.stolen = True
+        entry.steal_attempts += 1
+        self.steals_attempted += 1
+        try:
+            await self._download_one_piece(
+                session, entry.idx, exclude=frozenset((entry.parent_id,)),
+                inline_write=True,
+            )
+        except Exception as e:  # noqa: BLE001 — a failed steal must not kill
+            # the worker loop (the original fetch still owns the piece)
+            self.log.debug("tail steal of piece %d failed: %r", entry.idx, e)
+        landed = self.ts.has_piece(entry.idx)
+        current = self._inflight.get(entry.idx)
+        if landed and current is entry and not entry.task.done():
+            # the steal landed while the original is still grinding: cut the
+            # loser loose (its cleanup releases its buffer; the worker sees
+            # the cancellation as "stolen" and moves on)
+            entry.task.cancel()
+            self.steals_won += 1
+            metrics.PIECE_STEALS_TOTAL.inc(won="true")
+        else:
+            entry.stolen = False  # original may still need recovery/steals
+            metrics.PIECE_STEALS_TOTAL.inc(won="false")
+
+    async def _next_assignment(self, session, queue: asyncio.Queue) -> int:
+        """queue.get with tail-steal: an idle worker (empty queue, pieces
+        still in flight) re-fetches the slowest mature stripe instead of
+        parking. Waits are bounded by the next candidate's maturity and
+        always yield to fresh queue work the moment it appears."""
+        while True:
+            try:
+                return queue.get_nowait()
+            except asyncio.QueueEmpty:
+                pass
+            if not self._steal_active() or not self._inflight:
+                return await queue.get()
+            entry, delay = self._steal_candidate()
+            if entry is None:
+                return await queue.get()
+            if delay <= 0:
+                await self._steal_piece(session, entry)
+                continue
+            try:
+                return await asyncio.wait_for(queue.get(), timeout=delay)
+            except asyncio.TimeoutError:
+                continue  # candidate matured (or the flight set changed)
+
+    async def _run_piece_fetch(self, session, idx: int) -> None:
+        """One piece fetch, registered for tail stealing when striping is
+        live. The fetch runs as its own task so a winning steal can cancel
+        it; a cancellation that was NOT a steal (round teardown) propagates
+        to the worker exactly as before."""
+        if not self._steal_active():
+            await self._download_one_piece(session, idx)
+            return
+        entry = _InflightFetch(idx=idx, task=None, started=time.monotonic())
+        fetch = asyncio.ensure_future(
+            self._download_one_piece(session, idx, inflight=entry)
+        )
+        entry.task = fetch
+        self._inflight[idx] = entry
+        try:
+            await fetch
+        except asyncio.CancelledError:
+            if not fetch.cancelled():
+                # the WORKER is being cancelled (teardown): take the fetch
+                # down with us and propagate
+                fetch.cancel()
+                raise
+            # else: a steal won and cancelled the fetch — the piece is
+            # landed (or will be refetched next round); not a failure
+        finally:
+            if self._inflight.get(idx) is entry:
+                del self._inflight[idx]
+
     async def _piece_worker(self, session: aiohttp.ClientSession, queue: asyncio.Queue) -> None:
         while True:
-            idx = await queue.get()
+            idx = await self._next_assignment(session, queue)
             try:
                 if not self.ts.has_piece(idx):
-                    await self._download_one_piece(session, idx)
+                    await self._run_piece_fetch(session, idx)
             except Exception as e:
                 # _download_one_piece handles the expected fetch/verify
                 # failures itself; anything landing HERE (storage write error,
@@ -1030,10 +1337,21 @@ class PeerTaskConductor:
             finally:
                 queue.task_done()
 
-    async def _download_one_piece(self, session: aiohttp.ClientSession, idx: int) -> None:
-        state = self.dispatcher.pick(idx)
+    async def _download_one_piece(
+        self,
+        session: aiohttp.ClientSession,
+        idx: int,
+        *,
+        exclude: frozenset = frozenset(),
+        inflight: "_InflightFetch | None" = None,
+        inline_write: bool = False,
+    ) -> None:
+        striped = self.cfg.striped_fetch and len(self.dispatcher.usable()) > 1
+        state = self.dispatcher.pick(idx, striped=striped, exclude=exclude)
         if state is None:
             return
+        if inflight is not None:
+            inflight.parent_id = state.info.peer_id
         m = self.ts.meta
         r = piece_range(idx, m.piece_size, m.content_length)
         path_qs = (
@@ -1050,24 +1368,33 @@ class PeerTaskConductor:
         # this is what lets dftrace say WHERE a slow piece spent its time.
         # Stage clocks are read only when the trace is sampled — an
         # unsampled piece pays the span object and nothing else.
-        with default_tracer().span(
-            "conductor.piece",
-            piece=idx, parent_peer=state.info.peer_id, bytes=r.length,
-            path="raw" if use_raw else "http",
-        ) as piece_span:
-            await self._fetch_and_land_piece(
-                session, state, idx, r, path_qs, piece_timeout, t0,
-                use_raw, piece_span,
-            )
+        self.dispatcher.begin(state)  # per-parent window accounting (striping)
+        try:
+            with default_tracer().span(
+                "conductor.piece",
+                piece=idx, parent_peer=state.info.peer_id, bytes=r.length,
+                path="raw" if use_raw else "http",
+            ) as piece_span:
+                await self._fetch_and_land_piece(
+                    session, state, idx, r, path_qs, piece_timeout, t0,
+                    use_raw, piece_span, inline_write=inline_write,
+                )
+        finally:
+            self.dispatcher.end(state)
 
     async def _fetch_and_land_piece(
         self, session, state, idx, r, path_qs, piece_timeout, t0,
-        use_raw, piece_span,
+        use_raw, piece_span, *, inline_write: bool = False,
     ) -> None:
         pooled = None
         digest = ""
         data = b""
         sampled = piece_span.sampled
+        # stage clocks run when the trace wants them OR while the write-
+        # behind governor is measuring its first round (two monotonic reads
+        # per piece, nothing else)
+        clocked = sampled or self._write_behind.measuring
+        recv_s = 0.0
         try:
             if faultline.ACTIVE is not None:
                 await faultline.ACTIVE.fire("parent.fetch")
@@ -1084,15 +1411,17 @@ class PeerTaskConductor:
                 pooled = await pipeline.pool.acquire(r.length)
                 pump = pipeline.hash_pump(pooled.view)
                 try:
-                    t_recv = time.monotonic() if sampled else 0.0
+                    t_recv = time.monotonic() if clocked else 0.0
                     await self._raw_http().get_range_into(
                         state.info.ip, state.info.download_port, path_qs,
                         r.header(), pooled.view, timeout=piece_timeout,
                         on_chunk=pump.feed, fault_point="parent.piece_body",
                     )
+                    t_hash = time.monotonic() if clocked else 0.0
+                    if clocked:
+                        recv_s = t_hash - t_recv
                     if sampled:
-                        t_hash = time.monotonic()
-                        piece_span.set_attr("recv_ms", round((t_hash - t_recv) * 1e3, 3))
+                        piece_span.set_attr("recv_ms", round(recv_s * 1e3, 3))
                     digest = await pump.finish()
                     if sampled:
                         # the hash overlaps recv; this is the residual WAIT
@@ -1128,7 +1457,7 @@ class PeerTaskConductor:
                     headers["traceparent"] = ctx.traceparent()
                 t_recv = time.monotonic() if sampled else 0.0
                 async with session.get(
-                    f"http://{_url_host(state.info.ip)}:{state.info.download_port}{path_qs}",
+                    f"{self._scheme}://{_url_host(state.info.ip)}:{state.info.download_port}{path_qs}",
                     headers=headers,
                     timeout=aiohttp.ClientTimeout(total=piece_timeout),
                 ) as resp:
@@ -1150,6 +1479,14 @@ class PeerTaskConductor:
             )
             return
         cost = (time.monotonic() - t0) * 1000
+        if self.ts.has_piece(idx):
+            # another fetch of this piece landed while ours was on the wire
+            # (tail steal, or a worker-requeue race): the winner already
+            # wrote + accounted it — landing again would double-count
+            # DOWNLOAD_TRAFFIC_BYTES and re-hash a finished piece
+            if pooled is not None:
+                pooled.release()
+            return
         expected = self._piece_digests.get(str(idx), "")
         if not expected:
             self._pieces_unverified += 1
@@ -1167,12 +1504,19 @@ class PeerTaskConductor:
             # the store write runs on a worker thread either way
             # (write_piece_view offloads big writes); deferring additionally
             # lets THIS worker recycle a fresh buffer into recv before the
-            # write lands — see ConductorConfig.defer_piece_writes for the
-            # measured trade-off
-            if self.cfg.defer_piece_writes:
+            # write lands — the governor decides at runtime, see
+            # ConductorConfig.defer_piece_writes for the measured trade-off.
+            # Steal fetches force INLINE (`inline_write`): the stealer's
+            # win test is has_piece right after its fetch returns, and a
+            # spawned write would make every deferred-mode steal read as a
+            # loss — never cancelling the slow loser and re-stealing the
+            # same piece until its cap.
+            if self._write_behind.defer and not inline_write:
                 self._spawn_piece_write(state, idx, pooled, digest, cost, r.length)
             else:
-                await self._write_fetched_piece(state, idx, pooled, digest, cost, r.length)
+                await self._write_fetched_piece(
+                    state, idx, pooled, digest, cost, r.length, recv_s=recv_s
+                )
             return
         try:
             await self.ts.write_piece(idx, data, expected_digest=expected)
@@ -1201,14 +1545,18 @@ class PeerTaskConductor:
         self._pending_writes.add(t)
         t.add_done_callback(self._pending_writes.discard)
 
-    async def _write_fetched_piece(self, state, idx, pooled, digest, cost, length) -> None:
+    async def _write_fetched_piece(
+        self, state, idx, pooled, digest, cost, length, recv_s: float = 0.0
+    ) -> None:
         """Land a digest-verified pooled buffer in storage (writer side of
-        the recv/hash/write overlap; awaited inline or spawned per
-        defer_piece_writes). A write failure leaves the piece's bitset bit
-        unset, so the dispatch loop refetches it — the same bounded recovery
-        the worker-level re-enqueue gives small-piece writes."""
+        the recv/hash/write overlap; awaited inline or spawned per the
+        write-behind decision). A write failure leaves the piece's bitset
+        bit unset, so the dispatch loop refetches it — the same bounded
+        recovery the worker-level re-enqueue gives small-piece writes."""
         try:
             try:
+                measuring = self._write_behind.measuring
+                t_w = time.monotonic() if measuring else 0.0
                 # write stage span (inline: nested under conductor.piece;
                 # deferred: a sibling task span in the same round) — the
                 # third leg of the recv/hash/write stage decomposition
@@ -1216,6 +1564,10 @@ class PeerTaskConductor:
                     "conductor.piece_write", piece=idx, bytes=length
                 ):
                     await self.ts.write_piece_view(idx, pooled.view, digest=digest)
+                if measuring:
+                    # the governor's decision inputs: this piece's recv vs
+                    # write stage durations (inline mode, first round)
+                    self._write_behind.note(recv_s, time.monotonic() - t_w)
             finally:
                 pooled.release()
         except Exception as e:
@@ -1238,13 +1590,24 @@ class PeerTaskConductor:
         await self._account_piece_success(state, idx, cost, length)
 
     async def _account_piece_success(self, state, idx, cost, length) -> None:
+        # the serving parent earns its success/cost sample either way — it
+        # DID deliver valid bytes, even if another copy landed first
         state.record(True, cost)
+        if idx in self._accounted:
+            # duplicate landing (steal + original racing: storage deduped
+            # the write, both callers got success): bytes, metrics, and the
+            # scheduler report must count EXACTLY once — the first copy to
+            # reach accounting wins attribution.
+            return
+        self._accounted.add(idx)
         self.bytes_from_parents += length
+        pid = state.info.peer_id
+        self.pieces_by_parent[pid] = self.pieces_by_parent.get(pid, 0) + 1
         from dragonfly2_tpu.daemon import metrics
 
         metrics.PIECE_DOWNLOAD_TOTAL.inc(source="parent")
         metrics.DOWNLOAD_BYTES.inc(length)
-        await self._report_piece_success(idx, cost, state.info.peer_id)
+        await self._report_piece_success(idx, cost, pid)
 
     async def _report_piece_success(self, idx: int, cost_ms: float, parent_id: str = "") -> None:
         """Success-report fast path: enqueue into the batch buffer (sync, no
@@ -1276,7 +1639,14 @@ class PeerTaskConductor:
             # 1 MiB read buffer: the 64 KiB default hits the stream reader's
             # high-water mark hundreds of times per 16 MiB checkpoint piece,
             # each a transport pause/resume round-trip on the event loop
-            self._session = aiohttp.ClientSession(read_bufsize=1 << 20)
+            connector = None
+            if self._data_tls is not None:
+                # parents' metadata long-polls + small-piece fallbacks ride
+                # the same mTLS client identity the raw path handshakes with
+                connector = aiohttp.TCPConnector(ssl=self._data_tls.client_ctx)
+            self._session = aiohttp.ClientSession(
+                read_bufsize=1 << 20, connector=connector
+            )
         return self._session
 
     # pieces at/above this size fetch via the raw recv_into client; below it
@@ -1287,7 +1657,10 @@ class PeerTaskConductor:
         if self._raw_client is None:
             from dragonfly2_tpu.daemon.rawrange import RawRangeClient
 
-            self._raw_client = RawRangeClient()
+            # standalone conductors (tests, direct use) must still speak the
+            # data plane's wire posture — a plain client against mTLS
+            # parents would charge every parent with handshake garbage
+            self._raw_client = RawRangeClient(tls=self._data_tls)
         return self._raw_client
 
     def _pipeline(self):
@@ -1301,6 +1674,12 @@ class PeerTaskConductor:
         if self._peer_reported:  # failure paths raise after reporting: once only
             return
         self._peer_reported = True
+        if success and self.pieces_by_parent:
+            # stripe width for this task: how many distinct parents actually
+            # served pieces (1 = classic single-parent assignment)
+            from dragonfly2_tpu.daemon import metrics
+
+            metrics.PIECE_STRIPE_PARENTS.observe(float(len(self.pieces_by_parent)))
         if self._reports is not None:
             # task-completion flush BEFORE the peer result: report_peer_result
             # snapshots the peer's finished set into telemetry, so buffered

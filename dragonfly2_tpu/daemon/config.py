@@ -66,6 +66,13 @@ class DaemonYaml:
     metrics_port: Optional[int] = cfgfield(None, minimum=0, maximum=65535)
     probe_interval: Optional[float] = cfgfield(None, minimum=0.1)
     log_dir: Optional[str] = cfgfield(None, help="rotating per-component log dir")
+    data_tls_dir: Optional[str] = cfgfield(
+        None, help="tls.crt/tls.key/ca.pem dir: piece plane runs mTLS"
+    )
+    piece_cipher: Optional[str] = cfgfield(
+        None, choices=("aes-gcm", "chacha20"),
+        help="pin the data-plane cipher (default: one-shot host probe)",
+    )
     storage: StorageSection = cfgfield(default_factory=StorageSection)
     proxy: ProxySection = cfgfield(default_factory=ProxySection)
     object_storage: ObjectStorageSection = cfgfield(default_factory=ObjectStorageSection)

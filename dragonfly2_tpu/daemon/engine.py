@@ -95,6 +95,7 @@ class PeerEngine:
         storage_ttl: float = 24 * 3600,
         storage_capacity_bytes: int | None = None,
         disk_gc_threshold: float | None = None,
+        data_tls=None,
     ):
         from dragonfly2_tpu.daemon.traffic_shaper import (
             TOTAL_DOWNLOAD_RATE_BPS,
@@ -112,7 +113,15 @@ class PeerEngine:
         self.storage = StorageManager(storage_root)
         self.scheduler = scheduler
         self.sources = SourceRegistry()
-        self.upload = UploadServer(self.storage, host=ip, port=upload_port)
+        # secure-by-default data plane: a DataPlaneTls bundle
+        # (security/transport.py) puts the upload server AND every piece
+        # fetch on mTLS with the cipher the host's one-shot probe picked;
+        # None keeps the plain wire (tests, closed networks)
+        self.data_tls = data_tls
+        self.upload = UploadServer(
+            self.storage, host=ip, port=upload_port,
+            tls=None if data_tls is None else data_tls.server_ctx,
+        )
         self.conductor_config = conductor_config or ConductorConfig()
         # ONE host-wide download budget shared by all concurrent conductors
         # (ref NewSamplingTrafficShaper, traffic_shaper.go:139) — per-task
@@ -189,6 +198,14 @@ class PeerEngine:
 
     async def start(self) -> None:
         if not self._started:
+            from dragonfly2_tpu.daemon import metrics
+
+            # one-hot wire posture for dftop: which cipher piece MB/s rides
+            active = self.data_tls.policy if self.data_tls is not None else "plain"
+            for cipher in ("plain", "aes-gcm", "chacha20"):
+                metrics.PIECE_CIPHER.set(
+                    1.0 if cipher == active else 0.0, cipher=cipher
+                )
             # Crash recovery BEFORE the upload server opens: the audit
             # digest-verifies every claimed piece of restored incomplete
             # tasks (a metadata snapshot can claim bits over torn data after
@@ -273,11 +290,14 @@ class PeerEngine:
     def _shared_raw_client(self):
         """One raw range client for ALL conductors: keep-alive connections to
         a parent survive across tasks, so a recursive dfget (or a multi-file
-        checkpoint fetch) reuses sockets instead of reconnecting per file."""
+        checkpoint fetch) reuses sockets instead of reconnecting per file.
+        Under TLS the sharing matters twice: pooled connections skip the
+        handshake entirely, and the bundle's session cache lets every fresh
+        connect across all tasks resume abbreviated."""
         if self._raw_client is None:
             from dragonfly2_tpu.daemon.rawrange import RawRangeClient
 
-            self._raw_client = RawRangeClient()
+            self._raw_client = RawRangeClient(tls=self.data_tls)
         return self._raw_client
 
     def _shared_pipeline(self):
@@ -350,6 +370,7 @@ class PeerEngine:
         headers: dict[str, str] | None,
         *,
         seed: bool = False,
+        priority: float = 1.0,
     ):
         """Shared reuse/purge/conductor logic for download_task + stream_task.
 
@@ -394,6 +415,8 @@ class PeerEngine:
             shaper=self.shaper,
             raw_client=self._shared_raw_client(),
             pipeline=self._shared_pipeline(),
+            data_tls=self.data_tls,
+            flow_weight=priority,
         )
         producer = asyncio.ensure_future(conductor.run())
         self._conductors.add(producer)
@@ -421,9 +444,15 @@ class PeerEngine:
         seed: bool = False,
         headers: dict[str, str] | None = None,
         timeout: float | None = None,
+        priority: float = 1.0,
         **meta_kw,
     ) -> TaskStorage:
         """Download (or reuse) a task; optionally export to a named file.
+
+        `priority` is the task's tenant weight in the host traffic shaper:
+        under contention, concurrent tasks' bandwidth shares converge to the
+        ratio of their weights (a priority-3 task gets ~3x a priority-1
+        neighbor); with headroom it changes nothing.
 
         `output_range=(start, end)` (inclusive bytes, HTTP Range semantics)
         exports just that slice — performed HERE, under this operation's pin,
@@ -455,7 +484,9 @@ class PeerEngine:
             with dl.scope(timeout):
                 # the conductor task is created inside the scope, so it
                 # inherits the budget through its captured Context
-                ts, producer = await self._reuse_or_conduct(meta, headers, seed=seed)
+                ts, producer = await self._reuse_or_conduct(
+                    meta, headers, seed=seed, priority=priority
+                )
             pinned = ts  # engine-held pin for this operation (reclaim immunity)
             try:
                 if producer is not None:

@@ -38,6 +38,49 @@ TASK_RECOVERED_TOTAL = _r.counter(
     "task_recovered_total", "Tasks re-announced after restart",
     subsystem="dfdaemon", labels=("state",),
 )
+# ---- data-plane TLS (security/transport.py + rawrange/upload wiring) ----
+# resumed="true" rides the abbreviated handshake (cached session accepted by
+# the parent); "false" is a full ECDHE+cert exchange. The alert plane watches
+# the failure family: a parent fleet refusing handshakes (cert rollover gone
+# wrong, cipher mismatch) shows up here long before piece failures dominate.
+PIECE_TLS_HANDSHAKES_TOTAL = _r.counter(
+    "piece_tls_handshakes_total", "Data-plane TLS handshakes completed",
+    subsystem="dfdaemon", labels=("resumed",),
+)
+PIECE_TLS_HANDSHAKE_FAILURES_TOTAL = _r.counter(
+    "piece_tls_handshake_failures_total",
+    "Data-plane TLS handshakes that failed", subsystem="dfdaemon",
+)
+# one-hot active piece cipher ({cipher="aes-gcm"|"chacha20"|"plain"}): set at
+# engine boot so dftop can label piece MB/s with the wire posture
+PIECE_CIPHER = _r.gauge(
+    "piece_cipher", "Active piece-plane cipher policy (one-hot)",
+    subsystem="dfdaemon", labels=("cipher",),
+)
+# ---- striped multi-parent fetch (conductor) ----
+PIECE_STRIPE_PARENTS = _r.histogram(
+    "piece_stripe_parents",
+    "Distinct parents that served pieces for one completed P2P task",
+    subsystem="dfdaemon", buckets=(1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 16.0),
+)
+PIECE_STEALS_TOTAL = _r.counter(
+    "piece_steals_total",
+    "Tail pieces re-fetched from a faster parent (slowest-stripe steal)",
+    subsystem="dfdaemon", labels=("won",),
+)
+# ---- adaptive write-behind (conductor WriteBehindGovernor) ----
+# one-hot mode ({mode}): measuring | inline | deferred | forced_inline |
+# forced_deferred; the decision inputs ride the stage gauge alongside so a
+# dashboard can show WHY the governor chose what it chose
+WRITE_BEHIND_MODE = _r.gauge(
+    "write_behind_mode", "Write-behind decision state (one-hot)",
+    subsystem="dfdaemon", labels=("mode",),
+)
+WRITE_BEHIND_STAGE_MS = _r.gauge(
+    "write_behind_stage_ms",
+    "First-round per-stage totals the write-behind decision was made from",
+    subsystem="dfdaemon", labels=("stage",),
+)
 PIECE_RECOVERED_TOTAL = _r.counter(
     "piece_recovered_total", "Pieces verified back in at boot", subsystem="dfdaemon"
 )

@@ -33,6 +33,16 @@ a real early close produces; a corruption flows through hash-on-receive and
 is caught by the digest check, so chaos proofs exercise the same rejection
 path production corruption would take.
 
+TLS: when built with a ``DataPlaneTls`` bundle (security/transport.py) every
+connection handshakes through the bulk-BIO fast path — ciphertext moves in
+256 KiB reads and ``SSLObject.read`` decrypts DIRECTLY into the caller's
+pooled buffer, preserving the no-intermediate-copy discipline under mTLS.
+Sessions are cached per (ip, port): the first connect to a parent pays the
+full ECDHE+cert handshake, every later per-piece connection (and the whole
+pool after an idle prune or reconnect storm) resumes abbreviated. Handshake
+outcomes land in ``piece_tls_handshakes_total{resumed}`` and the failure
+counter the alert plane watches.
+
 Reference context: the piece transfer protocol is the reference's HTTP
 `GET /download/{taskID[:3]}/{taskID}?peerId=` with a Range header
 (client/daemon/peer/piece_downloader.go:203-211); this is the same wire
@@ -46,14 +56,19 @@ import asyncio
 import errno
 import logging
 import socket
+import ssl as _ssl
 from typing import Callable, Optional
 
 from dragonfly2_tpu.resilience import faultline
+from dragonfly2_tpu.security.transport import AsyncPlainTransport, AsyncTlsTransport
 
 logger = logging.getLogger(__name__)
 
 _MAX_HEADER_BYTES = 16 << 10
 _MAX_IDLE_PER_HOST = 4
+# TLS bodies at/above this ride the worker-thread drain (recv+decrypt off
+# the loop); below it the thread hop costs more than it overlaps
+_TLS_THREADED_BODY_BYTES = 256 << 10
 # pooled sockets older than this are assumed dead (peer upload servers close
 # idle keep-alive connections after ~75 s) and are discarded at checkout /
 # pruned periodically rather than tried
@@ -99,14 +114,33 @@ class RawRangeClient:
         *,
         max_idle_per_host: int = _MAX_IDLE_PER_HOST,
         idle_ttl_s: float = _IDLE_TTL_S,
+        tls=None,
     ):
         import time
 
         self._now = time.monotonic
-        self._pool: dict[tuple[str, int], list[tuple[socket.socket, float]]] = {}
+        # pooled entries are transports (AsyncPlainTransport / AsyncTlsTransport)
+        self._pool: dict[tuple[str, int], list[tuple[object, float]]] = {}
         self._max_idle = max_idle_per_host
         self._idle_ttl = idle_ttl_s
+        # DataPlaneTls bundle (security/transport.py): client_ctx + per-parent
+        # session cache. None = plain TCP (the pre-TLS wire).
+        self._tls = tls
+        # ONE TLS body drain at a time per client: each drain's per-record
+        # Python slice runs ~1.5 µs under the GIL, and N concurrent drain
+        # threads convoy on it — 4 parallel drains measured ~290 MB/s
+        # aggregate where a single serialized drain does ~630. Piece workers
+        # still pipeline: while one body drains, the others' requests are in
+        # flight (the parent encrypts ahead into TCP buffers) and their
+        # hash/write stages run on their own threads.
+        self._drain_sem: asyncio.Semaphore | None = (
+            asyncio.Semaphore(1) if tls is not None else None
+        )
         self._closed = False
+
+    @property
+    def tls_enabled(self) -> bool:
+        return self._tls is not None
 
     async def close(self) -> None:
         self._closed = True
@@ -135,7 +169,7 @@ class RawRangeClient:
                 del self._pool[key]
         return closed
 
-    def _checkout(self, key: tuple[str, int]) -> Optional[socket.socket]:
+    def _checkout(self, key: tuple[str, int]):
         conns = self._pool.get(key)
         while conns:
             s, t = conns.pop()
@@ -144,15 +178,15 @@ class RawRangeClient:
             s.close()  # idle past the server's keep-alive window: dead
         return None
 
-    def _checkin(self, key: tuple[str, int], sock: socket.socket) -> None:
+    def _checkin(self, key: tuple[str, int], transport) -> None:
         if self._closed:
-            sock.close()
+            transport.close()
             return
         conns = self._pool.setdefault(key, [])
         if len(conns) < self._max_idle:
-            conns.append((sock, self._now()))
+            conns.append((transport, self._now()))
         else:
-            sock.close()
+            transport.close()
 
     async def get_range(
         self,
@@ -230,22 +264,29 @@ class RawRangeClient:
         # framing) raise plain IOError and are never replayed either.
         key = (ip, port)
         while True:
-            sock = self._checkout(key)
-            pooled = sock is not None
+            transport = self._checkout(key)
+            pooled = transport is not None
             got_response = [False]  # set by _request on the first response byte
             try:
-                if sock is None:
+                if transport is None:
                     sock = self._fresh_socket(ip)
                     try:
                         await asyncio.get_running_loop().sock_connect(sock, (ip, port))
+                        transport = await self._wrap_fresh(sock, key)
                     except OSError as e:
+                        sock.close()
                         if ":" in ip and e.errno in _AF_CONNECT_ERRNOS:
                             raise AddressFamilyError(
                                 f"no route to IPv6 target {ip!r} from this host"
                             ) from e
                         raise
+                    except BaseException:
+                        # timeout cancellation between connect and handshake
+                        # completion must not leak the raw fd
+                        sock.close()
+                        raise
                 await self._request(
-                    sock, key, ip, port, path_qs, range_header,
+                    transport, key, ip, port, path_qs, range_header,
                     view, on_chunk, fault_point, got_response,
                 )
                 return
@@ -253,11 +294,39 @@ class RawRangeClient:
                 # every failure path — including timeout cancellation mid-body
                 # — must close the socket: a piece timeout against a stalled
                 # parent is routine, and each one would otherwise leak an fd
-                if sock is not None:
-                    sock.close()
+                if transport is not None:
+                    transport.close()
                 if pooled and isinstance(e, ConnectionError) and not got_response[0]:
                     continue  # drain the next pooled socket (or go fresh)
                 raise
+
+    async def _wrap_fresh(self, sock: socket.socket, key: tuple[str, int]):
+        """Transport for a just-connected socket: plain pass-through, or the
+        TLS fast-path handshake resuming the parent's cached session. The
+        session learned from a successful handshake (resumed or not — a full
+        handshake re-issues a fresh ticket) replaces the cache entry, so a
+        parent that restarted and rejected the old session heals on the very
+        next connect."""
+        if self._tls is None:
+            return AsyncPlainTransport(sock)
+        from dragonfly2_tpu.daemon import metrics
+
+        try:
+            t = await AsyncTlsTransport.connect(
+                sock, self._tls.client_ctx, session=self._tls.sessions.get(key)
+            )
+        except (_ssl.SSLError, ConnectionError, OSError, asyncio.TimeoutError) as e:
+            metrics.PIECE_TLS_HANDSHAKE_FAILURES_TOTAL.inc()
+            sock.close()
+            # a refused handshake is the parent's problem (bad cert, cipher
+            # mismatch, not actually speaking TLS): surface as the IOError the
+            # piece retry path charges to the parent, never replay silently
+            raise IOError(f"TLS handshake with {key[0]}:{key[1]} failed: {e!r}") from e
+        metrics.PIECE_TLS_HANDSHAKES_TOTAL.inc(
+            resumed="true" if t.session_reused else "false"
+        )
+        self._tls.sessions.put(key, t.session)
+        return t
 
     def _fresh_socket(self, ip: str) -> socket.socket:
         """Non-blocking TCP socket in the family `ip` needs (':' marks an
@@ -275,11 +344,15 @@ class RawRangeClient:
             raise
         sock.setblocking(False)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self._tls is not None:
+            # deeper kernel pipeline under TLS: the parent encrypts ahead
+            # into these buffers while this side's single drain catches up
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4 << 20)
         return sock
 
     async def _request(
         self,
-        sock: socket.socket,
+        transport,
         key: tuple[str, int],
         ip: str,
         port: int,
@@ -291,7 +364,6 @@ class RawRangeClient:
         got_response: list,
     ) -> None:
         length = len(view)
-        loop = asyncio.get_running_loop()
         host = f"[{ip}]" if ":" in ip else ip
         # piece bodies join the caller's trace: the standard traceparent
         # header carries the context (and its sampled flag) to the parent's
@@ -309,7 +381,7 @@ class RawRangeClient:
             "Connection: keep-alive\r\n"
             "\r\n"
         ).encode("ascii")
-        await loop.sock_sendall(sock, req)
+        await transport.sendall(req)
 
         head = bytearray()
         while True:
@@ -318,7 +390,7 @@ class RawRangeClient:
                 break
             if len(head) > _MAX_HEADER_BYTES:
                 raise IOError("response headers too large")
-            chunk = await loop.sock_recv(sock, 8192)
+            chunk = await transport.recv(8192)
             if not chunk:
                 raise ConnectionError("connection closed before response headers")
             got_response[0] = True  # past here, ConnectionErrors are not replayed
@@ -337,47 +409,65 @@ class RawRangeClient:
             # no pooling across error responses — the error body would have
             # to be drained to reuse the connection, and error paths are not
             # worth a keep-alive optimization
-            sock.close()
+            transport.close()
             raise IOError(f"parent returned HTTP {status}")
         clen = headers.get("content-length")
         if clen is None or not clen.isdigit() or int(clen) != length:
-            sock.close()
+            transport.close()
             raise IOError(f"unexpected Content-Length {clen!r} (want {length})")
         if "chunked" in headers.get("transfer-encoding", "").lower():
-            sock.close()
+            transport.close()
             raise IOError("chunked range response unsupported")
 
         off = len(leftover)
         if off > length:
-            sock.close()
+            transport.close()
             raise IOError("server sent more body bytes than Content-Length")
         view[:off] = leftover
         faulted = fault_point is None or faultline.ACTIVE is None
         if off:
             if not faulted:
-                self._fault_first_body(fault_point, view, 0, off, sock)
+                self._fault_first_body(fault_point, view, 0, off, transport)
                 faulted = True
             if on_chunk is not None:
                 on_chunk(off)
+        if transport.tls and length - off >= _TLS_THREADED_BODY_BYTES:
+            # big TLS bodies drain on a worker thread: recv + BIO copy +
+            # per-record decrypt run GIL-released off the loop, so the hash
+            # pump and store writes overlap the crypto on another core (the
+            # loop-thread recv_into shape time-sliced all three). Faults and
+            # on_chunk fire from the worker — both are single-producer-safe,
+            # and a fault's IOError/close propagates exactly like the
+            # loop-side path's.
+            def _on_bytes(prev: int, new: int) -> None:
+                nonlocal faulted
+                if not faulted:
+                    self._fault_first_body(fault_point, view, prev, new, transport)
+                    faulted = True
+                if on_chunk is not None:
+                    on_chunk(new)
+
+            async with self._drain_sem:
+                off = await transport.recv_body_into(view, off, on_bytes=_on_bytes)
         while off < length:
-            n = await loop.sock_recv_into(sock, view[off:])
+            n = await transport.recv_into(view[off:])
             if n == 0:
-                sock.close()
+                transport.close()
                 raise IOError(f"connection closed at byte {off}/{length}")
             if not faulted:
-                self._fault_first_body(fault_point, view, off, off + n, sock)
+                self._fault_first_body(fault_point, view, off, off + n, transport)
                 faulted = True
             off += n
             if on_chunk is not None:
                 on_chunk(off)
         if headers.get("connection", "").lower() == "close":
-            sock.close()
+            transport.close()
         else:
-            self._checkin(key, sock)
+            self._checkin(key, transport)
 
     @staticmethod
     def _fault_first_body(
-        point: str, view: memoryview, start: int, end: int, sock: socket.socket
+        point: str, view: memoryview, start: int, end: int, transport
     ) -> None:
         """Apply one seeded truncate/corrupt draw to the first body bytes —
         the pipeline's read point. Truncation becomes the short-body close a
@@ -388,7 +478,7 @@ class RawRangeClient:
         mutated = faultline.ACTIVE.mutate(point, data)
         if len(mutated) != len(data):  # truncate: simulate the dead socket
             view[start : start + len(mutated)] = mutated
-            sock.close()
+            transport.close()
             raise IOError(
                 f"connection closed at byte {start + len(mutated)}/{len(view)}"
                 " (injected truncation)"

@@ -54,6 +54,9 @@ class DaemonRpcAdapter:
                 digest=p.get("digest", ""),
                 filters=tuple(p.get("filters", ())),
                 headers=p.get("headers") or None,
+                # tenant priority: the task's weight in the host traffic
+                # shaper (dfget/dfstress mixed-tenant load)
+                priority=float(p.get("priority", 1.0)),
             )
         except RangeOutOfBounds as e:
             # ONLY the bounds check maps to bad_request — an internal
@@ -213,6 +216,8 @@ async def run_daemon(
     disk_gc_threshold: float | None = None,
     total_download_rate_bps: float | None = None,
     per_task_rate_bps: float | None = None,
+    data_tls_dir: str | None = None,
+    piece_cipher: str | None = None,
     ready_event: asyncio.Event | None = None,
 ) -> None:
     from dragonfly2_tpu.resilience import faultline
@@ -244,6 +249,25 @@ async def run_daemon(
     conductor_config = None
     if per_task_rate_bps is not None:
         conductor_config = ConductorConfig(download_rate_bps=per_task_rate_bps)
+    # secure-by-default piece plane: --data-tls-dir names a directory holding
+    # tls.crt/tls.key/ca.pem (the cache layout security.ca.write_issued
+    # produces from the manager's issuance RPC); the bundle's one-shot probe
+    # picks the cipher unless --piece-cipher pins it
+    data_tls = None
+    if data_tls_dir:
+        from pathlib import Path
+
+        from dragonfly2_tpu.security.transport import DataPlaneTls
+
+        d = Path(data_tls_dir)
+        data_tls = DataPlaneTls.from_paths(
+            str(d / "tls.crt"), str(d / "tls.key"), str(d / "ca.pem"),
+            policy=piece_cipher or None,
+        )
+        logging.getLogger(__name__).info(
+            "data-plane mTLS on: cipher=%s ktls=%s", data_tls.policy,
+            data_tls.ktls["reason"],
+        )
     engine = PeerEngine(
         storage_root=storage_root,
         scheduler=scheduler,
@@ -258,6 +282,7 @@ async def run_daemon(
         storage_ttl=storage_ttl,
         storage_capacity_bytes=storage_capacity_bytes,
         disk_gc_threshold=disk_gc_threshold,
+        data_tls=data_tls,
     )
     await engine.start()
 
@@ -557,6 +582,13 @@ def main() -> None:
                     help="evict LRU complete tasks when disk usage passes this percent")
     ap.add_argument("--log-dir", default=cfg.log_dir,
                     help="per-component rotating log files (console only when unset)")
+    ap.add_argument("--data-tls-dir", default=cfg.data_tls_dir,
+                    help="directory with tls.crt/tls.key/ca.pem: piece plane "
+                         "(upload server + fetches) runs mTLS with cipher "
+                         "autoselection")
+    ap.add_argument("--piece-cipher", default=cfg.piece_cipher,
+                    choices=["aes-gcm", "chacha20"],
+                    help="pin the data-plane cipher (default: one-shot probe)")
     ap.add_argument("-v", "--verbose", action="store_true")
     args = ap.parse_args()
     if args.object_storage_backend != "fs":
@@ -622,6 +654,8 @@ def main() -> None:
             ),
             total_download_rate_bps=cfg.rate_limit.total_download_mib_per_s * (1 << 20),
             per_task_rate_bps=cfg.rate_limit.per_task_mib_per_s * (1 << 20),
+            data_tls_dir=args.data_tls_dir,
+            piece_cipher=args.piece_cipher,
         )
     )
 

@@ -21,6 +21,16 @@ Allocation per resample: every flow keeps a guaranteed floor; the spare
 budget is split proportionally to observed need; per-flow caps (the 512 MB/s
 per-peer limit) redistribute their excess to uncapped flows. Flows younger
 than one full interval count as max-need so new downloads ramp immediately.
+
+Tenant priorities (`open_flow(..., weight=)`): each flow's share of the
+CONTENDED budget scales by its weight, so two saturated tasks with weights
+1 and 3 converge to a 1:3 bandwidth split. For this to be a stable fixed
+point, a saturated flow's demand is taken as the PER-FLOW CAP rather than a
+multiple of its current rate — ramping off the current rate made allocation
+proportional to prior allocation, which compounds every interval and only
+stops at the floor/cap rails (the weighted split would never converge to
+the weights). Demand-capped shares converge in one resample and still ramp
+a starved flow instantly (cap >> anything it had).
 """
 
 from __future__ import annotations
@@ -39,10 +49,18 @@ PER_FLOW_CAP_BPS = float(512 << 20)  # ref constants.go:45
 class Flow:
     """One task's slice of the host budget; quacks like TokenBucket.acquire."""
 
-    def __init__(self, shaper: "SamplingTrafficShaper", flow_id: str, bucket: TokenBucket):
+    def __init__(
+        self,
+        shaper: "SamplingTrafficShaper",
+        flow_id: str,
+        bucket: TokenBucket,
+        weight: float = 1.0,
+    ):
         self._shaper = shaper
         self.flow_id = flow_id
         self.bucket = bucket
+        # tenant priority: scales this flow's share of contended bandwidth
+        self.weight = max(1e-6, float(weight))
         self.created_at = time.monotonic()
         self.window_bytes = 0.0  # demand since last resample
         self.pending_bytes = 0.0  # blocked in the bucket right now
@@ -102,18 +120,16 @@ class SamplingTrafficShaper:
         self._last_sample = time.monotonic()
         self._last_needs: dict[str, float] = {}  # carried into out-of-band reallocs
         self.resamples = 0
-        # A saturated flow's true need is unobservable from issuance (it can
-        # only issue what it was granted); ramp its weight by this factor of
-        # its current rate so starvation resolves in a few intervals.
-        self.saturation_ramp = 4.0
 
     # ---- flow lifecycle ----
 
-    def open_flow(self, flow_id: str) -> Flow:
+    def open_flow(self, flow_id: str, *, weight: float = 1.0) -> Flow:
         """Register a task download; triggers an immediate reallocation so
-        the newcomer gets headroom without waiting a full interval."""
+        the newcomer gets headroom without waiting a full interval. `weight`
+        is the task's tenant priority (module docstring): contended
+        bandwidth splits weight-proportionally."""
         bucket = TokenBucket(self.min_flow_rate_bps, burst=self.min_flow_rate_bps / 2)
-        flow = Flow(self, flow_id, bucket)
+        flow = Flow(self, flow_id, bucket, weight=weight)
         self._flows[flow_id] = flow
         # Out-of-band reallocation carries the LAST sampled needs: a task
         # arriving must not zero the established flows' weights and collapse
@@ -139,11 +155,13 @@ class SamplingTrafficShaper:
         for fid, f in self._flows.items():
             need = f.window_bytes / elapsed
             if f.saturated:
-                # Blocked right now → wants more than granted; issuance is a
-                # lower bound, so ramp multiplicatively off the current rate.
-                need = max(
-                    need, f.bucket.rate * self.saturation_ramp, f.pending_bytes / elapsed
-                )
+                # Blocked in its bucket → wants more than granted, and
+                # issuance only shows what the old allocation permitted.
+                # Demand is taken as the per-flow cap: the starved flow
+                # reaches any allocation in ONE resample, and (unlike a
+                # rate-multiple ramp) the weighted split over cap-demands is
+                # a stable fixed point at the configured weights.
+                need = self.per_flow_cap_bps
             needs[fid] = need
         for f in self._flows.values():
             f.window_bytes = 0.0
@@ -162,14 +180,17 @@ class SamplingTrafficShaper:
         n = len(flows)
         floor = min(self.min_flow_rate_bps, self.total_rate_bps / n)
         spare = self.total_rate_bps - floor * n
-        # Weight = observed need; flows younger than a full interval have no
-        # meaningful sample yet and weigh in at the per-flow cap (max need).
+        # Share weight = observed need (flows younger than a full interval
+        # weigh in at the per-flow cap — no meaningful sample yet) scaled by
+        # the flow's tenant priority: contended bandwidth converges to the
+        # weight ratio because saturated flows all demand the same cap.
         weights = {}
         for f in flows:
             if now - f.created_at < self.interval_s:
-                weights[f.flow_id] = self.per_flow_cap_bps
+                need = self.per_flow_cap_bps
             else:
-                weights[f.flow_id] = needs.get(f.flow_id, 0.0)
+                need = needs.get(f.flow_id, 0.0)
+            weights[f.flow_id] = need * f.weight
         total_w = sum(weights.values())
 
         alloc = {f.flow_id: floor for f in flows}

@@ -132,6 +132,18 @@ def default_rules() -> list[AlertRule]:
             bound=0.0, window_s=60.0, for_s=10.0,
             description="any federation sync errors sustained in the window",
         ),
+        AlertRule(
+            name="piece_tls_handshake_failures",
+            kind="rate",
+            metric="dragonfly_dfdaemon_piece_tls_handshake_failures_total",
+            bound=0.0, window_s=60.0, for_s=15.0,
+            # a RATE rule, not a failure/success ratio: when a cert rollover
+            # goes wrong every handshake fails and a ratio's denominator
+            # (completed handshakes) goes to zero — exactly when the alert
+            # must fire. Sustained-for filters the stray flaky parent.
+            description="data-plane TLS handshake failures sustained in the "
+                        "window (cert rollover / cipher mismatch suspect)",
+        ),
     ]
 
 

@@ -466,6 +466,18 @@ def build_stats_frame(
     put("tasks_per_s", r.rate(
         "dragonfly_dfdaemon_task_result_total", window_s=window_s
     ), 3)
+    # data-plane TLS health: handshake volume + how much of it resumed (the
+    # fast-path contract is resumed/total ≥ 0.9 under reconnect storms)
+    put("tls_handshakes_per_s", r.rate(
+        "dragonfly_dfdaemon_piece_tls_handshakes_total", window_s=window_s
+    ), 3)
+    put("tls_resumed_per_s", r.rate(
+        "dragonfly_dfdaemon_piece_tls_handshakes_total", {"resumed": "true"},
+        window_s=window_s,
+    ), 3)
+    put("tls_handshake_failures_per_s", r.rate(
+        "dragonfly_dfdaemon_piece_tls_handshake_failures_total", window_s=window_s
+    ), 3)
     # loop health
     lag = r.hist_window("dragonfly_loop_lag_seconds", window_s=window_s)
     if lag is not None:
@@ -497,6 +509,14 @@ def build_stats_frame(
     mode = _one_hot_mode(r, "dragonfly_scheduler_ml_serving_mode", "mode")
     if mode is not None:
         frame["serving_mode"] = mode
+    # wire posture labels for the daemon's byte rates: which cipher piece
+    # MB/s is riding, and what the write-behind governor decided
+    cipher = _one_hot_mode(r, "dragonfly_dfdaemon_piece_cipher", "cipher")
+    if cipher is not None:
+        frame["piece_cipher"] = cipher
+    wb = _one_hot_mode(r, "dragonfly_dfdaemon_write_behind_mode", "mode")
+    if wb is not None:
+        frame["write_behind"] = wb
     state = _one_hot_mode(r, "dragonfly_scheduler_model_rollout_state", "state")
     if state is not None:
         frame["rollout_state"] = state

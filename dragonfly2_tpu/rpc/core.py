@@ -256,6 +256,14 @@ class RpcServer:
         # drain() serialized every responder behind the slowest flush.
         wq = WriteCoalescer(writer)
         self._conns.add(writer)
+        ssl_obj = writer.get_extra_info("ssl_object")
+        if ssl_obj is not None:
+            # one line per TLS connection: which suite actually negotiated
+            # (cert-rollover/cipher-policy debugging without a pcap)
+            logger.debug(
+                "rpc conn from %s: %s %s", writer.get_extra_info("peername"),
+                ssl_obj.version(), (ssl_obj.cipher() or ("?",))[0],
+            )
         try:
             while True:
                 try:
@@ -540,6 +548,24 @@ class RpcClient:
                 await writer.wait_closed()
             except (ConnectionResetError, BrokenPipeError, OSError):
                 pass
+
+    def tls_info(self) -> "dict | None":
+        """Negotiated TLS parameters of the live connection, or None when
+        plain/disconnected: {"cipher", "version"}. The control plane rides
+        asyncio's stock SSL (small frames — the data plane's fast-path
+        transport lives in security/transport.py); this surfaces what
+        actually negotiated so dfstress/debug tooling can report the wire
+        posture next to its numbers."""
+        if self._writer is None:
+            return None
+        ssl_obj = self._writer.get_extra_info("ssl_object")
+        if ssl_obj is None:
+            return None
+        cipher = ssl_obj.cipher()
+        return {
+            "cipher": cipher[0] if cipher else None,
+            "version": ssl_obj.version(),
+        }
 
     async def healthy(self) -> bool:
         try:

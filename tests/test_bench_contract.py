@@ -162,6 +162,57 @@ def test_federation_contract():
     assert 0.2 < out["reshard_moved_frac_join_1to2"] < 0.75
 
 
+def test_piece_pipeline_contract():
+    # tiny shape: pins the ISSUE 13 key set — TLS fast path (cipher A/B,
+    # handshake storm, kTLS null-probe), striped-vs-single A/B over real
+    # subprocess parents, adaptive write-behind decision + both legs — and
+    # the null/"skipped" hygiene (VERDICT #8): TLS keys may be None as a
+    # SET (no CA backend), never fabricated zeros.
+    out = bench.bench_piece_pipeline(total_mb=16, piece_mb=4)
+    for key in (
+        "recv_mb_per_s", "hash_mb_per_s", "write_mb_per_s",
+        "serial_mb_per_s", "pipelined_mb_per_s",
+        "plain_transport_mb_per_s", "mtls_transport_mb_per_s",
+        "mtls_stream_mb_per_s", "tls_cipher_policy", "tls_aes_accel",
+        "aesgcm_transport_mb_per_s", "chacha20_transport_mb_per_s",
+        "cipher_autoselect_gain_pct", "tls_handshake_full_ms",
+        "tls_handshake_resumed_ms", "tls_resumption_hit_rate",
+        "pipelined_tls_mb_per_s", "pipelined_plain_e2e_mb_per_s",
+        "tls_overhead_pct", "ktls",
+        "single_parent_mb_per_s", "striped_mb_per_s", "striped_speedup",
+        "stripe_parents_used", "stripe_parent_cap_mb_per_s",
+        "write_behind_mb_per_s_inline", "write_behind_mb_per_s_deferred",
+        "write_behind_decision", "write_behind_recv_ms", "write_behind_write_ms",
+    ):
+        assert key in out, key
+    assert out["pipelined_mb_per_s"] > 0
+    tls_ran = out["mtls_transport_mb_per_s"] is not None
+    if tls_ran:
+        # this image has the openssl CLI backend, so the suite must RUN
+        assert out["tls_cipher_policy"] in ("aes-gcm", "chacha20")
+        assert out["aesgcm_transport_mb_per_s"] > 0
+        assert out["chacha20_transport_mb_per_s"] > 0
+        # the reconnect-storm acceptance: ≥ 0.9 of post-first connects resume
+        assert out["tls_resumption_hit_rate"] >= 0.9
+        assert out["tls_handshake_full_ms"] > 0
+        # kTLS is a PROBE RESULT, never a number: structured null-report
+        assert set(out["ktls"]) == {"available", "reason"}
+        assert isinstance(out["ktls"]["available"], bool)
+    else:
+        # skipped => the whole TLS key set is null, no fabricated zeros
+        assert out["tls_overhead_pct"] is None
+        assert out["tls_resumption_hit_rate"] is None
+    if out["striped_speedup"] is not None:
+        # two rate-capped parents: striping must beat one parent's ceiling
+        # (the real acceptance bar of 1.3x is pinned by the full-shape
+        # bench; the tiny shape asserts direction, not magnitude)
+        assert out["stripe_parents_used"] == 2
+        assert out["striped_speedup"] > 1.1, out["striped_speedup"]
+    assert out["write_behind_decision"] in ("inline", "deferred", "measuring")
+    assert out["write_behind_mb_per_s_inline"] > 0
+    assert out["write_behind_mb_per_s_deferred"] > 0
+
+
 def test_payload_schema():
     line = bench._payload(1234.5, {"backend": "cpu"})
     d = json.loads(line)

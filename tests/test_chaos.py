@@ -565,3 +565,109 @@ class TestDisabledOverhead:
         fl = faultline.Faultline([], seed=0)
         data = b"q" * (1 << 20)
         assert fl.mutate("parent.piece_body", data) is data
+
+
+# ---------------------------------------------------------------------------
+# striped multi-parent fetch under faults (ISSUE 13 chaos satellite)
+
+
+class TestStripedFetchChaos:
+    """The striping + tail-steal machinery under the same contract as every
+    other fault class: COMPLETE, BIT-EXACT, and piece/byte accounting that
+    adds up exactly once (the PR 6 discipline — a re-striped or stolen piece
+    must never double-count DOWNLOAD_TRAFFIC_BYTES)."""
+
+    async def _two_seeded_parents(self, tmp_path, client, origin, payload):
+        e1 = make_engine(tmp_path, client, "stripe-p1")
+        e2 = make_engine(tmp_path, client, "stripe-p2")
+        await e1.start()
+        await e2.start()
+        await e1.download_task(origin.url("f.bin"))
+        await e2.download_task(origin.url("f.bin"))
+        return e1, e2
+
+    def _striped_child(self, tmp_path, client, engine, url):
+        from dragonfly2_tpu.daemon.conductor import PeerTaskConductor
+        from dragonfly2_tpu.daemon.source import SourceRegistry
+        from dragonfly2_tpu.daemon.storage import StorageManager
+
+        meta = engine.make_meta(url)
+        conductor = PeerTaskConductor(
+            peer_id="stripe-chaos-peer",
+            meta=meta,
+            host=HostInfo(id="stripe-chaos-host", ip="127.0.0.1", hostname="stripe-chaos"),
+            scheduler=client,
+            storage=StorageManager(tmp_path / "stripe-chaos-store"),
+            sources=SourceRegistry(),
+            config=fast_conductor(),
+        )
+        conductor.dispatcher.epsilon = 0.0  # deterministic stripes
+        return conductor
+
+    def test_parent_death_restripes_to_survivor(self, run, tmp_path, payload):
+        """One parent's upload server dies: its stripes fail (connection
+        refused), the parent is charged and the remainder re-stripes to the
+        survivor — bit-exact, bytes counted exactly once, and the survivor
+        served EVERYTHING."""
+
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            async with Origin({"f.bin": payload}) as origin:
+                url = origin.url("f.bin")
+                e1, e2 = await self._two_seeded_parents(tmp_path, client, origin, payload)
+                try:
+                    # e1 is dead on the wire but still registered as a
+                    # ready parent — the child only learns at fetch time,
+                    # mid-stripe, exactly like a crashed peer
+                    await e1.upload.stop()
+                    bytes0 = metrics.DOWNLOAD_BYTES.value
+                    served2_0 = e2.upload.bytes_served
+                    conductor = self._striped_child(tmp_path, client, e1, url)
+                    ts = await asyncio.wait_for(conductor.run(), 60)
+                    assert ts.is_complete()
+                    data = await ts.read_range(Range(0, ts.meta.content_length))
+                    assert data == payload
+                    # survivor carried every stripe; accounting exact
+                    assert conductor.pieces_by_parent == {
+                        next(iter(conductor.pieces_by_parent)): ts.meta.total_pieces
+                    }
+                    assert e2.upload.bytes_served - served2_0 == len(payload)
+                    assert metrics.DOWNLOAD_BYTES.value - bytes0 == len(payload)
+                finally:
+                    await e1.stop()
+                    await e2.stop()
+
+        run(body())
+
+    def test_corrupt_stripes_rejected_and_refetched(self, run, tmp_path, payload):
+        """Seeded bit-flips on piece bodies with striping live: corrupted
+        stripes are digest-rejected (charging whichever parent served them)
+        and refetched — bit-exact, DOWNLOAD bytes counted once per piece."""
+
+        async def body():
+            svc = SchedulerService()
+            client = InProcessSchedulerClient(svc)
+            async with Origin({"f.bin": payload}) as origin:
+                url = origin.url("f.bin")
+                e1, e2 = await self._two_seeded_parents(tmp_path, client, origin, payload)
+                try:
+                    bytes0 = metrics.DOWNLOAD_BYTES.value
+                    conductor = self._striped_child(tmp_path, client, e1, url)
+                    fl = faultline.enable("parent.piece_body:corrupt:0.5,seed=131")
+                    ts = await asyncio.wait_for(conductor.run(), 60)
+                    faultline.disable()
+                    assert fl.injected[("parent.piece_body", "corrupt")] >= 1
+                    data = await ts.read_range(Range(0, ts.meta.content_length))
+                    assert data == payload
+                    # successful lands only — corrupt attempts never counted
+                    assert metrics.DOWNLOAD_BYTES.value - bytes0 == len(payload)
+                    assert (
+                        sum(conductor.pieces_by_parent.values()) == ts.meta.total_pieces
+                    )
+                finally:
+                    faultline.disable()
+                    await e1.stop()
+                    await e2.stop()
+
+        run(body())

@@ -135,7 +135,12 @@ class TestCA:
                 )
                 out = await client.call("echo", {"x": 1})
                 assert out == {"echo": {"x": 1}}
+                # negotiated-posture introspection: a live mTLS connection
+                # reports its suite; a closed one reports None
+                info = client.tls_info()
+                assert info is not None and info["cipher"] and info["version"]
                 await client.close()
+                assert client.tls_info() is None
 
                 # a client without a cert is refused (mTLS force policy)
                 bare = RpcClient(

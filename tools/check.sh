@@ -86,6 +86,12 @@ run_stage "restart-smoke" env JAX_PLATFORMS=cpu python -m pytest tests/test_rest
     -m 'restart and not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+# stripe-smoke: cluster-in-a-box with mTLS ON — a hot multi-piece task
+# fetched striped across 2 parents' TLS upload servers over the real wire,
+# sha256 bit-exact, per-parent byte counters proving both parents served
+# stripes (ISSUE 13 data plane v2).
+run_stage "stripe-smoke" env JAX_PLATFORMS=cpu python tools/stripe_smoke.py
+
 # control-plane smoke: the bench section at tiny shapes — catches a broken
 # batched-report / cached-feature / coalesced-write path without paying for
 # a full bench run (the real numbers come from bench.py's control_plane key)
