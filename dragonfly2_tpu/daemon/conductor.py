@@ -437,7 +437,6 @@ class WriteBehindGovernor:
         self.write_s = 0.0
         self.samples = 0
         self.decided: bool | None = forced
-        self._exported = False
         if forced is not None:
             self._export("forced_deferred" if forced else "forced_inline")
 
@@ -475,7 +474,6 @@ class WriteBehindGovernor:
             metrics.WRITE_BEHIND_MODE.set(1.0 if m == mode else 0.0, mode=m)
         metrics.WRITE_BEHIND_STAGE_MS.set(round(self.recv_s * 1e3, 3), stage="recv")
         metrics.WRITE_BEHIND_STAGE_MS.set(round(self.write_s * 1e3, 3), stage="write")
-        self._exported = True
 
     def snapshot(self) -> dict:
         return {
@@ -1231,21 +1229,27 @@ class PeerTaskConductor:
         entry.stolen = True
         entry.steal_attempts += 1
         self.steals_attempted += 1
+        won = False
         try:
-            await self._download_one_piece(
+            # won = OUR fetch landed the piece and claimed its exactly-once
+            # attribution. has_piece alone is not a win test: the ORIGINAL
+            # can land and still be mid-accounting (task not done), and
+            # counting that as a steal win would both overstate steal
+            # efficacy and cancel the original's in-flight success report.
+            won = await self._download_one_piece(
                 session, entry.idx, exclude=frozenset((entry.parent_id,)),
                 inline_write=True,
             )
         except Exception as e:  # noqa: BLE001 — a failed steal must not kill
             # the worker loop (the original fetch still owns the piece)
             self.log.debug("tail steal of piece %d failed: %r", entry.idx, e)
-        landed = self.ts.has_piece(entry.idx)
         current = self._inflight.get(entry.idx)
-        if landed and current is entry and not entry.task.done():
-            # the steal landed while the original is still grinding: cut the
-            # loser loose (its cleanup releases its buffer; the worker sees
-            # the cancellation as "stolen" and moves on)
-            entry.task.cancel()
+        if won:
+            if current is entry and not entry.task.done():
+                # the steal landed while the original is still grinding: cut
+                # the loser loose (its cleanup releases its buffer; the
+                # worker sees the cancellation as "stolen" and moves on)
+                entry.task.cancel()
             self.steals_won += 1
             metrics.PIECE_STEALS_TOTAL.inc(won="true")
         else:
@@ -1345,11 +1349,13 @@ class PeerTaskConductor:
         exclude: frozenset = frozenset(),
         inflight: "_InflightFetch | None" = None,
         inline_write: bool = False,
-    ) -> None:
+    ) -> bool:
+        """Returns True when THIS fetch landed the piece and claimed its
+        attribution (False: no parent, failure, or another copy won)."""
         striped = self.cfg.striped_fetch and len(self.dispatcher.usable()) > 1
         state = self.dispatcher.pick(idx, striped=striped, exclude=exclude)
         if state is None:
-            return
+            return False
         if inflight is not None:
             inflight.parent_id = state.info.peer_id
         m = self.ts.meta
@@ -1375,7 +1381,7 @@ class PeerTaskConductor:
                 piece=idx, parent_peer=state.info.peer_id, bytes=r.length,
                 path="raw" if use_raw else "http",
             ) as piece_span:
-                await self._fetch_and_land_piece(
+                return await self._fetch_and_land_piece(
                     session, state, idx, r, path_qs, piece_timeout, t0,
                     use_raw, piece_span, inline_write=inline_write,
                 )
@@ -1385,7 +1391,7 @@ class PeerTaskConductor:
     async def _fetch_and_land_piece(
         self, session, state, idx, r, path_qs, piece_timeout, t0,
         use_raw, piece_span, *, inline_write: bool = False,
-    ) -> None:
+    ) -> bool:
         pooled = None
         digest = ""
         data = b""
@@ -1477,7 +1483,7 @@ class PeerTaskConductor:
             await self._record_piece_failure(
                 state, idx, (time.monotonic() - t0) * 1000, f"failed: {e}"
             )
-            return
+            return False
         cost = (time.monotonic() - t0) * 1000
         if self.ts.has_piece(idx):
             # another fetch of this piece landed while ours was on the wire
@@ -1486,7 +1492,7 @@ class PeerTaskConductor:
             # DOWNLOAD_TRAFFIC_BYTES and re-hash a finished piece
             if pooled is not None:
                 pooled.release()
-            return
+            return False
         expected = self._piece_digests.get(str(idx), "")
         if not expected:
             self._pieces_unverified += 1
@@ -1500,30 +1506,29 @@ class PeerTaskConductor:
                     state, idx, cost,
                     f"corrupt: digest {digest[:12]} != {expected[:12]}", corrupt=True,
                 )
-                return
+                return False
             # the store write runs on a worker thread either way
             # (write_piece_view offloads big writes); deferring additionally
             # lets THIS worker recycle a fresh buffer into recv before the
             # write lands — the governor decides at runtime, see
             # ConductorConfig.defer_piece_writes for the measured trade-off.
             # Steal fetches force INLINE (`inline_write`): the stealer's
-            # win test is has_piece right after its fetch returns, and a
+            # win test is whether its own chain claimed attribution, and a
             # spawned write would make every deferred-mode steal read as a
             # loss — never cancelling the slow loser and re-stealing the
             # same piece until its cap.
             if self._write_behind.defer and not inline_write:
                 self._spawn_piece_write(state, idx, pooled, digest, cost, r.length)
-            else:
-                await self._write_fetched_piece(
-                    state, idx, pooled, digest, cost, r.length, recv_s=recv_s
-                )
-            return
+                return False  # outcome unknowable here; only steals need it
+            return await self._write_fetched_piece(
+                state, idx, pooled, digest, cost, r.length, recv_s=recv_s
+            )
         try:
             await self.ts.write_piece(idx, data, expected_digest=expected)
         except (ValueError, digestlib.InvalidDigestError) as e:
             await self._record_piece_failure(state, idx, cost, f"corrupt: {e}", corrupt=True)
-            return
-        await self._account_piece_success(state, idx, cost, len(data))
+            return False
+        return await self._account_piece_success(state, idx, cost, len(data))
 
     async def _record_piece_failure(
         self, state, idx, cost, why: str, *, corrupt: bool = False
@@ -1547,12 +1552,13 @@ class PeerTaskConductor:
 
     async def _write_fetched_piece(
         self, state, idx, pooled, digest, cost, length, recv_s: float = 0.0
-    ) -> None:
+    ) -> bool:
         """Land a digest-verified pooled buffer in storage (writer side of
         the recv/hash/write overlap; awaited inline or spawned per the
         write-behind decision). A write failure leaves the piece's bitset
         bit unset, so the dispatch loop refetches it — the same bounded
-        recovery the worker-level re-enqueue gives small-piece writes."""
+        recovery the worker-level re-enqueue gives small-piece writes.
+        Returns True when this write claimed the piece's attribution."""
         try:
             try:
                 measuring = self._write_behind.measuring
@@ -1578,7 +1584,7 @@ class PeerTaskConductor:
                     "piece %d deferred write failed (attempt %d), will refetch: %r",
                     idx, n, e,
                 )
-                return
+                return False
             self.log.warning("piece %d failed past the write-retry budget", idx,
                              exc_info=True)
             try:
@@ -1586,10 +1592,14 @@ class PeerTaskConductor:
             except Exception as report_err:  # noqa: BLE001 — best-effort advisory;
                 # the dispatch loop re-sees the piece anyway
                 self.log.debug("piece %d failure report failed: %r", idx, report_err)
-            return
-        await self._account_piece_success(state, idx, cost, length)
+            return False
+        return await self._account_piece_success(state, idx, cost, length)
 
-    async def _account_piece_success(self, state, idx, cost, length) -> None:
+    async def _account_piece_success(self, state, idx, cost, length) -> bool:
+        """Returns True when THIS call claimed the piece's (exactly-once)
+        attribution — the signal `_steal_piece` uses to decide whether its
+        fetch actually won the race or merely observed the other copy's
+        landing."""
         # the serving parent earns its success/cost sample either way — it
         # DID deliver valid bytes, even if another copy landed first
         state.record(True, cost)
@@ -1598,7 +1608,7 @@ class PeerTaskConductor:
             # the write, both callers got success): bytes, metrics, and the
             # scheduler report must count EXACTLY once — the first copy to
             # reach accounting wins attribution.
-            return
+            return False
         self._accounted.add(idx)
         self.bytes_from_parents += length
         pid = state.info.peer_id
@@ -1608,6 +1618,7 @@ class PeerTaskConductor:
         metrics.PIECE_DOWNLOAD_TOTAL.inc(source="parent")
         metrics.DOWNLOAD_BYTES.inc(length)
         await self._report_piece_success(idx, cost, pid)
+        return True
 
     async def _report_piece_success(self, idx: int, cost_ms: float, parent_id: str = "") -> None:
         """Success-report fast path: enqueue into the batch buffer (sync, no

@@ -69,6 +69,12 @@ _MAX_IDLE_PER_HOST = 4
 # TLS bodies at/above this ride the worker-thread drain (recv+decrypt off
 # the loop); below it the thread hop costs more than it overlaps
 _TLS_THREADED_BODY_BYTES = 256 << 10
+# idle bound armed on the drain's blocking socket (per-recv, not total):
+# a parent that stalls mid-body fails the drain this fast, so it cannot
+# hold the client-wide _drain_sem for a full piece timeout while waiters'
+# own piece timers expire and falsely charge their healthy parents — and
+# a blocked worker thread always self-unblocks even if no close arrives
+_TLS_DRAIN_IDLE_TIMEOUT_S = 5.0
 # pooled sockets older than this are assumed dead (peer upload servers close
 # idle keep-alive connections after ~75 s) and are discarded at checkout /
 # pruned periodically rather than tried
@@ -231,7 +237,8 @@ class RawRangeClient:
         try:
             await asyncio.wait_for(
                 self._get_with_pool(
-                    ip, port, path_qs, range_header, view, on_chunk, fault_point
+                    ip, port, path_qs, range_header, view, on_chunk, fault_point,
+                    timeout,
                 ),
                 timeout,
             )
@@ -249,6 +256,7 @@ class RawRangeClient:
         view: memoryview,
         on_chunk: "Callable[[int], None] | None",
         fault_point: str | None,
+        timeout: float,
     ) -> None:
         # Transparent retries ONLY for pooled sockets that turn out to be
         # stale keep-alive connections: server closed them between uses →
@@ -287,7 +295,7 @@ class RawRangeClient:
                         raise
                 await self._request(
                     transport, key, ip, port, path_qs, range_header,
-                    view, on_chunk, fault_point, got_response,
+                    view, on_chunk, fault_point, got_response, timeout,
                 )
                 return
             except BaseException as e:
@@ -362,6 +370,7 @@ class RawRangeClient:
         on_chunk: "Callable[[int], None] | None",
         fault_point: str | None,
         got_response: list,
+        timeout: float,
     ) -> None:
         length = len(view)
         host = f"[{ip}]" if ":" in ip else ip
@@ -448,7 +457,14 @@ class RawRangeClient:
                     on_chunk(new)
 
             async with self._drain_sem:
-                off = await transport.recv_body_into(view, off, on_bytes=_on_bytes)
+                # the idle bound (not the full piece timeout) arms the
+                # worker's socket timeout: a stalled parent releases the
+                # semaphore in seconds, and the worker thread can never
+                # outlive its caller by more than the idle window
+                off = await transport.recv_body_into(
+                    view, off, on_bytes=_on_bytes,
+                    timeout=min(timeout, _TLS_DRAIN_IDLE_TIMEOUT_S),
+                )
         while off < length:
             n = await transport.recv_into(view[off:])
             if n == 0:

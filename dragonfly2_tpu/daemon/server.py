@@ -44,6 +44,25 @@ class DaemonRpcAdapter:
                 rng = (int(start_s), int(end_s))
             except ValueError:
                 raise RpcError(f"bad range {rng_s!r}: want START-END", code="bad_request")
+        import math
+
+        try:
+            # tenant priority: the task's weight in the host traffic
+            # shaper (dfget/dfstress mixed-tenant load) — client-supplied,
+            # so a non-numeric value is the CLIENT's error, not an internal
+            # fault the caller should retry. Finite and positive only: an
+            # inf/nan weight poisons the shaper's weighted-share math for
+            # EVERY tenant, and a zero/negative one would be silently
+            # clamped to near-starvation instead of doing what the client
+            # plausibly meant.
+            priority = float(p.get("priority", 1.0))
+            if not math.isfinite(priority) or priority <= 0:
+                raise ValueError
+        except (TypeError, ValueError):
+            raise RpcError(
+                f"bad priority {p.get('priority')!r}: want a finite number > 0",
+                code="bad_request",
+            )
         try:
             ts = await self.engine.download_task(
                 p["url"],
@@ -54,9 +73,7 @@ class DaemonRpcAdapter:
                 digest=p.get("digest", ""),
                 filters=tuple(p.get("filters", ())),
                 headers=p.get("headers") or None,
-                # tenant priority: the task's weight in the host traffic
-                # shaper (dfget/dfstress mixed-tenant load)
-                priority=float(p.get("priority", 1.0)),
+                priority=priority,
             )
         except RangeOutOfBounds as e:
             # ONLY the bounds check maps to bad_request — an internal

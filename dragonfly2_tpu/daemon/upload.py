@@ -44,6 +44,10 @@ from dragonfly2_tpu.utils.ratelimit import TokenBucket
 logger = logging.getLogger(__name__)
 
 _MAX_REQUEST_HEAD = 16 << 10
+# idle bound armed on the threaded TLS body send (per-sendall): a client
+# that stops reading cannot park a worker thread in send(2) forever —
+# shutdown-on-close wakes it, this self-unblocks it even without a close
+_TLS_SEND_TIMEOUT_S = 30.0
 
 _REASONS = {200: "OK", 206: "Partial Content", 400: "Bad Request",
             404: "Not Found", 416: "Range Not Satisfiable", 500: "Internal Server Error"}
@@ -420,7 +424,8 @@ class UploadServer:
     # ---- raw TLS server (module docstring: the mTLS piece plane) ----
 
     async def _start_tls_raw(self) -> None:
-        lsock = socketlib.socket(socketlib.AF_INET, socketlib.SOCK_STREAM)
+        family = socketlib.AF_INET6 if ":" in self.host else socketlib.AF_INET
+        lsock = socketlib.socket(family, socketlib.SOCK_STREAM)
         lsock.setsockopt(socketlib.SOL_SOCKET, socketlib.SO_REUSEADDR, 1)
         lsock.bind((self.host, self.port))
         lsock.listen(128)
@@ -431,14 +436,29 @@ class UploadServer:
         logger.info("upload server on %s:%d (mTLS, raw)", self.host, self.port)
 
     async def _tls_accept_loop(self) -> None:
+        from dragonfly2_tpu.resilience.backoff import BackoffPolicy
+
         loop = asyncio.get_running_loop()
+        # transient-accept pacing: fd pressure clears in ms, so start small
+        backoff = BackoffPolicy(base=0.05, multiplier=2.0, max_delay=1.0, jitter=0.3)
+        accept_failures = 0
         while True:
             try:
                 conn, _addr = await loop.sock_accept(self._tls_lsock)
+                accept_failures = 0
             except asyncio.CancelledError:
                 return
-            except OSError:
-                return  # listener closed under us (stop())
+            except OSError as e:
+                if self._tls_lsock.fileno() < 0:
+                    return  # listener closed under us (stop())
+                # transient accept failure (ECONNABORTED, EMFILE/ENFILE
+                # under fd pressure): the listener is still live and bound —
+                # returning here would silently stop accepting piece
+                # connections forever while clients hang on the backlog
+                logger.warning("TLS piece-server accept failed, retrying: %r", e)
+                await backoff.sleep(accept_failures)
+                accept_failures += 1
+                continue
             conn.setblocking(False)
             conn.setsockopt(socketlib.IPPROTO_TCP, socketlib.TCP_NODELAY, 1)
             # deeper kernel pipeline: encrypt-ahead depth for the send path
@@ -460,7 +480,34 @@ class UploadServer:
             return
         try:
             while True:
-                req = await self._tls_read_request(tr)
+                try:
+                    req = await self._tls_read_request(tr)
+                except _HttpError as e:
+                    # malformed head (oversized, non-GET, bad request line):
+                    # tell the client why, then drop the connection — the
+                    # request framing may be desynced past recovery
+                    await self._tls_send_simple(
+                        tr, e.status, e.text.encode(), connection="close"
+                    )
+                    # drain what the client already sent (a POST body, the
+                    # rest of an oversized head) before closing: close()
+                    # with unread bytes queued answers with RST, which can
+                    # destroy the in-flight 400 before the client reads it.
+                    # Bounded in bytes, per-read idle, AND total wall-clock
+                    # — a client that streams (or trickles) forever gets cut
+                    # off, response delivered or not
+                    try:
+                        loop = asyncio.get_running_loop()
+                        deadline = loop.time() + 2.0
+                        drained = 0
+                        while drained < (1 << 20) and loop.time() < deadline:
+                            chunk = await asyncio.wait_for(tr.recv(8192), 0.5)
+                            if not chunk:
+                                break  # client read the 400 and closed
+                            drained += len(chunk)
+                    except (asyncio.TimeoutError, ConnectionError, OSError):
+                        pass
+                    return
                 if req is None:
                     return  # clean keep-alive close
                 path, query, headers = req
@@ -508,13 +555,14 @@ class UploadServer:
         return unquote(path), query, headers
 
     async def _tls_send_simple(
-        self, tr, status: int, body: bytes, content_type: str = "text/plain"
+        self, tr, status: int, body: bytes, content_type: str = "text/plain",
+        connection: str = "keep-alive",
     ) -> None:
         head = (
             f"HTTP/1.1 {status} {_REASONS.get(status, 'OK')}\r\n"
             f"Content-Type: {content_type}\r\n"
             f"Content-Length: {len(body)}\r\n"
-            "Connection: keep-alive\r\n"
+            f"Connection: {connection}\r\n"
             "\r\n"
         ).encode("ascii")
         await tr.sendall(head + body)
@@ -611,7 +659,10 @@ class UploadServer:
                 "Connection: keep-alive\r\n"
                 "\r\n"
             ).encode("ascii")
-            await tr.send_file_range(ts.data_path, rng.start, rng.length, head=head)
+            await tr.send_file_range(
+                ts.data_path, rng.start, rng.length, head=head,
+                timeout=_TLS_SEND_TIMEOUT_S,
+            )
         finally:
             ts.unpin()
             if span is not None:

@@ -95,8 +95,6 @@ def cipher_policy(force: str | None = None) -> str:
     /proc/cpuinfo AES flag → aes-gcm default. Composition roots that hold
     certs refine this with measure_cipher_rates() (the microbench beats the
     flag when they disagree)."""
-    import os
-
     choice = force or os.environ.get("DRAGONFLY_PIECE_CIPHER", "")
     if choice:
         if choice not in CIPHER_STRINGS:
@@ -156,15 +154,33 @@ def probe_ktls() -> dict:
             "reason": "ssl module lacks OP_ENABLE_KTLS (needs Python 3.12+/OpenSSL 3)",
         }
     # kernel side: attaching the tls ULP to a TCP socket is the definitive
-    # probe (the module may be absent or the kernel predates it — 4.13+)
+    # probe (the module may be absent or the kernel predates it — 4.13+).
+    # tls_init requires TCP_ESTABLISHED (an unconnected socket gets ENOTCONN
+    # even on capable kernels — a false negative), so probe over a loopback-
+    # connected pair.
     tcp_ulp = getattr(socket, "TCP_ULP", 31)  # TCP_ULP is 31 since Linux 4.13
-    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    csock = asock = None
     try:
-        s.setsockopt(socket.IPPROTO_TCP, tcp_ulp, b"tls")
+        lsock.bind(("127.0.0.1", 0))
+        lsock.listen(1)
+        csock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        csock.connect(lsock.getsockname())
+        asock, _ = lsock.accept()
+        try:
+            csock.setsockopt(socket.IPPROTO_TCP, tcp_ulp, b"tls")
+        except OSError as e:
+            return {
+                "available": False,
+                "reason": f"kernel tls ULP unavailable ({e.strerror})",
+            }
     except OSError as e:
-        return {"available": False, "reason": f"kernel tls ULP unavailable ({e.strerror})"}
+        # loopback itself unusable (sandbox): can't tell — report honestly
+        return {"available": False, "reason": f"kTLS probe setup failed ({e.strerror})"}
     finally:
-        s.close()
+        for s in (csock, asock, lsock):
+            if s is not None:
+                s.close()
     return {"available": True, "reason": "kernel tls ULP + OP_ENABLE_KTLS present"}
 
 
@@ -176,8 +192,6 @@ def measure_cipher_rates(
     batches. Returns {"aes-gcm": MB/s, "chacha20": MB/s, "picked": policy}.
     ~10 ms total — composition roots run it once at data-plane context build
     and let the measurement override the cpuinfo prior."""
-    import os
-
     payload = os.urandom(256 << 10)
     rates: dict[str, float] = {}
     for policy in CIPHER_STRINGS:
@@ -248,23 +262,6 @@ class TlsSessionCache:
         return len(self._sessions)
 
 
-def _watch_fd(loop, fd: int, *, write: bool = False) -> asyncio.Future:
-    """Future resolving when fd is readable/writable; the done callback
-    (firing on resolution AND cancellation) always detaches the watcher."""
-    fut = loop.create_future()
-
-    def _arm() -> None:
-        fut.set_result(None)
-
-    if write:
-        loop.add_writer(fd, _arm)
-        fut.add_done_callback(lambda _f: loop.remove_writer(fd))
-    else:
-        loop.add_reader(fd, _arm)
-        fut.add_done_callback(lambda _f: loop.remove_reader(fd))
-    return fut
-
-
 class AsyncPlainTransport:
     """The no-TLS side of the transport seam: thin delegation to the loop's
     sock_* fast paths so daemon/rawrange.py speaks one API either way (the
@@ -307,6 +304,7 @@ class AsyncTlsTransport:
 
     __slots__ = (
         "_sock", "_loop", "_obj", "_inc", "_out", "_ct", "_ctv", "session_reused",
+        "_worker_busy",
     )
     tls = True
 
@@ -319,6 +317,9 @@ class AsyncTlsTransport:
         self._ct = bytearray(CT_CHUNK)
         self._ctv = memoryview(self._ct)
         self.session_reused = False
+        # True while a recv_body_into/send_file_range worker thread owns the
+        # SSLObject; close() must not touch OpenSSL state while it is set
+        self._worker_busy = False
 
     # ---- construction ----
 
@@ -434,11 +435,14 @@ class AsyncTlsTransport:
         the loop this path exists to keep in C; HashPump.feed batches at the
         same granularity anyway). Both known consumers — the hash pump and
         the faultline first-body hook — are thread-safe single-producer
-        calls. Cancellation contract: the
-        caller's timeout path closes the socket (rawrange's failure handler
-        already does), which unblocks the worker immediately; `timeout` also
-        arms SO_RCVTIMEO as a belt-and-braces self-unblock. Raises IOError
-        on EOF/timeout short of the full body."""
+        calls. Cancellation contract: the caller's timeout path closes the
+        transport (rawrange's failure handler already does), whose
+        shutdown(2) unblocks a worker mid-recv immediately; `timeout`
+        additionally arms the socket timeout as a belt-and-braces
+        self-unblock — it bounds each recv call (IDLE time, not total drain
+        time), so a parent that stalls mid-body fails the drain within
+        `timeout` seconds even if no close ever arrives. Raises IOError on
+        EOF/timeout short of the full body."""
         loop = asyncio.get_running_loop()
         sock = self._sock
         obj = self._obj
@@ -456,10 +460,10 @@ class AsyncTlsTransport:
             # GIL-held time stolen from every other thread
             obj_read = obj.read
             want_read = ssl.SSLWantReadError
-            sock.setblocking(True)
-            if timeout is not None:
-                sock.settimeout(timeout)
             try:
+                sock.setblocking(True)
+                if timeout is not None:
+                    sock.settimeout(timeout)
                 while o < total:
                     try:
                         n = obj_read(total - o, view[o:])
@@ -487,11 +491,13 @@ class AsyncTlsTransport:
                     inc.write(ctv[:r])
                 return o
             finally:
+                self._worker_busy = False
                 try:
                     sock.setblocking(False)
                 except OSError:
                     pass  # closed under us mid-drain: the error already raised
 
+        self._worker_busy = True  # set before the hop: no await in between
         fut = loop.run_in_executor(None, work)
         # a cancelled caller (piece timeout) abandons the future; the close()
         # that follows unblocks the worker, whose IOError must not spam the
@@ -544,38 +550,43 @@ class AsyncTlsTransport:
         out = self._out
 
         def work() -> None:
-            buf = bytearray(chunk_bytes)
-            mv = memoryview(buf)
-            fd = os.open(path, os.O_RDONLY)
-            sock.setblocking(True)
-            if timeout is not None:
-                sock.settimeout(timeout)
             try:
-                if head:
-                    obj.write(head)
-                remaining = length
-                off = offset
-                while remaining > 0:
-                    want = min(chunk_bytes, remaining)
-                    got = 0
-                    while got < want:
-                        n = os.preadv(fd, [mv[got:want]], off + got)
-                        if n == 0:
-                            raise IOError(f"{path} truncated at {off + got}")
-                        got += n
-                    obj.write(mv[:got])
-                    sock.sendall(out.read())
-                    off += got
-                    remaining -= got
-                if length == 0 and head:
-                    sock.sendall(out.read())
-            finally:
-                os.close(fd)
+                buf = bytearray(chunk_bytes)
+                mv = memoryview(buf)
+                fd = os.open(path, os.O_RDONLY)
                 try:
-                    sock.setblocking(False)
-                except OSError:
-                    pass  # closed under us: the send error already raised
+                    sock.setblocking(True)
+                    if timeout is not None:
+                        sock.settimeout(timeout)
+                    if head:
+                        obj.write(head)
+                    remaining = length
+                    off = offset
+                    while remaining > 0:
+                        want = min(chunk_bytes, remaining)
+                        got = 0
+                        while got < want:
+                            n = os.preadv(fd, [mv[got:want]], off + got)
+                            if n == 0:
+                                raise IOError(f"{path} truncated at {off + got}")
+                            got += n
+                        obj.write(mv[:got])
+                        sock.sendall(out.read())
+                        off += got
+                        remaining -= got
+                    if length == 0 and head:
+                        sock.sendall(out.read())
+                finally:
+                    os.close(fd)
+                    try:
+                        sock.setblocking(False)
+                    except OSError:
+                        pass  # closed under us: the send error already raised
+            finally:
+                # outermost so even a failed os.open releases the flag
+                self._worker_busy = False
 
+        self._worker_busy = True  # set before the hop: no await in between
         fut = loop.run_in_executor(None, work)
         # cancelled callers abandon the future; the socket close that
         # follows unblocks the worker, whose error must not hit the loop's
@@ -594,15 +605,28 @@ class AsyncTlsTransport:
 
     def close(self) -> None:
         # best-effort close_notify: encrypt the alert if the state machine
-        # allows and push it with a non-blocking send; never block a close
+        # allows and push it with a non-blocking send; never block a close.
+        # NEVER while a worker thread owns the SSLObject though — OpenSSL
+        # objects are not thread-safe and the worker may be inside read()/
+        # write() with the GIL released; there the raw shutdown below is the
+        # whole close (the peer sees an abortive close, which the framing's
+        # length checks already treat as truncation).
+        if not self._worker_busy:
+            try:
+                self._obj.unwrap()
+            except (ssl.SSLError, OSError, ValueError):
+                pass
+            try:
+                pending = self._out.read()
+                if pending:
+                    self._sock.send(pending)
+            except OSError:
+                pass
+        # shutdown(2) before close: close() alone does NOT wake another
+        # thread blocked in recv(2)/send(2) on this fd — shutdown does,
+        # immediately, on both the drain and serve worker paths
         try:
-            self._obj.unwrap()
-        except (ssl.SSLError, OSError, ValueError):
-            pass
-        try:
-            pending = self._out.read()
-            if pending:
-                self._sock.send(pending)
+            self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
         self._sock.close()

@@ -219,6 +219,89 @@ class TestAsyncTlsTransport:
 
         run(main())
 
+    def test_close_unblocks_threaded_drain(self, run, certs):
+        """The piece-timeout contract: close() from the loop thread must
+        wake a drain worker blocked in recv(2) on a stalled parent — close
+        alone does not on Linux; the shutdown(2) inside close() does. A
+        regression here leaks one executor thread per stalled-parent timeout
+        until the default pool is exhausted daemon-wide."""
+        import time
+
+        srv_ctx, cli_ctx = self._ctxs(certs)
+
+        async def main():
+            ls = socket.socket()
+            ls.bind(("127.0.0.1", 0))
+            ls.listen(1)
+            ls.setblocking(False)
+            port = ls.getsockname()[1]
+            stall = asyncio.Event()
+
+            async def serve():
+                t = await _accept_one(ls, srv_ctx)
+                await t.sendall(b"x" * 1024)  # partial body, then stall
+                await stall.wait()
+                t.close()
+
+            server_task = asyncio.ensure_future(serve())
+            loop = asyncio.get_running_loop()
+            s = socket.socket()
+            s.setblocking(False)
+            await loop.sock_connect(s, ("127.0.0.1", port))
+            t = await tport.AsyncTlsTransport.connect(s, cli_ctx)
+            buf = bytearray(1 << 20)  # wants far more than the server sends
+            drain = asyncio.ensure_future(
+                t.recv_body_into(memoryview(buf), 0)  # no timeout: only close can wake it
+            )
+            await asyncio.sleep(0.2)  # worker drains the 1 KiB, blocks in recv
+            t0 = time.monotonic()
+            t.close()
+            with pytest.raises(IOError):
+                await drain
+            assert time.monotonic() - t0 < 2.0  # woke immediately, no hang
+            stall.set()
+            await server_task
+            ls.close()
+
+        run(main())
+
+    def test_drain_idle_timeout_self_unblocks(self, run, certs):
+        """Belt-and-braces leg: even with no close() ever arriving, the
+        armed socket timeout fails the drain after the idle bound, so a
+        worker can never outlive its caller indefinitely (and the client's
+        drain semaphore is released on the same clock)."""
+        srv_ctx, cli_ctx = self._ctxs(certs)
+
+        async def main():
+            ls = socket.socket()
+            ls.bind(("127.0.0.1", 0))
+            ls.listen(1)
+            ls.setblocking(False)
+            port = ls.getsockname()[1]
+            stall = asyncio.Event()
+
+            async def serve():
+                t = await _accept_one(ls, srv_ctx)
+                await t.sendall(b"x" * 1024)
+                await stall.wait()
+                t.close()
+
+            server_task = asyncio.ensure_future(serve())
+            loop = asyncio.get_running_loop()
+            s = socket.socket()
+            s.setblocking(False)
+            await loop.sock_connect(s, ("127.0.0.1", port))
+            t = await tport.AsyncTlsTransport.connect(s, cli_ctx)
+            buf = bytearray(1 << 20)
+            with pytest.raises(IOError, match="timed out"):
+                await t.recv_body_into(memoryview(buf), 0, timeout=0.3)
+            t.close()
+            stall.set()
+            await server_task
+            ls.close()
+
+        run(main())
+
 
 # ---------------------------------------------------------------------------
 # rawrange + upload server over mTLS
@@ -320,6 +403,50 @@ class TestTlsPiecePath:
                     )
             finally:
                 await client.close()
+                await srv.stop()
+
+        run(main())
+
+    def test_malformed_request_answered_400_then_closed(self, run, tmp_path, data_tls, payload):
+        """A bad request line must come back as an HTTP 400 over the wire —
+        not a silent drop with a server-side traceback — and the connection
+        closes after it (the framing may be desynced past recovery)."""
+
+        async def main():
+            sm, task_id = _register_payload_task(tmp_path / "srv400", payload)
+            ts = sm.get(task_id)
+            await ts.write_piece(0, payload[: ts.meta.piece_size])
+            srv = UploadServer(sm, tls=data_tls.server_ctx)
+            await srv.start()
+            loop = asyncio.get_running_loop()
+            s = socket.socket()
+            s.setblocking(False)
+            await loop.sock_connect(s, ("127.0.0.1", srv.port))
+            t = await tport.AsyncTlsTransport.connect(s, data_tls.client_ctx)
+            try:
+                # a POST with a BODY: the unread body bytes queued server-
+                # side are the RST trap — close() without draining them
+                # would destroy the 400 in flight
+                await t.sendall(
+                    b"POST /download/abc/abc123task HTTP/1.1\r\n"
+                    b"Content-Length: 65536\r\n\r\n" + b"p" * 65536
+                )
+                resp = bytearray()
+                while b"\r\n\r\n" not in resp:
+                    chunk = await t.recv(4096)
+                    if not chunk:
+                        break
+                    resp += chunk
+                assert resp.startswith(b"HTTP/1.1 400")
+                assert b"connection: close" in resp.lower()
+                # server drops the connection after the error response: the
+                # stream drains to EOF rather than waiting for a next request
+                while True:
+                    chunk = await asyncio.wait_for(t.recv(4096), 5.0)
+                    if not chunk:
+                        break
+            finally:
+                t.close()
                 await srv.stop()
 
         run(main())

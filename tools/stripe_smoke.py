@@ -79,7 +79,10 @@ async def main() -> int:
             scheduler=client,
             storage=StorageManager(os.path.join(td, "child")),
             sources=SourceRegistry(),
-            config=ConductorConfig(metadata_poll_interval=0.02),
+            # tail_steal off: a steal DELIBERATELY double-fetches a slow tail
+            # piece, which would trip the exact-served-bytes gate below on a
+            # loaded box even though the system behaved as designed
+            config=ConductorConfig(metadata_poll_interval=0.02, tail_steal=False),
             data_tls=tls,
         )
         conductor.dispatcher.epsilon = 0.0  # deterministic stripes for the gate
