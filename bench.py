@@ -35,12 +35,12 @@ import numpy as np
 
 _SECTION_TIMEOUT_S = int(os.environ.get("DF_BENCH_SECTION_TIMEOUT", "420"))
 _PROBE_TIMEOUT_S = int(os.environ.get("DF_BENCH_PROBE_TIMEOUT", "240"))
-# The worker must outlive its own worst case: eleven SIGALRM-bounded sections
-# plus backend init/compile margin — otherwise the supervisor would kill it
-# and discard sections that did complete.
+# The worker must outlive its own worst case: fourteen SIGALRM-bounded
+# sections plus backend init/compile margin — otherwise the supervisor would
+# kill it and discard sections that did complete.
 _WORKER_TIMEOUT_S = max(
     int(os.environ.get("DF_BENCH_WORKER_TIMEOUT", "1500")),
-    13 * _SECTION_TIMEOUT_S + _PROBE_TIMEOUT_S + 120,
+    14 * _SECTION_TIMEOUT_S + _PROBE_TIMEOUT_S + 120,
 )
 
 
@@ -2394,6 +2394,87 @@ def bench_metrics_plane(rounds: int = 1200, sample_probes: int = 50) -> dict:
     return out
 
 
+def bench_swarm_sim(
+    wall_budget_s: float = 25.0,
+    start_peers: int = 4_000,
+    max_peers: int = 64_000,
+) -> dict:
+    """Swarm-simulator throughput + the scenario-level properties (ISSUE 14
+    14th section): how many peers the discrete-event engine can simulate
+    against the REAL scheduler/evaluator/federation objects inside a wall
+    budget, at what events/s, with the flash-crowd cluster properties
+    reported alongside.
+
+      swarm_sim_events_per_sec        engine throughput (real control-plane
+                                      work per event: scheduling rounds,
+                                      batched piece reports, gossip)
+      swarm_sim_peers                 peers simulated in the largest rung
+                                      that fit the wall budget (ladder:
+                                      doubles from start_peers)
+      swarm_sim_time_compression      virtual seconds per wall second
+      swarm_sim_flash_origin_egress_ratio
+                                      max over regions of origin bytes /
+                                      task size — the O(1)-egress property
+                                      (a number NEAR 1.0 means the crowd hit
+                                      the origin ~once per region)
+      swarm_sim_same_region_frac      placement locality at scheduling time
+      swarm_sim_completed_frac        peers that finished their download
+      swarm_sim_fed_convergence_virtual_s
+                                      virtual time until EVERY ring member
+                                      held federation-merged remote edges
+
+    Nulls (never 0.0) when a rung/leg fails, per the PR 6 hygiene rule."""
+    out: dict = {
+        "swarm_sim_events_per_sec": None,
+        "swarm_sim_peers": None,
+        "swarm_sim_events": None,
+        "swarm_sim_wall_s": None,
+        "swarm_sim_virtual_s": None,
+        "swarm_sim_time_compression": None,
+        "swarm_sim_flash_origin_egress_ratio": None,
+        "swarm_sim_same_region_frac": None,
+        "swarm_sim_completed_frac": None,
+        "swarm_sim_fed_convergence_virtual_s": None,
+        "swarm_sim_wall_budget_s": wall_budget_s,
+    }
+    try:
+        from dragonfly2_tpu.sim.scenarios import flash_crowd
+
+        best = None
+        peers = start_peers
+        spent = 0.0
+        while True:
+            sc = flash_crowd(peers=peers, telemetry_dir=None)
+            try:
+                rep = sc.sim.run()
+                sc.check(rep)
+            finally:
+                sc.sim.close()
+            best = (peers, rep, sc.content_length)
+            spent += rep.wall_s
+            # double while the NEXT rung (≈2x wall) still fits the budget
+            if peers >= max_peers or spent + 2.0 * rep.wall_s > wall_budget_s:
+                break
+            peers *= 2
+        peers, rep, content = best
+        out["swarm_sim_events_per_sec"] = rep.events_per_sec
+        out["swarm_sim_peers"] = peers
+        out["swarm_sim_events"] = rep.events
+        out["swarm_sim_wall_s"] = rep.wall_s
+        out["swarm_sim_virtual_s"] = rep.virtual_s
+        out["swarm_sim_time_compression"] = rep.time_compression
+        out["swarm_sim_flash_origin_egress_ratio"] = round(
+            max(rep.origin_egress_bytes.values(), default=0) / content, 3
+        )
+        out["swarm_sim_same_region_frac"] = rep.same_region_frac
+        out["swarm_sim_completed_frac"] = round(rep.completed / max(rep.peers, 1), 4)
+        fed = rep.federation or {}
+        out["swarm_sim_fed_convergence_virtual_s"] = fed.get("first_remote_edge_s")
+    except Exception as e:  # noqa: BLE001 — section skipped, keys stay null
+        print(f"bench: swarm_sim section failed: {e!r}", file=sys.stderr)
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -2442,6 +2523,7 @@ def main() -> None:
     observability = run_section("observability", bench_observability, {})
     metrics_plane = run_section("metrics_plane", bench_metrics_plane, {})
     federation = run_section("federation", bench_federation, {})
+    swarm_sim = run_section("swarm_sim", bench_swarm_sim, {})
     mlp_sps, mlp_mse = run_section("mlp_train", bench_mlp_train, (None, None))
     serving = run_section("evaluator_serving", bench_evaluator_serving, {})
     # headline = the production serving path: native C++ scorer when the
@@ -2534,6 +2616,12 @@ def main() -> None:
         "federation_swarm_rounds_per_sec": federation.get("swarm_rps_2sched"),
         "federation_sync_convergence_ms": federation.get("sync_convergence_ms"),
         "federation": federation or "skipped",
+        # discrete-event swarm simulator (ISSUE 14): peers simulated against
+        # the real control plane inside the wall budget, events/s, and the
+        # flash-crowd origin-egress / federation-convergence properties
+        "swarm_sim_events_per_sec": swarm_sim.get("swarm_sim_events_per_sec"),
+        "swarm_sim_peers": swarm_sim.get("swarm_sim_peers"),
+        "swarm_sim": swarm_sim or "skipped",
         "backend": backend,
         **serving,
     }

@@ -73,6 +73,9 @@ class InProcessSchedulerClient:
     async def leave_host(self, host_id):
         self._svc.leave_host(host_id)
 
+    async def announce_host(self, host, stats=None):
+        self._svc.announce_host(host, stats)
+
     async def sync_probes(self, host_id, results):
         return self._svc.sync_probes(host_id, results)
 

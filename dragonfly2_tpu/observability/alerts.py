@@ -133,6 +133,20 @@ def default_rules() -> list[AlertRule]:
             description="any federation sync errors sustained in the window",
         ),
         AlertRule(
+            name="sim_departed_parent",
+            kind="rate",
+            metric="dragonfly_sim_departed_parent_rounds_total",
+            bound=0.0, window_s=60.0,
+            # an INVARIANT alert, not an SLO: a scheduling round handing out
+            # a peer that cleanly left the cluster is wrong at any rate. The
+            # family only exists in processes that import the simulator
+            # (dragonfly2_tpu.sim.metrics), so the rule stays inactive
+            # everywhere else — scenario packs assert on it through the same
+            # recorder→engine path production would page through.
+            description="simulated scheduling rounds handed out a departed "
+                        "peer (virtual-clock swarm invariant violation)",
+        ),
+        AlertRule(
             name="piece_tls_handshake_failures",
             kind="rate",
             metric="dragonfly_dfdaemon_piece_tls_handshake_failures_total",

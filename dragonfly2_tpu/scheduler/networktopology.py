@@ -22,12 +22,12 @@ from __future__ import annotations
 
 import random
 import statistics
-import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Optional
 
 from dragonfly2_tpu.telemetry import TelemetryStorage
+from dragonfly2_tpu.utils import clock as clockmod
 
 DEFAULT_QUEUE_LENGTH = 5   # ref config DefaultProbeQueueLength
 DEFAULT_PROBE_COUNT = 10   # targets handed out per sync (ref FindProbedHosts cap)
@@ -75,17 +75,18 @@ class EdgeProbes:
         self.std_ms = 0.0
         self.min_ms = 0.0
 
-    def enqueue(self, rtt_ms: float) -> None:
+    def enqueue(self, rtt_ms: float, now: float | None = None) -> None:
         # Mutator-side only (the scheduler's probe ingest); concurrent
         # READERS (round-dispatcher workers assembling features) touch
         # nothing but the published scalar stats below — never the deque, so
         # an in-flight append can't blow up their iteration. Each stat is one
         # atomic attribute publish; they are written before the caller bumps
         # pair_version (NetworkTopology.enqueue), so a reader that sees the
-        # new version sees the new stats.
+        # new version sees the new stats. `now` is the owning store's clock
+        # reading (injectable — the swarm simulator stamps virtual time).
         self.rtts_ms.append(rtt_ms)
         self.probed_count += 1
-        self.updated_at = time.time()
+        self.updated_at = now if now is not None else clockmod.SYSTEM.time()
         self.avg_ms = statistics.fmean(self.rtts_ms)
         self.std_ms = statistics.pstdev(self.rtts_ms) if len(self.rtts_ms) > 1 else 0.0
         self.min_ms = min(self.rtts_ms)
@@ -99,10 +100,15 @@ class NetworkTopology:
         queue_length: int = DEFAULT_QUEUE_LENGTH,
         probe_count: int = DEFAULT_PROBE_COUNT,
         rng: random.Random | None = None,
+        clock: clockmod.Clock | None = None,
     ):
         self.telemetry = telemetry
         self.queue_length = queue_length
         self.probe_count = probe_count
+        # Injectable time source for edge freshness stamps (updated_at rides
+        # the federation's per-edge monotonic merge and the probe-target
+        # least-recently-probed ordering); production = system clock.
+        self.clock = clock or clockmod.SYSTEM
         self._edges: dict[tuple[str, str], EdgeProbes] = {}
         self._rng = rng or random.Random()
         # Coarse change counter (any mutation anywhere) kept for callers that
@@ -131,6 +137,11 @@ class NetworkTopology:
         # Peer schedulers' edges, keyed like _edges; consulted by avg_rtt_ms
         # when no local probes exist for either direction of the pair.
         self._remote: dict[tuple[str, str], RemoteEdge] = {}
+        # host -> edge keys touching it (local and remote views): forget_host
+        # runs per departed host, and scanning EVERY edge for membership made
+        # churn O(edges × departures) at 10^5 peers (swarm-simulator finding)
+        self._by_host: dict[str, set] = {}
+        self._remote_by_host: dict[str, set] = {}
 
     # ---- store ----
 
@@ -154,10 +165,12 @@ class NetworkTopology:
         edge = self._edges.get(key)
         if edge is None:
             edge = self._edges[key] = EdgeProbes(self.queue_length)
+            self._by_host.setdefault(src_host_id, set()).add(key)
+            self._by_host.setdefault(dst_host_id, set()).add(key)
         # stats first, version bumps second (see BandwidthHistory.observe for
         # the reader-safe ordering contract the evaluator's pair-row cache
         # depends on under the concurrent round dispatcher)
-        edge.enqueue(rtt_ms)
+        edge.enqueue(rtt_ms, now=self.clock.time())
         self.version += 1
         self._bump_pair(src_host_id, dst_host_id)
         self._clock.stamp(key, self.version)
@@ -169,6 +182,7 @@ class NetworkTopology:
                 rtt_std_ms=edge.std_ms,
                 rtt_min_ms=edge.min_ms,
                 probe_count=edge.probed_count,
+                created_at=self.clock.time(),
             )
 
     def avg_rtt_ms(self, src_host_id: str, dst_host_id: str) -> Optional[float]:
@@ -193,19 +207,32 @@ class NetworkTopology:
         return len(self._remote)
 
     def forget_host(self, host_id: str) -> int:
-        """Drop edges touching a GC'd host."""
-        dead = [k for k in self._edges if host_id in k]
+        """Drop edges touching a GC'd host (O(that host's edges) via the
+        per-host index, not O(all edges))."""
+        dead = [k for k in self._by_host.pop(host_id, ()) if k in self._edges]
         for k in dead:
             del self._edges[k]
+            other = k[0] if k[1] == host_id else k[1]
+            if other != host_id:
+                peers = self._by_host.get(other)
+                if peers is not None:
+                    peers.discard(k)
             self._bump_pair(*k)
             self.version += 1
-            self._clock.stamp(k, self.version)  # tombstone: gossiped as a delete
-        for k in [k for k in self._remote if host_id in k]:
+            self._clock.stamp_tombstone(k, self.version)  # gossiped as a delete
+        for k in list(self._remote_by_host.pop(host_id, ())):
+            if k not in self._remote:
+                continue
             del self._remote[k]
+            other = k[0] if k[1] == host_id else k[1]
+            if other != host_id:
+                peers = self._remote_by_host.get(other)
+                if peers is not None:
+                    peers.discard(k)
             self._bump_pair(*k)
             self.version += 1
         if dead:
-            self._clock.prune(self._edges.__contains__)
+            self._clock.prune()
         return len(dead)
 
     # ---- federation delta sync (scheduler/federation.py) ----
@@ -242,6 +269,10 @@ class NetworkTopology:
             key = (e["src"], e["dst"])
             if e.get("deleted"):
                 if self._remote.pop(key, None) is not None:
+                    for h in key:
+                        peers = self._remote_by_host.get(h)
+                        if peers is not None:
+                            peers.discard(key)
                     applied += 1
                     self.version += 1
                     self._bump_pair(*key)
@@ -257,6 +288,9 @@ class NetworkTopology:
                 min_ms=float(e["min_ms"]), probed_count=int(e["probed_count"]),
                 updated_at=float(e["updated_at"]), origin=origin,
             )
+            if prev is None:
+                for h in key:
+                    self._remote_by_host.setdefault(h, set()).add(key)
             applied += 1
             self.version += 1
             self._bump_pair(*key)
@@ -270,6 +304,10 @@ class NetworkTopology:
         dead = [k for k, e in self._remote.items() if e.origin == origin]
         for k in dead:
             del self._remote[k]
+            for h in k:
+                peers = self._remote_by_host.get(h)
+                if peers is not None:
+                    peers.discard(k)
             self._bump_pair(*k)
             self.version += 1
         return len(dead)
@@ -278,19 +316,38 @@ class NetworkTopology:
 
     def sync_probes(
         self, src_host_id: str, results: list[dict], hosts: dict, *,
-        exclude: set[str] | None = None,
+        exclude: set[str] | None = None, host_list: list | None = None,
     ) -> list[ProbeTarget]:
         """One round: ingest `results` ({dst_host_id, rtt_ms, success}), then
         pick the next probe targets for this source — least-recently-probed
-        first so coverage is uniform, random tiebreak."""
+        first so coverage is uniform, random tiebreak.
+
+        Target selection is a BOUNDED draw past a few hundred hosts: a
+        uniform sample (from `host_list` when the caller provides an
+        indexable snapshot — ResourcePool.host_values — else materialized
+        once from `hosts`) is filtered and LRU-ordered, instead of building,
+        shuffling, and sorting the full host population per probe round —
+        which was O(N log N) per call and dominated probe ingest at 10^5
+        hosts (swarm-simulator finding). Coverage stays near-uniform: the
+        sample is uniform and the LRU preference acts within it."""
         for r in results:
             if r.get("success", True):
                 self.enqueue(src_host_id, r["dst_host_id"], float(r["rtt_ms"]))
         exclude = exclude or set()
-        candidates = [
-            h for hid, h in hosts.items()
-            if hid != src_host_id and hid not in exclude and h.download_port
-        ]
+        pool_n = len(host_list) if host_list is not None else len(hosts)
+        draw = 8 * self.probe_count
+        if host_list is not None and pool_n > draw:
+            candidates = [
+                h for h in self._rng.sample(host_list, draw)
+                if h.id != src_host_id and h.id not in exclude and h.download_port
+            ]
+        else:
+            candidates = [
+                h for hid, h in hosts.items()
+                if hid != src_host_id and hid not in exclude and h.download_port
+            ]
+            if len(candidates) > draw:
+                candidates = self._rng.sample(candidates, draw)
         self._rng.shuffle(candidates)
         candidates.sort(
             key=lambda h: self._edges.get((src_host_id, h.id), _NEVER).updated_at

@@ -10,12 +10,12 @@ goroutine-per-stream.
 
 from __future__ import annotations
 
-import time
 from collections import deque
 from dataclasses import dataclass
 from enum import Enum
 from typing import Any
 
+from dragonfly2_tpu.utils import clock as clockmod
 from dragonfly2_tpu.utils import idgen
 from dragonfly2_tpu.utils.bitset import Bitset
 from dragonfly2_tpu.utils.dag import DAG, VertexNotFound
@@ -114,7 +114,9 @@ class Host:
         idc: str = "",
         location: str = "",
         upload_limit: int = 40,
+        clock: clockmod.Clock | None = None,
     ):
+        self._clock = clock or clockmod.SYSTEM
         self.id = host_id
         self.ip = ip
         self.hostname = hostname
@@ -135,8 +137,8 @@ class Host:
         # by (peer.feat_version, host.feat_version) to hit its 10k-rounds/s
         # serving budget (see evaluator.build_pair_features).
         self.feat_version = 0
-        self.created_at = time.monotonic()
-        self.updated_at = time.monotonic()
+        self.created_at = self._clock.monotonic()
+        self.updated_at = self.created_at
 
     def bump_feat(self) -> None:
         self.feat_version += 1
@@ -151,7 +153,7 @@ class Host:
         return self.upload_count / total if total else 1.0
 
     def touch(self) -> None:
-        self.updated_at = time.monotonic()
+        self.updated_at = self._clock.monotonic()
 
 
 class Peer:
@@ -161,6 +163,7 @@ class Peer:
         self.id = peer_id
         self.task = task
         self.host = host
+        self._clock = host._clock  # one clock per pool; hosts carry it
         self.fsm = FSM(PEER_PENDING, _PEER_EVENTS)
         self.finished_pieces = Bitset()
         self.piece_costs_ms: deque[float] = deque(maxlen=20)
@@ -194,8 +197,8 @@ class Peer:
         # the depth memo also carries its timestamp (TTL, see depth())
         self._depth_memo = (-1, 0, 0.0)
         self._bad_memo = (-1, False)
-        self.created_at = time.monotonic()
-        self.updated_at = time.monotonic()
+        self.created_at = self._clock.monotonic()
+        self.updated_at = self.created_at
 
     def bump_feat(self) -> None:
         self.feat_version += 1
@@ -232,7 +235,7 @@ class Peer:
         a bump — and depth gates the hard max_tree_depth filter, so its
         staleness must be time-bounded, not unbounded."""
         ver, cached, at = self._depth_memo
-        if ver == self.feat_version and time.monotonic() - at < self._DEPTH_MEMO_TTL_S:
+        if ver == self.feat_version and self._clock.monotonic() - at < self._DEPTH_MEMO_TTL_S:
             return cached
         depth, cur = 1, self
         seen = {self.id}
@@ -246,11 +249,11 @@ class Peer:
             seen.add(nxt.id)
             cur = nxt
             depth += 1
-        self._depth_memo = (self.feat_version, depth, time.monotonic())
+        self._depth_memo = (self.feat_version, depth, self._clock.monotonic())
         return depth
 
     def touch(self) -> None:
-        self.updated_at = time.monotonic()
+        self.updated_at = self._clock.monotonic()
 
 
 class Task:
@@ -265,7 +268,9 @@ class Task:
         tag: str = "",
         application: str = "",
         filters: tuple[str, ...] = (),
+        clock: clockmod.Clock | None = None,
     ):
+        self._clock = clock or clockmod.SYSTEM
         self.id = task_id
         self.url = url
         self.digest = digest
@@ -279,8 +284,8 @@ class Task:
         self.direct_piece: bytes = b""  # TINY scope payload
         self.dag: DAG[Peer] = DAG()
         self.back_to_source_budget = 3  # concurrent back-source peers (ref constants.go:66-70)
-        self.created_at = time.monotonic()
-        self.updated_at = time.monotonic()
+        self.created_at = self._clock.monotonic()
+        self.updated_at = self.created_at
 
     @property
     def state(self) -> str:
@@ -374,10 +379,18 @@ class Task:
         except VertexNotFound:
             return []
 
+    _AVAILABLE_STATES = (PEER_RUNNING, PEER_BACK_TO_SOURCE, PEER_SUCCEEDED)
+
     def has_available_peer(self, blocklist: set[str] = frozenset()) -> bool:
-        return any(
-            p.id not in blocklist and p.fsm.current in (PEER_RUNNING, PEER_BACK_TO_SOURCE, PEER_SUCCEEDED)
-            for p in self.dag.values()
+        # early-exit scan without copying the vertex list (DAG.first_match):
+        # this runs per registration against tasks that hold 10^5 peers in a
+        # flash crowd, and the first vertex (the seed) usually answers it
+        states = self._AVAILABLE_STATES
+        return (
+            self.dag.first_match(
+                lambda p: p.id not in blocklist and p.fsm.current in states
+            )
+            is not None
         )
 
     def can_back_to_source(self) -> bool:
@@ -385,7 +398,7 @@ class Task:
         return active < self.back_to_source_budget
 
     def touch(self) -> None:
-        self.updated_at = time.monotonic()
+        self.updated_at = self._clock.monotonic()
 
 
 # ---- managers with TTL GC (ref peer_manager.go / task_manager.go / host_manager.go) ----
@@ -404,26 +417,54 @@ class GCPolicy:
 class ResourcePool:
     """Hosts + tasks + peers with shared GC; the scheduler's world state."""
 
-    def __init__(self, gc_policy: GCPolicy | None = None):
+    def __init__(
+        self,
+        gc_policy: GCPolicy | None = None,
+        *,
+        clock: clockmod.Clock | None = None,
+    ):
         self.hosts: dict[str, Host] = {}
         self.tasks: dict[str, Task] = {}
         self._peer_index: dict[str, Peer] = {}
+        # host-list snapshot for bounded random draws (probe-target
+        # selection): appended in place on create, invalidated on delete —
+        # same idiom as DAG._vlist (rebuilding per read was O(hosts) per
+        # probe round at 10^5 hosts)
+        self._host_list: list[Host] | None = None
         self.gc_policy = gc_policy or GCPolicy()
+        # Injectable time source (utils/clock.py): production = the system
+        # clock; the swarm simulator injects a VirtualClock so TTL sweeps
+        # and freshness windows run in simulated time. Hosts/tasks created
+        # here carry it; peers inherit their host's.
+        self.clock = clock or clockmod.SYSTEM
 
     # hosts
     def load_or_create_host(self, host_id: str, ip: str, hostname: str, **kw: Any) -> Host:
         host = self.hosts.get(host_id)
         if host is None:
-            host = Host(host_id, ip, hostname, **kw)
+            host = Host(host_id, ip, hostname, clock=self.clock, **kw)
             self.hosts[host_id] = host
+            if self._host_list is not None:
+                self._host_list.append(host)
         host.touch()
         return host
+
+    def host_values(self) -> list[Host]:
+        """Indexable host snapshot (probe-target sampling); O(1) amortized —
+        rebuilt only after a host delete."""
+        if self._host_list is None or len(self._host_list) != len(self.hosts):
+            self._host_list = list(self.hosts.values())
+        return self._host_list
+
+    def delete_host(self, host_id: str) -> None:
+        if self.hosts.pop(host_id, None) is not None:
+            self._host_list = None
 
     # tasks
     def load_or_create_task(self, task_id: str, url: str, **kw: Any) -> Task:
         task = self.tasks.get(task_id)
         if task is None:
-            task = Task(task_id, url, **kw)
+            task = Task(task_id, url, clock=self.clock, **kw)
             self.tasks[task_id] = task
         task.touch()
         return task
@@ -457,7 +498,7 @@ class ResourcePool:
 
     def gc(self) -> dict[str, int]:
         """TTL sweep; returns counts removed (wired into utils.gcreg)."""
-        now = time.monotonic()
+        now = self.clock.monotonic()
         removed = {"peers": 0, "tasks": 0, "hosts": 0}
         for pid, peer in list(self._peer_index.items()):
             expired = now - peer.updated_at > self.gc_policy.peer_ttl
@@ -470,6 +511,6 @@ class ResourcePool:
                 removed["tasks"] += 1
         for hid, host in list(self.hosts.items()):
             if not host.peer_ids and now - host.updated_at > self.gc_policy.host_ttl:
-                del self.hosts[hid]
+                self.delete_host(hid)
                 removed["hosts"] += 1
         return removed

@@ -97,11 +97,20 @@ class SchedulerService:
         telemetry: TelemetryStorage | None = None,
         gc_policy: GCPolicy | None = None,
         seed_trigger: Callable[[Task], Awaitable[None]] | None = None,
+        clock=None,
+        topology_rng=None,
     ):
         from dragonfly2_tpu.scheduler.networktopology import NetworkTopology
         from dragonfly2_tpu.telemetry import BandwidthHistory
+        from dragonfly2_tpu.utils import clock as clockmod
 
-        self.pool = ResourcePool(gc_policy)
+        # Injectable time source (utils/clock.py): every wall/monotonic read
+        # on the scheduling and TTL paths goes through this — production
+        # default is the system clock, the swarm simulator injects a
+        # VirtualClock so one process can play hours of TTL/GC and
+        # federation behavior in seconds (ISSUE 14).
+        self.clock = clock or clockmod.SYSTEM
+        self.pool = ResourcePool(gc_policy, clock=self.clock)
         self.evaluator = evaluator or new_evaluator("base")
         # registry-scoped serving-health counters (ISSUE 12): rollout health
         # baselines window THESE, so N services in one process never share a
@@ -116,7 +125,13 @@ class SchedulerService:
         # loop-side noise. NEVER held across an await.
         self.state_lock = self.scheduling.state_lock
         self.telemetry = telemetry
-        self.topology = NetworkTopology(telemetry=telemetry)
+        # topology_rng: seedable randomness for probe-target selection —
+        # production leaves it None (fresh entropy); the simulator seeds it
+        # so a run's probe schedule (and thus its telemetry/dataset) is
+        # bit-reproducible from SimConfig.seed
+        self.topology = NetworkTopology(
+            telemetry=telemetry, clock=self.clock, rng=topology_rng
+        )
         self.evaluator.topology = self.topology  # rtt_norm feature source
         self.bandwidth = BandwidthHistory()  # bandwidth_norm feature source
         if telemetry is not None:
@@ -151,9 +166,19 @@ class SchedulerService:
         ghost lose their edge and reschedule; a superseded-but-actually-live
         peer (pathological double-download on one host) self-heals through
         the conductor's reschedule→not_found→re-register path. Returns the
-        number of ghosts removed."""
+        number of ghosts removed. Walks the HOST's peer index (a handful of
+        rows), not the task's whole DAG: at flash-crowd scale the task holds
+        10^5 peers and this runs on every registration — the O(task-peers)
+        scan was O(N²) across the crowd (swarm-simulator finding)."""
+        host = self.pool.hosts.get(host_id)
+        if host is None:
+            return 0
         stale = [
-            p.id for p in task.peers() if p.host.id == host_id and p.id != keep_peer_id
+            pid
+            for pid in host.peer_ids
+            if pid != keep_peer_id
+            and (p := self.pool.peer(pid)) is not None
+            and p.task is task
         ]
         for pid in stale:
             self.pool.delete_peer(pid)
@@ -559,6 +584,10 @@ class SchedulerService:
             piece_cost_ms_mean=float(np.mean(costs)) if costs else 0.0,
             success=success,
             back_to_source=peer.fsm.is_(PEER_BACK_TO_SOURCE) or peer.state == PEER_SUCCEEDED and not parents,
+            # record stamps ride the service clock: simulated traffic carries
+            # virtual timestamps end-to-end (identical to the store's own
+            # time.time() default under the production system clock)
+            created_at=self.clock.time(),
         )
         if parents:
             feats = build_pair_features(peer, parents, self.topology, self.bandwidth)
@@ -619,7 +648,7 @@ class SchedulerService:
         with self.state_lock:
             for pid in list(host.peer_ids):
                 self.leave_peer(pid)
-            del self.pool.hosts[host_id]
+            self.pool.delete_host(host_id)
             self.topology.forget_host(host_id)
             self.bandwidth.forget_host(host_id)
 
@@ -628,7 +657,10 @@ class SchedulerService:
     def sync_probes(self, src_host_id: str, results: list[dict]) -> list[dict]:
         """Ingest a probe round from a daemon and hand back the next targets."""
         with self.state_lock:
-            targets = self.topology.sync_probes(src_host_id, results, self.pool.hosts)
+            targets = self.topology.sync_probes(
+                src_host_id, results, self.pool.hosts,
+                host_list=self.pool.host_values(),
+            )
         if results:
             metrics.PROBES_SYNCED_TOTAL.inc(len(results))
         return [{"host_id": t.host_id, "ip": t.ip, "port": t.port} for t in targets]

@@ -1,0 +1,265 @@
+"""Scenario packs: configured simulations + their cluster-level assertions.
+
+Each scenario is a builder returning a ready Simulation and a checker that
+raises AssertionError (with the offending numbers) against its SimReport —
+shared verbatim by tests/test_sim.py, cli/dfsim.py, bench.py's swarm_sim
+section, and check.sh's sim-smoke leg, so "the scenario passes" means the
+same thing everywhere.
+
+  flash_crowd             N peers pull ONE task inside a short window (the
+                          deploy-wave image pull). Asserts origin egress is
+                          O(1) per region — a bounded number of task-sized
+                          fetches, NOT proportional to peers — placement
+                          stays region-local, and no scheduling round ever
+                          hands out a cleanly-departed peer.
+  cross_region_cold_start the task is seeded in one region; a crowd wakes in
+                          another. Asserts the cold region bootstraps over a
+                          bounded number of cross-region transfers and then
+                          fans out locally.
+  partition_and_heal      2 federated schedulers; the gossip link is severed
+                          mid-run and healed. Asserts sync errors appear
+                          during the partition, convergence (remote edges on
+                          every member) within bounded virtual time after
+                          heal, and the departed-peer invariant throughout.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+from dragonfly2_tpu.sim.engine import SimConfig, SimReport, Simulation
+from dragonfly2_tpu.sim.topology import TopologyConfig
+from dragonfly2_tpu.sim.workload import FlashCrowd, TaskSpec, WorkloadConfig
+
+
+@dataclass
+class Scenario:
+    name: str
+    sim: Simulation
+    check: Callable[[SimReport], None]
+    # the crowd task's size — origin-egress ratios are in units of it
+    content_length: int
+
+
+def _task(content_mb: int = 256, piece_mb: int = 16) -> TaskSpec:
+    return TaskSpec(
+        "sim-task-0000", "http://origin/sim-0.bin", content_mb << 20, piece_mb << 20
+    )
+
+
+def _probe_fraction(peers: int) -> float:
+    # enough probe traffic to populate topology/dataset edges, bounded so
+    # probe rounds stay a small slice of the event budget at 10^5 peers
+    return min(0.25, 20_000 / max(peers, 1))
+
+
+def flash_crowd(
+    *,
+    peers: int = 2_000,
+    schedulers: int = 2,
+    seed: int = 0,
+    crowd_window_s: float = 60.0,
+    telemetry_dir: str | None = None,
+    regions: tuple[str, ...] = ("us-east", "us-west", "eu-west"),
+    churn_lifetime_mean_s: float = 600.0,
+    churn_crash_fraction: float = 0.25,
+    sample_interval_s: float = 10.0,
+) -> Scenario:
+    task = _task()
+    cfg = SimConfig(
+        schedulers=schedulers,
+        seed=seed,
+        topology=TopologyConfig(regions=regions),
+        workload=WorkloadConfig(
+            flash_crowds=(FlashCrowd(1.0, peers, crowd_window_s),),
+            tasks=(task,),
+            churn_lifetime_mean_s=churn_lifetime_mean_s,
+            churn_crash_fraction=churn_crash_fraction,
+            probe_fraction=_probe_fraction(peers),
+        ),
+        telemetry_dir=telemetry_dir,
+        sample_interval_s=sample_interval_s,
+    )
+    sim = Simulation(cfg, scenario="flash_crowd")
+
+    # Cluster properties are read off the metrics PLANE, not ad-hoc
+    # counters: a mid-crowd control event queries the recorder's windowed
+    # rates at VIRTUAL timestamps (observability/timeseries.py — the same
+    # instrument dftop and the SLO engine read in production).
+    ts_probe: dict = {}
+
+    def probe_rates() -> None:
+        rec = sim.recorder
+        now = sim.clock.time()
+        ts_probe["events_rate"] = rec.rate(
+            "dragonfly_sim_events_total", window_s=30.0, now=now
+        )
+        ts_probe["egress_rate"] = rec.rate(
+            "dragonfly_sim_origin_egress_bytes_total", window_s=30.0, now=now
+        )
+        ts_probe["peers"] = rec.latest("dragonfly_sim_peers")
+
+    sim.at(1.0 + crowd_window_s * 0.6, probe_rates)
+
+    def check(rep: SimReport) -> None:
+        # ---- the timeseries plane saw the crowd: live windowed event rate
+        # and population mid-crowd, origin egress RATE bounded in-window ----
+        assert ts_probe.get("events_rate"), ts_probe
+        assert ts_probe.get("peers"), ts_probe
+        assert (ts_probe.get("egress_rate") or 0.0) * 30.0 <= 8.0 * task.content_length, (
+            ts_probe
+        )
+        # ---- origin egress is O(1) per region: a bounded number of
+        # task-sized fetches, independent of crowd size ----
+        for region, nbytes in rep.origin_egress_bytes.items():
+            fetches = nbytes / task.content_length
+            assert fetches <= 8.0, (
+                f"origin egress in {region} is {fetches:.1f} task-sized fetches "
+                f"for {peers} peers — not O(1) per region"
+            )
+        assert sum(rep.origin_egress_bytes.values()) > 0, "nobody fetched the origin"
+        # ---- the crowd actually completed through P2P ----
+        assert rep.completed >= 0.95 * peers, (rep.completed, peers)
+        assert rep.p2p_bytes >= 0.9 * peers * task.content_length * 0.5
+        # ---- placement quality: the evaluator's locality features must beat
+        # a uniform random draw (which would land ~1/len(regions) local) ----
+        assert rep.same_region_frac >= 1.5 / len(regions), rep.same_region_frac
+        # ---- no scheduling round ever observed a cleanly-departed peer ----
+        assert rep.departed_parent_rounds == 0, rep.departed_parent_rounds
+        # fan-out is shared, not one hero parent
+        assert rep.fairness_jain > 0.1, rep.fairness_jain
+
+    return Scenario("flash_crowd", sim, check, task.content_length)
+
+
+def cross_region_cold_start(
+    *,
+    peers: int = 1_500,
+    seed: int = 0,
+    telemetry_dir: str | None = None,
+) -> Scenario:
+    """Task seeded (announce path) in region A; the crowd wakes in region B."""
+    task = _task()
+    regions = ("us-east", "eu-west")
+    cfg = SimConfig(
+        schedulers=2,
+        seed=seed,
+        topology=TopologyConfig(regions=regions, origin_region="us-east"),
+        workload=WorkloadConfig(
+            flash_crowds=(FlashCrowd(1.0, peers, 45.0, region="eu-west"),),
+            tasks=(task,),
+            probe_fraction=_probe_fraction(peers),
+        ),
+        telemetry_dir=telemetry_dir,
+    )
+    sim = Simulation(cfg, scenario="cross_region_cold_start")
+    sim.preseed(task, "us-east", count=2)
+
+    def check(rep: SimReport) -> None:
+        assert rep.completed >= 0.95 * peers, (rep.completed, peers)
+        # cold start crosses the WAN a bounded number of times (the seeds
+        # and the origin sit in us-east), then fan-out happens locally:
+        # cross-region bytes stay a small fraction of total P2P traffic
+        frac = rep.cross_region_bytes / max(rep.p2p_bytes, 1)
+        assert frac <= 0.25, f"cross-region fraction {frac:.3f} — no local fan-out"
+        # origin egress bounded as ever
+        total_fetches = sum(rep.origin_egress_bytes.values()) / task.content_length
+        assert total_fetches <= 8.0, total_fetches
+        assert rep.departed_parent_rounds == 0
+
+    return Scenario("cross_region_cold_start", sim, check, task.content_length)
+
+
+def partition_and_heal(
+    *,
+    peers: int = 1_200,
+    seed: int = 0,
+    partition_at_s: float = 20.0,
+    heal_at_s: float = 120.0,
+    convergence_budget_s: float = 60.0,
+    telemetry_dir: str | None = None,
+) -> Scenario:
+    """Two federated ring members; gossip severed mid-crowd, then healed."""
+    task = _task()
+    cfg = SimConfig(
+        schedulers=2,
+        seed=seed,
+        topology=TopologyConfig(regions=("us-east", "us-west")),
+        workload=WorkloadConfig(
+            flash_crowds=(
+                FlashCrowd(1.0, peers // 2, 30.0),
+                # a second wave keeps probe/scheduling traffic flowing after
+                # the heal so convergence has deltas to carry
+                FlashCrowd(heal_at_s + 5.0, peers - peers // 2, 30.0),
+            ),
+            tasks=(task,),
+            probe_fraction=_probe_fraction(peers),
+            churn_lifetime_mean_s=400.0,
+            churn_crash_fraction=0.2,
+        ),
+        telemetry_dir=telemetry_dir,
+        federation_interval_s=2.0,
+        sample_interval_s=5.0,
+    )
+    sim = Simulation(cfg, scenario="partition_and_heal")
+    a, b = sim.names[0], sim.names[1]
+    sim.at(partition_at_s, lambda: sim.partition(a, b))
+    sim.at(heal_at_s, lambda: sim.heal(a, b))
+
+    # The production paging path, in virtual time: an AlertEngine over the
+    # sim's recorder evaluates the stock federation_sync_failures rule
+    # DURING the partition (two evaluations, spaced past the rule's for_s)
+    # and again after the heal — the scenario asserts the alert fires while
+    # severed and resolves once healed.
+    from dragonfly2_tpu.observability.alerts import AlertEngine
+
+    engine = AlertEngine(sim.recorder, export=False)
+    alert_seen: dict = {}
+
+    def _active() -> set:
+        engine.evaluate_once(now=sim.clock.time())
+        return {al["name"] for al in engine.active()}
+
+    sim.at(partition_at_s + 45.0, lambda: _active())
+    sim.at(
+        partition_at_s + 60.0,
+        lambda: alert_seen.__setitem__("during", "federation_sync_failures" in _active()),
+    )
+    sim.at(
+        heal_at_s + 120.0,
+        lambda: alert_seen.__setitem__("after", "federation_sync_failures" in _active()),
+    )
+
+    def check(rep: SimReport) -> None:
+        fed = rep.federation
+        assert fed, "no federation ticks ran"
+        # the partition was real: sync errors accumulated while severed
+        assert fed["syncs_failed"] > 0, fed
+        # ... and the stock SLO rule saw it through the timeseries plane,
+        # then resolved after the heal
+        assert alert_seen.get("during") is True, alert_seen
+        assert alert_seen.get("after") is False, alert_seen
+        # and it healed: convergence (remote edges on EVERY member) within
+        # the virtual budget after heal
+        converged_at = None
+        for row in fed["history"]:
+            if row["t_s"] > heal_at_s and all(c > 0 for c in row["remote_edges"]):
+                converged_at = row["t_s"]
+                break
+        assert converged_at is not None, "never converged after heal"
+        assert converged_at - heal_at_s <= convergence_budget_s, (
+            f"convergence took {converged_at - heal_at_s:.1f}s virtual "
+            f"(budget {convergence_budget_s}s)"
+        )
+        assert rep.departed_parent_rounds == 0
+        assert rep.completed >= 0.9 * peers, (rep.completed, peers)
+
+    return Scenario("partition_and_heal", sim, check, task.content_length)
+
+
+SCENARIOS: dict[str, Callable[..., Scenario]] = {
+    "flash-crowd": flash_crowd,
+    "cross-region-cold-start": cross_region_cold_start,
+    "partition-and-heal": partition_and_heal,
+}

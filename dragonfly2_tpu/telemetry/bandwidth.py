@@ -58,6 +58,14 @@ class BandwidthHistory:
         self._remote_pair: dict[tuple[str, str], float] = {}
         self._remote_origin: dict[tuple[str, str], str] = {}
         self._remote_parent: dict[str, float] = {}
+        # host -> pair keys touching it, and a per-parent count of remote
+        # pairs: forget_host ran an O(all pairs) membership scan per departed
+        # host, and merge_remote's drop-the-aggregate-with-the-last-pair rule
+        # re-scanned every remote pair per tombstone — together the top CPU
+        # items under churn at 10^5 peers (swarm-simulator finding)
+        self._pairs_by_host: dict[str, set] = {}
+        self._remote_pairs_by_host: dict[str, set] = {}
+        self._remote_parent_pairs: dict[str, int] = {}
 
     def parent_version(self, parent_host_id: str) -> int:
         """Change counter covering every pair this parent serves (pair EWMA
@@ -74,6 +82,9 @@ class BandwidthHistory:
         key = (parent_host_id, child_host_id)
         prev = self._pair.get(key)
         self._pair[key] = bps if prev is None else (1 - a) * prev + a * bps
+        if prev is None:
+            self._pairs_by_host.setdefault(parent_host_id, set()).add(key)
+            self._pairs_by_host.setdefault(child_host_id, set()).add(key)
         prev = self._parent.get(parent_host_id)
         self._parent[parent_host_id] = bps if prev is None else (1 - a) * prev + a * bps
         # Versions bump AFTER the EWMA writes (reader-safe ordering for the
@@ -112,25 +123,58 @@ class BandwidthHistory:
         return float(min(v / BANDWIDTH_NORM_BPS, 1.0))
 
     def forget_host(self, host_id: str) -> None:
+        """Drop all history touching a GC'd host — O(that host's pairs) via
+        the per-host index, not O(all pairs)."""
         self._parent.pop(host_id, None)
         self._bump_parent(host_id)
-        for key in [k for k in self._pair if host_id in k]:
+        for key in [k for k in self._pairs_by_host.pop(host_id, ()) if k in self._pair]:
             del self._pair[key]
+            other = key[0] if key[1] == host_id else key[1]
+            if other != host_id:
+                peers = self._pairs_by_host.get(other)
+                if peers is not None:
+                    peers.discard(key)
             # dropping a (parent, child) pair changes normalized() for that
             # PARENT (its children fall back to the aggregate) even when the
             # forgotten host was the child side
             if key[0] != host_id:
                 self._bump_parent(key[0])
             self.version += 1
-            self._clock.stamp(key, self.version)  # tombstone for the gossip
+            self._clock.stamp_tombstone(key, self.version)  # gossiped delete
         self._remote_parent.pop(host_id, None)
-        for key in [k for k in self._remote_pair if host_id in k]:
-            del self._remote_pair[key]
-            self._remote_origin.pop(key, None)
+        for key in list(self._remote_pairs_by_host.pop(host_id, ())):
+            if key not in self._remote_pair:
+                continue
+            self._drop_remote_pair(key)
             if key[0] != host_id:
                 self._bump_parent(key[0])
         self.version += 1
-        self._clock.prune(self._pair.__contains__)
+        self._clock.prune()
+
+    def _drop_remote_pair(self, key: tuple[str, str]) -> None:
+        """Remove one merged pair, maintaining both indexes and the
+        per-parent refcount (aggregate eviction reads it)."""
+        if self._remote_pair.pop(key, None) is None:
+            return
+        self._remote_origin.pop(key, None)
+        for h in key:
+            peers = self._remote_pairs_by_host.get(h)
+            if peers is not None:
+                peers.discard(key)
+        n = self._remote_parent_pairs.get(key[0], 0) - 1
+        if n > 0:
+            self._remote_parent_pairs[key[0]] = n
+        else:
+            self._remote_parent_pairs.pop(key[0], None)
+
+    def _add_remote_pair(self, key: tuple[str, str], origin: str) -> None:
+        if key not in self._remote_pair:
+            for h in key:
+                self._remote_pairs_by_host.setdefault(h, set()).add(key)
+            self._remote_parent_pairs[key[0]] = (
+                self._remote_parent_pairs.get(key[0], 0) + 1
+            )
+        self._remote_origin[key] = origin
 
     # ---- federation delta sync (scheduler/federation.py) ----
 
@@ -158,15 +202,17 @@ class BandwidthHistory:
         for e in entries:
             key = (e["parent"], e["child"])
             if e.get("deleted"):
-                if self._remote_pair.pop(key, None) is not None:
-                    self._remote_origin.pop(key, None)
+                if key in self._remote_pair:
+                    self._drop_remote_pair(key)
                     applied += 1
                     self.version += 1
                     self._bump_parent(key[0])
                 # drop the merged parent aggregate once its LAST remote pair
                 # is gone: a GC'd (possibly id-recycled) parent must not keep
-                # serving a stale fallback estimate forever
-                if not any(k[0] == key[0] for k in self._remote_pair):
+                # serving a stale fallback estimate forever (refcount — the
+                # original any()-over-every-pair scan per tombstone was the
+                # top churn cost at 10^5 peers)
+                if not self._remote_parent_pairs.get(key[0]):
                     if self._remote_parent.pop(key[0], None) is not None:
                         self._bump_parent(key[0])
                         self.version += 1
@@ -178,8 +224,8 @@ class BandwidthHistory:
                 changed = True
             if not changed:
                 continue
+            self._add_remote_pair(key, origin)
             self._remote_pair[key] = float(e["bps"])
-            self._remote_origin[key] = origin
             applied += 1
             self.version += 1
             self._bump_parent(key[0])
@@ -191,11 +237,10 @@ class BandwidthHistory:
         NetworkTopology.purge_remote_origin."""
         dead = [k for k, o in self._remote_origin.items() if o == origin]
         for k in dead:
-            self._remote_pair.pop(k, None)
-            del self._remote_origin[k]
+            self._drop_remote_pair(k)
             self._bump_parent(k[0])
             self.version += 1
-            if not any(p == k[0] for p, _ in self._remote_pair):
+            if not self._remote_parent_pairs.get(k[0]):
                 if self._remote_parent.pop(k[0], None) is not None:
                     self._bump_parent(k[0])
                     self.version += 1

@@ -65,8 +65,15 @@ class DAG(Generic[V]):
         with self._lock:
             if vid in self._v:
                 raise VertexExists(vid)
-            self._v[vid] = Vertex(vid, value)
-            self._vlist = None
+            vertex = self._v[vid] = Vertex(vid, value)
+            # append-in-place instead of invalidating: a growing swarm adds a
+            # vertex per registration, and a None'd snapshot costs an O(N)
+            # rebuild inside the NEXT scheduling round's candidate draw —
+            # O(N²) across a flash crowd (measured by the swarm simulator at
+            # 10^5 peers). Deletes still invalidate (rarer, and removal from
+            # a list is O(N) anyway).
+            if self._vlist is not None:
+                self._vlist.append(vertex)
 
     def delete_vertex(self, vid: str) -> None:
         with self._lock:
@@ -93,6 +100,17 @@ class DAG(Generic[V]):
         with self._lock:
             vs = list(self._v.values())
         return (v.value for v in vs)
+
+    def first_match(self, pred) -> V | None:
+        """First value satisfying pred, scanned under the lock WITHOUT the
+        values() snapshot copy — the has-available-peer probe runs on every
+        registration and usually matches the first vertex; copying 10^5
+        vertices first made it O(N) per register (swarm-simulator finding)."""
+        with self._lock:
+            for v in self._v.values():
+                if pred(v.value):
+                    return v.value
+        return None
 
     def add_edge(self, from_id: str, to_id: str) -> None:
         """Add from->to; rejects self-loops and edges that would close a cycle."""

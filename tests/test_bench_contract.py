@@ -162,6 +162,34 @@ def test_federation_contract():
     assert 0.2 < out["reshard_moved_frac_join_1to2"] < 0.75
 
 
+def test_swarm_sim_contract():
+    # tiny shapes: one ladder rung at 600 peers pins the key set, the
+    # null-hygiene shape, and the scenario-level properties the driver's
+    # swarm_sim JSON consumers read — the real scale number comes from the
+    # full bench run's ladder
+    out = bench.bench_swarm_sim(wall_budget_s=4.0, start_peers=600, max_peers=600)
+    for key in (
+        "swarm_sim_events_per_sec", "swarm_sim_peers", "swarm_sim_events",
+        "swarm_sim_wall_s", "swarm_sim_virtual_s", "swarm_sim_time_compression",
+        "swarm_sim_flash_origin_egress_ratio", "swarm_sim_same_region_frac",
+        "swarm_sim_completed_frac", "swarm_sim_fed_convergence_virtual_s",
+        "swarm_sim_wall_budget_s",
+    ):
+        assert key in out, key
+    assert out["swarm_sim_peers"] == 600
+    assert out["swarm_sim_events_per_sec"] > 0
+    assert out["swarm_sim_events"] > 600  # more events than peers: real rounds ran
+    # virtual time outruns the wall by construction (the whole point)
+    assert out["swarm_sim_time_compression"] > 1.0
+    # the O(1)-egress property at tiny scale: a bounded number of task-sized
+    # origin fetches, not one per peer
+    assert 0 < out["swarm_sim_flash_origin_egress_ratio"] <= 8.0
+    assert out["swarm_sim_completed_frac"] >= 0.95
+    # 2 ring members gossip in the scenario: convergence must be measured
+    assert out["swarm_sim_fed_convergence_virtual_s"] is not None
+    assert out["swarm_sim_fed_convergence_virtual_s"] > 0
+
+
 def test_piece_pipeline_contract():
     # tiny shape: pins the ISSUE 13 key set — TLS fast path (cipher A/B,
     # handshake storm, kTLS null-probe), striped-vs-single A/B over real

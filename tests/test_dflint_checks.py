@@ -980,6 +980,91 @@ def test_df028_not_run_per_file():
 
 
 # ---------------------------------------------------------------------------
+# DF029 wall-clock reads inside sim/ (virtual-clock discipline)
+
+_SIM_PATH = "dragonfly2_tpu/sim/engine.py"
+
+
+def test_df029_fires_on_wall_clock_reads_in_sim():
+    src = """
+    import time
+
+    def now():
+        return time.time()
+
+    def tick():
+        return time.monotonic()
+    """
+    vs = dflint.lint_source(textwrap.dedent(src), _SIM_PATH)
+    assert [v.check for v in vs] == ["DF029", "DF029"]
+    assert "virtual" in vs[0].message
+
+
+def test_df029_fires_on_from_import_and_perf_counter_and_sleep():
+    src = """
+    import asyncio
+    from time import perf_counter, sleep
+
+    async def f():
+        t0 = perf_counter()
+        sleep(0.1)
+        await asyncio.sleep(0.1)
+        return t0
+    """
+    # sleep() in async also trips DF022 — both are right; DF029 must cover
+    # perf_counter, time.sleep, and asyncio.sleep
+    checks = ids(src, _SIM_PATH)
+    assert "DF029" in checks
+    vs = [v for v in dflint.lint_source(textwrap.dedent(src), _SIM_PATH)
+          if v.check == "DF029"]
+    assert len(vs) == 3
+
+
+def test_df029_fires_on_loop_time_and_datetime_now():
+    src = """
+    import asyncio
+    import datetime
+
+    def f(loop):
+        a = loop.time()
+        b = asyncio.get_event_loop().time()
+        return a, b, datetime.datetime.now()
+    """
+    vs = [v for v in dflint.lint_source(textwrap.dedent(src), _SIM_PATH)
+          if v.check == "DF029"]
+    # loop.time() hits via the loop-receiver heuristic (the get_event_loop()
+    # chain has a dynamic receiver and is out of dotted-name reach);
+    # datetime.now via the resolved tail
+    assert len(vs) == 2
+
+
+def test_df029_silent_outside_sim_and_on_injected_clock():
+    src = """
+    import time
+
+    def now():
+        return time.time()
+    """
+    assert "DF029" not in ids(src, "dragonfly2_tpu/daemon/engine.py")
+    clock_src = """
+    class Engine:
+        def now(self):
+            return self.clock.time() + self.clock.monotonic()
+    """
+    assert ids(clock_src, _SIM_PATH) == []
+
+
+def test_df029_suppressible_with_reason():
+    src = """
+    import time
+
+    def meter():
+        return time.perf_counter()  # dflint: disable=DF029 wall events/s meter
+    """
+    assert ids(src, _SIM_PATH) == []
+
+
+# ---------------------------------------------------------------------------
 # suppression handling
 
 

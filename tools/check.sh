@@ -120,6 +120,31 @@ run_stage "concurrency-smoke" env JAX_PLATFORMS=cpu python -m pytest tests/test_
 run_stage "federation-smoke" env JAX_PLATFORMS=cpu python -m dragonfly2_tpu.cli.dfcluster \
     demo --payload-kb 6144 --verify-trace
 
+# sim-smoke: the discrete-event swarm simulator at 10^4 peers — the
+# flash-crowd scenario against the REAL scheduler+evaluator+federation
+# objects (virtual clock, zero sockets), in-process through the dfsim JSON
+# contract: placement quality, O(1)-per-region origin egress, the
+# no-departed-peer invariant, and the telemetry→DatasetAccumulator bridge.
+# The 10^5 acceptance shape is the slow-marked test in tests/test_sim.py.
+run_stage "sim-smoke" env JAX_PLATFORMS=cpu python -c "
+import logging; logging.disable(logging.WARNING)
+from dragonfly2_tpu.cli.dfsim import run_scenario
+out = run_scenario('flash-crowd', peers=10_000, seed=0)
+assert out['peers'] == 10_000, out['peers']
+assert out['outcomes']['completed'] >= 9_500, out['outcomes']
+assert out['events_per_sec'] > 0 and out['time_compression'] > 1.0
+pl = out['placement']
+assert pl['rounds'] > 9_000 and pl['same_region_frac'] >= 0.5, pl
+assert 0 < out['origin_egress']['max_region_fetches'] <= 8.0, out['origin_egress']
+assert out['violations']['departed_parent_rounds'] == 0, out['violations']
+assert out['telemetry']['nodes'] > 0 and out['telemetry']['edges'] > 0, out['telemetry']
+assert out['assertions']['passed'], out['assertions']
+print('sim smoke ok:', {'peers': out['peers'], 'events_per_sec': out['events_per_sec'],
+      'same_region_frac': pl['same_region_frac'],
+      'origin_fetches': out['origin_egress']['max_region_fetches'],
+      'dataset_nodes': out['telemetry']['nodes']})
+"
+
 # metrics-smoke: the cluster metrics plane against the live box — boots
 # manager + 2 ml schedulers + 2 daemons, real dfget traffic, asserts
 # `dftop --once --json` shows every member with live windowed rates, then

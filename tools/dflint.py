@@ -51,6 +51,13 @@ Checks (see README.md "Static analysis" for the catalog):
          and alert rules read as "healthy" (the PR 11 heartbeat bug class).
          This is dflint's first CROSS-FILE check: declarations in one module
          are cleared by touches in any other.
+  DF029  wall-clock read or real sleep inside the sim/ package (virtual-
+         clock discipline): the discrete-event simulator orders EVERYTHING
+         by its injected VirtualClock — one stray time.time()/
+         time.monotonic()/asyncio.sleep()/loop.time() silently mixes wall
+         time into event ordering and corrupts the simulation without
+         crashing it. Read time through the engine's clock (utils/clock.py);
+         the engine's own events/s wall meter is the one suppressed site.
   DF031  silent exception swallow: bare/overbroad except whose body is only
          pass/continue/... (no log, no narrowing)
   DF032  mutable default argument (list/dict/set literal or constructor)
@@ -94,6 +101,7 @@ CHECKS: dict[str, str] = {
     "DF026": "Thread/ThreadPoolExecutor constructed on a hot path (pool churn)",
     "DF027": "Tracer.span(...) not used as a `with` context manager (leaked span)",
     "DF028": "module-scope metric family never incremented/observed anywhere (dead metric)",
+    "DF029": "wall-clock read or real sleep inside sim/ (virtual-clock discipline)",
     "DF031": "bare/overbroad except silently swallowing the error",
     "DF032": "mutable default argument",
     "DF033": "per-row numpy array construction inside a for loop (vectorize)",
@@ -932,6 +940,54 @@ def _is_broad_handler(handler: ast.ExceptHandler) -> bool:
     return False
 
 
+# DF029: wall-clock reads inside the sim/ package. Calls that read the
+# process clock or sleep for real time — each one a way wall time can leak
+# into virtual event ordering. datetime.now/utcnow/today are matched on the
+# resolved dotted tail so both `datetime.now()` (from-import) and
+# `datetime.datetime.now()` hit.
+WALL_CLOCK_CALLS = {
+    "time.time", "time.monotonic", "time.monotonic_ns", "time.time_ns",
+    "time.perf_counter", "time.perf_counter_ns", "time.sleep",
+    "asyncio.sleep",
+}
+_WALL_DATETIME_TAILS = ("datetime.now", "datetime.utcnow", "datetime.today")
+
+
+def _in_sim_package(path: str) -> bool:
+    parts = Path(path).parts
+    return "sim" in parts
+
+
+def check_wall_clock_in_sim(tree: ast.Module, path: str) -> Iterator[Violation]:
+    """DF029: any wall-clock/real-sleep call inside sim/ — the virtual-clock
+    discipline. Also flags `<something>loop.time()`: an event-loop time read
+    is only virtual if the loop is the simulator's, which the linter cannot
+    prove — route it through the engine's clock instead."""
+    if not _in_sim_package(path):
+        return
+    aliases = import_aliases(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _resolved_call_name(node, aliases)
+        bad = (
+            name in WALL_CLOCK_CALLS
+            or name.endswith(_WALL_DATETIME_TAILS)
+            or (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "time"
+                and "loop" in dotted(node.func.value).rsplit(".", 1)[-1].lower()
+            )
+        )
+        if bad:
+            yield Violation(
+                path, node.lineno, node.col_offset, "DF029",
+                f"{name or 'loop.time'}() inside sim/ mixes wall time into "
+                "virtual event ordering — read the engine's injected clock "
+                "(utils/clock.py) instead",
+            )
+
+
 def check_silent_swallow(tree: ast.Module, path: str) -> Iterator[Violation]:
     """DF031: broad except whose body is only pass/continue/ellipsis."""
     for node in ast.walk(tree):
@@ -1142,6 +1198,7 @@ ALL_CHECKS = (
     check_rpc_in_loop,
     check_thread_churn,
     check_span_without_with,
+    check_wall_clock_in_sim,
     check_silent_swallow,
     check_mutable_defaults,
     check_np_ctor_in_row_loop,
