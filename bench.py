@@ -35,12 +35,12 @@ import numpy as np
 
 _SECTION_TIMEOUT_S = int(os.environ.get("DF_BENCH_SECTION_TIMEOUT", "420"))
 _PROBE_TIMEOUT_S = int(os.environ.get("DF_BENCH_PROBE_TIMEOUT", "240"))
-# The worker must outlive its own worst case: fourteen SIGALRM-bounded
+# The worker must outlive its own worst case: fifteen SIGALRM-bounded
 # sections plus backend init/compile margin — otherwise the supervisor would
 # kill it and discard sections that did complete.
 _WORKER_TIMEOUT_S = max(
     int(os.environ.get("DF_BENCH_WORKER_TIMEOUT", "1500")),
-    14 * _SECTION_TIMEOUT_S + _PROBE_TIMEOUT_S + 120,
+    15 * _SECTION_TIMEOUT_S + _PROBE_TIMEOUT_S + 120,
 )
 
 
@@ -2394,6 +2394,157 @@ def bench_metrics_plane(rounds: int = 1200, sample_probes: int = 50) -> dict:
     return out
 
 
+def bench_ml_observability(rounds: int = 1200, probes: int = 400) -> dict:
+    """ML-plane observability cost (ISSUE 15 acceptance: decision recorder +
+    live drift sketch ≤1% on the real serial round at the default sample
+    rate): interleaved SAME-RUN A/B of the REAL serial scheduling round with
+    both instruments OFF vs ON at shipping defaults, plus the deterministic
+    decomposition — the measured per-op cost of one forced decision record
+    and one sketch fold, and the overhead those IMPLY at the default
+    sampling strides (the A/B pct on a 2-core CI box carries scheduler noise
+    of the same magnitude as the effect; the implied figure does not).
+
+      ml_obs_round_rps_off/on           rounds/s, instruments off vs on
+      ml_obs_overhead_pct               (off-on)/off from the A/B (noisy)
+      ml_obs_implied_overhead_pct       (record_us*rate + sketch_us/stride)
+                                        / round_us — the ≤1% acceptance
+      decision_record_us                one forced (sampled-in) record
+      ml_obs_decision_sample_rate       the shipped default stride
+      sketch_update_ns_per_row          FeatureSketch.update per feature row
+      drift_score_us                    one full per-feature PSI compute
+      decision_ring_records             ring occupancy after the on legs
+
+    Nulls (never 0.0) on a skipped/failed leg per the PR 6 hygiene rule."""
+    import asyncio
+    import random as _random
+
+    from dragonfly2_tpu.models.features import FEATURE_DIM, FEATURE_NAMES
+    from dragonfly2_tpu.observability.sketches import DriftDetector, FeatureSketch, psi
+    from dragonfly2_tpu.scheduler.evaluator import (
+        DECISION_SAMPLE_DEFAULT,
+        DecisionRecorder,
+        new_evaluator,
+    )
+    from dragonfly2_tpu.scheduler.resource import HostType
+    from dragonfly2_tpu.scheduler.service import SchedulerService
+
+    out: dict = {
+        "ml_obs_round_rps_off": None,
+        "ml_obs_round_rps_on": None,
+        "ml_obs_overhead_pct": None,
+        "ml_obs_implied_overhead_pct": None,
+        "ml_obs_decision_sample_rate": DECISION_SAMPLE_DEFAULT,
+        "decision_record_us": None,
+        "sketch_update_ns_per_row": None,
+        "drift_score_us": None,
+        "decision_ring_records": None,
+    }
+    try:
+        # the production serving shape: an ml evaluator (base fallback — no
+        # model in a bench worker) whose _prepare/fallback path carries both
+        # instruments; the pool mirrors the metrics_plane section's
+        svc = SchedulerService(
+            evaluator=new_evaluator("ml"),
+            decision_sample_rate=DECISION_SAMPLE_DEFAULT,
+        )
+        task = svc.pool.load_or_create_task("mlo-task", "http://origin/mlo.bin")
+        task.set_metadata(1 << 30, 4 << 20)
+        children = []
+        for i in range(96):
+            h = svc.pool.load_or_create_host(
+                f"mlh{i}", f"10.9.{i // 256}.{i % 256}", f"mlhost{i}",
+                download_port=8000, host_type=HostType.NORMAL,
+            )
+            h.upload_limit = 10_000
+            p = svc.pool.create_peer(f"mlp{i}", task, h)
+            for evname in ("register", "download"):
+                if p.fsm.can(evname):
+                    p.fsm.fire(evname)
+            if i < 8:
+                children.append(p)
+            else:
+                for idx in range(8):
+                    p.finished_pieces.set(idx)
+                p.bump_feat()
+        rng = _random.Random(11)
+        for c in children:
+            for h in list(svc.pool.hosts.values())[:40]:
+                svc.topology.enqueue(c.host.id, h.id, rng.uniform(0.2, 30.0))
+                svc.bandwidth.observe(h.id, c.host.id, rng.uniform(1e8, 1e9))
+
+        nprng = np.random.default_rng(11)
+        ref = FeatureSketch(FEATURE_DIM, names=FEATURE_NAMES)
+        ref.update(nprng.random((5000, FEATURE_DIM)).astype(np.float32))
+
+        drift_on = svc.drift
+        decisions_on = svc.decisions
+
+        async def round_leg(on: bool) -> float:
+            from dragonfly2_tpu.scheduler.scheduling import Scheduling
+
+            if on:
+                svc.evaluator.decisions = decisions_on
+                svc.evaluator.drift = drift_on
+                drift_on.set_reference(ref, version="bench")
+            else:
+                svc.evaluator.decisions = None
+                svc.evaluator.drift = None
+            sched = Scheduling(svc.evaluator)  # fresh seeded rng per leg
+            t0 = time.perf_counter()
+            for r in range(rounds):
+                await sched.find_candidate_parents_async(children[r % len(children)])
+            return rounds / (time.perf_counter() - t0)
+
+        offs, ons = [], []
+        for _rep in range(3):
+            offs.append(asyncio.run(round_leg(False)))
+            ons.append(asyncio.run(round_leg(True)))
+        off, on = float(np.median(offs)), float(np.median(ons))
+        out["ml_obs_round_rps_off"] = round(off, 1)
+        out["ml_obs_round_rps_on"] = round(on, 1)
+        out["ml_obs_overhead_pct"] = round((off - on) / off * 100.0, 2)
+        out["decision_ring_records"] = decisions_on.stats()["records"]
+
+        # ---- deterministic decomposition ----
+        feats = nprng.random((40, FEATURE_DIM)).astype(np.float32)
+        scores = nprng.random(40).astype(np.float32)
+        child = children[0]
+        cands = [p for p in task.peers() if p is not child][:40]
+        rec = DecisionRecorder(sample_rate=1.0, clock=svc.clock)
+        t0 = time.perf_counter()
+        for _ in range(probes):
+            rec.maybe_record(child, cands, feats, scores)
+        record_us = (time.perf_counter() - t0) / probes * 1e6
+        out["decision_record_us"] = round(record_us, 2)
+
+        sk = FeatureSketch(FEATURE_DIM, names=FEATURE_NAMES)
+        t0 = time.perf_counter()
+        for _ in range(probes):
+            sk.update(feats)
+        sketch_us = (time.perf_counter() - t0) / probes * 1e6
+        out["sketch_update_ns_per_row"] = round(sketch_us / len(feats) * 1e3, 1)
+
+        live = FeatureSketch(FEATURE_DIM, names=FEATURE_NAMES)
+        live.update(nprng.random((2000, FEATURE_DIM)).astype(np.float32))
+        t0 = time.perf_counter()
+        for _ in range(probes):
+            psi(ref, live)
+        out["drift_score_us"] = round((time.perf_counter() - t0) / probes * 1e6, 2)
+
+        # the acceptance figure: per-round cost at the DEFAULT strides over
+        # the measured uninstrumented round (A/B-noise-free by construction)
+        round_us = 1e6 / off
+        stride = DriftDetector().sample_stride
+        implied = (
+            record_us * DECISION_SAMPLE_DEFAULT + sketch_us / stride
+        ) / round_us * 100.0
+        out["ml_obs_implied_overhead_pct"] = round(implied, 3)
+        svc.close()
+    except Exception as e:  # noqa: BLE001 — leg skipped, keys stay null
+        print(f"bench: ml_observability leg failed: {e!r}", file=sys.stderr)
+    return out
+
+
 def bench_swarm_sim(
     wall_budget_s: float = 25.0,
     start_peers: int = 4_000,
@@ -2522,6 +2673,7 @@ def main() -> None:
     control_plane = run_section("control_plane", bench_control_plane, {})
     observability = run_section("observability", bench_observability, {})
     metrics_plane = run_section("metrics_plane", bench_metrics_plane, {})
+    ml_observability = run_section("ml_observability", bench_ml_observability, {})
     federation = run_section("federation", bench_federation, {})
     swarm_sim = run_section("swarm_sim", bench_swarm_sim, {})
     mlp_sps, mlp_mse = run_section("mlp_train", bench_mlp_train, (None, None))
@@ -2610,6 +2762,16 @@ def main() -> None:
         ),
         "metrics_plane_stats_frame_bytes": metrics_plane.get("stats_frame_bytes"),
         "metrics_plane": metrics_plane or "skipped",
+        # ML-plane observability (ISSUE 15): decision recorder + live drift
+        # sketch cost on the real serial round (acceptance ≤1% implied at
+        # the default sample rate; the A/B pct carries 2-core noise)
+        "ml_observability_overhead_pct": ml_observability.get(
+            "ml_obs_implied_overhead_pct"
+        ),
+        "ml_observability_decision_record_us": ml_observability.get(
+            "decision_record_us"
+        ),
+        "ml_observability": ml_observability or "skipped",
         # scheduler federation (ISSUE 10): swarm rounds/s through the
         # 2-scheduler ring, one-hop topology-sync convergence, watermarked
         # payload counter-assert, and ring re-shard churn bounds

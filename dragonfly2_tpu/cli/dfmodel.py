@@ -85,6 +85,31 @@ async def _rollout_main(args: argparse.Namespace) -> int:
             for r in st["rejected"]:
                 reason = (r.get("rollout") or {}).get("rejected_reason", "")
                 print(f"  rejected:  {r['version']} (id {r['id']})  {reason}")
+            # feature drift (ISSUE 15): each scheduler member's max PSI vs
+            # the serving model's training reference, read off the stats
+            # frames the members already push — best-effort (a cluster with
+            # no frames yet just prints nothing extra)
+            try:
+                # ONE decision boundary: sketches.classify_psi is what the
+                # alert rule and dfml read too
+                from dragonfly2_tpu.observability.sketches import classify_psi
+
+                cs = await mc.cluster_stats()
+                for m in cs.get("members") or []:
+                    if m.get("source_type") != "scheduler":
+                        continue
+                    rates = (m.get("frame") or {}).get("rates") or {}
+                    drift = rates.get("feature_drift_max")
+                    if drift is None:
+                        continue
+                    label = classify_psi(drift)
+                    flag = f" [{label}]" if label != "stable" else ""
+                    print(
+                        f"  drift:     {m.get('hostname', '?')} "
+                        f"feature_drift_max={drift:.3f}{flag}"
+                    )
+            except Exception:  # dflint: disable=DF031 drift line is best-effort decoration on status — a frameless cluster or old manager must not fail the command
+                pass
             return 0
         if args.cmd == "promote":
             model_id = args.id

@@ -58,9 +58,9 @@ def render(stats: dict, *, clear: bool = False) -> str:
         lines.append("alerts: none")
     header = (
         f"{'member':<18} {'type':<9} {'age':>5} "
-        f"{'rounds/s':>9} {'p95ms':>7} {'down MB/s':>10} {'up MB/s':>9} "
+        f"{'work/s':>9} {'p95ms':>7} {'down MB/s':>10} {'up MB/s':>9} "
         f"{'cipher':>8} {'lag p95':>8} {'util':>5} {'serving':>8} "
-        f"{'rollout':>12} alerts"
+        f"{'drift':>6} {'rollout':>12} alerts"
     )
     lines.append(header)
     lines.append("-" * len(header))
@@ -71,10 +71,16 @@ def render(stats: dict, *, clear: bool = False) -> str:
         if m.get("stale"):
             name += " (stale)"
         member_alerts = ",".join(frame.get("alerts") or ()) or "-"
+        # "work/s" is each member's native unit of work: scheduling rounds
+        # for a scheduler, training steps for a trainer (ISSUE 15 — a
+        # trainer member finally shows live learner work, not a blank)
+        work = r.get("rounds_per_s")
+        if work is None:
+            work = r.get("train_steps_per_s")
         lines.append(
             f"{name:<18} {m.get('source_type', '?'):<9} "
             f"{_fmt(m.get('age_s'), 0, 5)} "
-            f"{_fmt(r.get('rounds_per_s'))} "
+            f"{_fmt(work)} "
             f"{_fmt(r.get('round_p95_ms'), 2, 7)} "
             f"{_fmt(r.get('piece_down_mb_per_s'), 2, 10)} "
             f"{_fmt(r.get('piece_up_mb_per_s'), 2, 9)} "
@@ -82,6 +88,7 @@ def render(stats: dict, *, clear: bool = False) -> str:
             f"{_fmt(r.get('loop_lag_p95_ms'), 1, 8)} "
             f"{_fmt(r.get('dispatcher_utilization'), 2, 5)} "
             f"{str(frame.get('serving_mode', '-')):>8} "
+            f"{_fmt(r.get('feature_drift_max'), 2, 6)} "
             f"{str(frame.get('rollout_state', '-')):>12} "
             f"{member_alerts}"
         )

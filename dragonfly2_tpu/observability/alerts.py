@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 from typing import Any, Mapping, Optional
 
 from dragonfly2_tpu.observability.metrics import default_registry
+from dragonfly2_tpu.observability.sketches import PSI_MAJOR
 from dragonfly2_tpu.observability.timeseries import MetricsRecorder
 
 DEFAULT_EVAL_INTERVAL_S = 5.0
@@ -145,6 +146,23 @@ def default_rules() -> list[AlertRule]:
             # recorder→engine path production would page through.
             description="simulated scheduling rounds handed out a departed "
                         "peer (virtual-clock swarm invariant violation)",
+        ),
+        AlertRule(
+            name="feature_drift",
+            kind="value",
+            metric="dragonfly_feature_drift_max",
+            # ONE decision boundary with classify_psi()/dfml/dfmodel
+            bound=PSI_MAJOR,
+            window_s=60.0, for_s=0.0,
+            # the UNLABELED max gauge, not dragonfly_feature_drift{feature}:
+            # value-kind sums matching label sets (PromQL sum-by), and a sum
+            # of 16 per-feature PSIs would fire on collective noise; the max
+            # is the decision variable (0.25 = conventional "major shift").
+            # The per-feature detail stays queryable at /debug/ts.
+            description="live scoring-feature distribution drifted past "
+                        "PSI 0.25 vs the serving model's training reference "
+                        "(population shift — retrain or investigate; "
+                        "per-feature detail in dragonfly_feature_drift)",
         ),
         AlertRule(
             name="piece_tls_handshake_failures",

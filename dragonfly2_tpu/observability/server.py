@@ -13,6 +13,10 @@ The reference gives every service a dedicated metrics port plus pprof/statsview
                           family name → raw ring points plus the windowed
                           rate / histogram summary
   GET /debug/alerts       SLO rule engine state (observability.alerts)
+  GET /debug/decisions[?task=T&child=C&limit=N&features=0]
+                          sampled scoring decision records + feature-drift
+                          state (scheduler processes; `dfml explain` replays
+                          these — scheduler/evaluator.DecisionRecorder)
   GET /debug/stacks       every thread's stack + every asyncio task's frame
                           (the /debug/pprof/goroutine analogue)
   GET /debug/profile?seconds=N[&mode=sample&hz=H]
@@ -121,6 +125,7 @@ def make_debug_app(
     loophealth: LoopHealthMonitor | None = None,
     recorder=None,
     alerts=None,
+    decisions=None,
 ) -> web.Application:
     from dragonfly2_tpu.observability.alerts import default_engine
     from dragonfly2_tpu.observability.metrics import metrics_http_handler
@@ -168,6 +173,25 @@ def make_debug_app(
     async def alerts_status(_req: web.Request) -> web.Response:
         return web.json_response(eng.status())
 
+    async def decision_records(req: web.Request) -> web.Response:
+        # decisions: a SchedulerService (composition roots pass theirs) — a
+        # non-scheduler process answers with a typed "not here" instead of 404
+        # so curl against the wrong port is self-explaining
+        if decisions is None:
+            return web.json_response(
+                {"error": "no decision recorder in this process"}, status=404
+            )
+        try:
+            limit = min(256, max(1, int(req.query.get("limit", "16"))))
+        except ValueError:
+            raise web.HTTPBadRequest(text="limit must be an integer")
+        return web.json_response(decisions.decision_records(
+            task_id=req.query.get("task") or None,
+            child=req.query.get("child") or None,
+            limit=limit,
+            with_features=req.query.get("features", "1") != "0",
+        ))
+
     async def stacks(_req: web.Request) -> web.Response:
         return web.Response(text=_dump_stacks(), content_type="text/plain")
 
@@ -208,6 +232,7 @@ def make_debug_app(
     app.router.add_get("/debug/loop", loop_health)
     app.router.add_get("/debug/ts", timeseries)
     app.router.add_get("/debug/alerts", alerts_status)
+    app.router.add_get("/debug/decisions", decision_records)
     app.router.add_get("/debug/stacks", stacks)
     app.router.add_get("/debug/profile", profile)
     return app
@@ -224,10 +249,13 @@ class DebugServer:
         loophealth: LoopHealthMonitor | None = None,
         recorder=None,
         alerts=None,
+        decisions=None,
     ):
         self.host = host
         self.port = port
-        self._app = make_debug_app(registry, tracer, loophealth, recorder, alerts)
+        self._app = make_debug_app(
+            registry, tracer, loophealth, recorder, alerts, decisions
+        )
         self._runner: web.AppRunner | None = None
 
     async def start(self) -> None:
@@ -253,10 +281,12 @@ async def start_debug_server(
     loophealth: LoopHealthMonitor | None = None,
     recorder=None,
     alerts=None,
+    decisions=None,
 ) -> DebugServer:
     srv = DebugServer(
         host=host, port=port, registry=registry, tracer=tracer,
         loophealth=loophealth, recorder=recorder, alerts=alerts,
+        decisions=decisions,
     )
     await srv.start()
     return srv

@@ -478,6 +478,22 @@ def build_stats_frame(
     put("tls_handshake_failures_per_s", r.rate(
         "dragonfly_dfdaemon_piece_tls_handshake_failures_total", window_s=window_s
     ), 3)
+    # trainer plane (ISSUE 15): a trainer member shows live learner work —
+    # keys appear only in processes where the dragonfly_train_* families
+    # have children (the only-present-families schema, like everything here)
+    put("train_steps_per_s", r.rate(
+        "dragonfly_train_steps_total", window_s=window_s
+    ), 2)
+    put("train_examples_per_s", r.rate(
+        "dragonfly_train_examples_total", window_s=window_s
+    ), 1)
+    runs = r.latest("dragonfly_train_runs_total")
+    if runs is not None:
+        rates["train_runs_total"] = int(runs)
+    put("train_last_loss", r.latest("dragonfly_train_last_run_loss"), 5)
+    # ML-plane drift (ISSUE 15): max per-feature PSI vs the serving model's
+    # training reference — the number the feature_drift alert gates on
+    put("feature_drift_max", r.latest("dragonfly_feature_drift_max"), 4)
     # loop health
     lag = r.hist_window("dragonfly_loop_lag_seconds", window_s=window_s)
     if lag is not None:

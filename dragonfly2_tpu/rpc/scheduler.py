@@ -35,6 +35,7 @@ SCHEDULER_METHODS = [
     "sync_probes",
     "federation_sync",
     "federation_state",
+    "decision_records",
 ]
 
 
@@ -150,6 +151,15 @@ class SchedulerRpcAdapter:
     async def federation_state(self, p: Any = None) -> dict:
         return self.svc.federation_state()
 
+    async def decision_records(self, p: dict | None = None) -> dict:
+        p = p or {}
+        return self.svc.decision_records(
+            task_id=p.get("task_id"),
+            child=p.get("child"),
+            limit=int(p.get("limit", 64)),
+            with_features=bool(p.get("with_features", True)),
+        )
+
 
 def serve_scheduler(service: SchedulerService, **server_kw: Any) -> RpcServer:
     server = RpcServer(**server_kw)
@@ -250,6 +260,17 @@ class RemoteSchedulerClient:
 
     async def federation_state(self):
         return await self._rpc.call("federation_state")
+
+    async def decision_records(
+        self, *, task_id=None, child=None, limit: int = 64,
+        with_features: bool = True,
+    ):
+        """Sampled scoring decision records (ISSUE 15; `dfml explain`)."""
+        return await self._rpc.call(
+            "decision_records",
+            {"task_id": task_id, "child": child, "limit": limit,
+             "with_features": with_features},
+        )
 
     async def healthy(self) -> bool:
         return await self._rpc.healthy()

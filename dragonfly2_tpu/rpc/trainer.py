@@ -10,7 +10,9 @@ import numpy as np
 from dragonfly2_tpu.rpc.core import RpcClient, RpcServer
 from dragonfly2_tpu.trainer.service import TrainerService, pack_records
 
-TRAINER_METHODS = ["train_open", "train_chunk", "train_close", "status"]
+TRAINER_METHODS = [
+    "train_open", "train_chunk", "train_close", "status", "train_history",
+]
 
 
 def register_trainer(server: RpcServer, service: TrainerService) -> None:
@@ -42,3 +44,12 @@ class RemoteTrainerClient:
 
     async def status(self) -> dict:
         return await self._c.call("status")
+
+    async def train_history(
+        self, *, limit: int = 64, with_curves: bool = True
+    ) -> dict:
+        """Per-run manifests (ISSUE 15): run id, dataset size, per-model
+        steps / final loss / bounded loss curve, wall seconds."""
+        return await self._c.call(
+            "train_history", {"limit": limit, "with_curves": with_curves}
+        )

@@ -18,6 +18,8 @@ from __future__ import annotations
 import functools
 import logging
 import statistics
+import threading
+from collections import deque
 from typing import Sequence
 
 import numpy as np
@@ -28,6 +30,7 @@ from dragonfly2_tpu.models.features import (
     location_affinity,
 )
 from dragonfly2_tpu.scheduler.resource import HostType, Peer
+from dragonfly2_tpu.utils import clock as clockmod
 
 logger = logging.getLogger(__name__)
 
@@ -216,22 +219,191 @@ def build_pair_features(
     return f
 
 
+# ---------------------------------------------------------------------------
+# scoring decision records (ISSUE 15): why did THOSE parents win that round?
+
+# Sampled, not exhaustive: one full record (feature matrix + scores + ids)
+# is a few KB and costs ~10-20µs to capture; at the default 1-in-50 a
+# 10k-rounds/s scheduler spends ~0.2µs/round recording (inside the bench's
+# ≤1% combined budget with the drift sketch) and the 256-slot ring still
+# refreshes every ~1.3s. DRAGONFLY_DECISION_SAMPLE / SchedulerService
+# (decision_sample_rate=) override; smokes/tests run at 1.0.
+DECISION_SAMPLE_DEFAULT = 0.02
+DECISION_RING_DEFAULT = 256
+
+
+class DecisionRecorder:
+    """Bounded, sampled ring of scoring decisions.
+
+    Each recorded round captures the full evidence a post-hoc "why did these
+    parents win" question needs: the candidate parent set (peer + host ids),
+    the assembled feature rows EXACTLY as scored, the score vector, the
+    chosen top-k (recomputed with the same stable argsort
+    Scheduling._top_parents uses, so the stored choice is bit-exact with the
+    round's), the serving model version/mode, and the active trace_id when a
+    trace is recording — `dftrace` finds the round, `dfml explain` replays
+    it. Served at /debug/decisions and over the `decision_records` scheduler
+    RPC.
+
+    Sampling is a deterministic stride (ratio-exact, no rng — the
+    ShadowTracker discipline); the ring and counters live behind one small
+    lock because rounds record from dispatcher worker threads. Timestamps
+    come from an injected clock (DF029) so recorded rounds inside the swarm
+    simulator stamp virtual time.
+    """
+
+    def __init__(
+        self,
+        *,
+        sample_rate: float = DECISION_SAMPLE_DEFAULT,
+        capacity: int = DECISION_RING_DEFAULT,
+        topk: int = 4,
+        clock: clockmod.Clock | None = None,
+    ):
+        self.sample_rate = float(sample_rate)
+        self._stride = (
+            max(1, round(1.0 / sample_rate)) if sample_rate > 0 else 0
+        )
+        self.topk = int(topk)
+        self._clock = clock or clockmod.SYSTEM
+        self._ring: deque = deque(maxlen=int(capacity))
+        self._lock = threading.Lock()
+        self.rounds_seen = 0
+        self.recorded = 0
+        self._seq = 0
+
+    def maybe_record(self, child, parents, feats, scores, *, bundle=None) -> None:
+        """Record this round if the stride elects it. Cheap when it doesn't:
+        one lock + counter. Never raises into the scoring path.
+
+        The sampled-in path stays lean too (it rides the serving round):
+        per-parent ids as tuples, score/feature arrays stored by REFERENCE
+        (both are freshly allocated per round and never mutated after — see
+        build_pair_features/_base_from), chosen computed with the exact
+        stable argsort Scheduling._top_parents runs (the bit-exact replay
+        contract), everything else deferred to snapshot()."""
+        stride = self._stride
+        if stride == 0:
+            return
+        try:
+            with self._lock:
+                self.rounds_seen += 1
+                if self.rounds_seen % stride:
+                    return
+                self._seq += 1
+                seq = self._seq
+            # EXACTLY _top_parents' selection: same negation dtype, same
+            # stable argsort — the stored chosen must replay bit-for-bit
+            order = np.argsort(-np.asarray(scores), kind="stable")
+            chosen = [parents[i].id for i in order[: self.topk]]
+            from dragonfly2_tpu.observability.tracing import Tracer
+
+            ctx = Tracer.current_context()
+            record = {
+                "seq": seq,
+                "ts": self._clock.time(),
+                "task_id": child.task.id,
+                "child_peer": child.id,
+                "child_host": child.host.id,
+                "parents": [(p.id, p.host.id) for p in parents],
+                "scores": scores,  # by reference until snapshot()
+                "feats": feats,
+                "chosen": chosen,
+                "topk": self.topk,
+                "model_version": getattr(bundle, "version", "") or "",
+                "serving_mode": self._mode_label(bundle),
+                "trace_id": (
+                    ctx.trace_id if ctx is not None and ctx.sampled else ""
+                ),
+            }
+            with self._lock:
+                self._ring.append(record)
+                self.recorded += 1
+        except Exception:
+            logger.exception("decision record failed")
+
+    @staticmethod
+    def _mode_label(bundle) -> str:
+        if bundle is None:
+            return "base"
+        scorer = getattr(bundle, "scorer", None)
+        return getattr(scorer, "engine", None) or (
+            "native" if hasattr(scorer, "score_rounds") else "jax"
+        )
+
+    def snapshot(
+        self,
+        *,
+        task_id: str | None = None,
+        child: str | None = None,
+        limit: int = 64,
+        with_features: bool = True,
+    ) -> list[dict]:
+        """Newest-first JSON-safe records; `child` matches the child peer OR
+        child host id. Scores/features serialize exactly (no rounding) — the
+        replay contract is bit-exact."""
+        with self._lock:
+            records = list(self._ring)
+        out: list[dict] = []
+        for r in reversed(records):
+            if task_id is not None and r["task_id"] != task_id:
+                continue
+            if child is not None and child not in (r["child_peer"], r["child_host"]):
+                continue
+            d = {
+                k: v for k, v in r.items()
+                if k not in ("scores", "feats", "parents")
+            }
+            d["parents"] = [{"peer": p, "host": h} for p, h in r["parents"]]
+            d["scores"] = [float(x) for x in r["scores"]]
+            if with_features:
+                d["feats"] = [[float(x) for x in row] for row in np.asarray(r["feats"])]  # dflint: disable=DF033 cold introspection path — per-record JSON conversion of a ring snapshot, not a columnar pass
+            out.append(d)
+            if len(out) >= limit:
+                break
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "sample_rate": self.sample_rate,
+                "capacity": self._ring.maxlen,
+                "records": len(self._ring),
+                "rounds_seen": self.rounds_seen,
+                "recorded": self.recorded,
+                "topk": self.topk,
+            }
+
+
 class Evaluator:
     """Base linear evaluator + bad-node detection. Subclass for `ml`."""
 
     name = "base"
     topology = None  # NetworkTopology, attached by the scheduler service
     bandwidth = None  # telemetry.BandwidthHistory, attached by the service
+    # ML-plane observability seams (ISSUE 15), attached by the scheduler
+    # service / manager link; None = recording/drift off (library default)
+    decisions: "DecisionRecorder | None" = None
+    drift = None  # observability.sketches.DriftDetector
     # Assembly seam: the bench's control_plane A/B swaps in
     # _build_pair_features_rowwise on a baseline instance; production always
     # serves the cached path.
     feature_builder = staticmethod(build_pair_features)
 
+    def _record_decision(self, child, parents, feats, scores, bundle=None) -> None:
+        """Sampled decision-record hook (ISSUE 15): cheap None-check per
+        round when no recorder is attached; maybe_record never raises."""
+        rec = self.decisions
+        if rec is not None:
+            rec.maybe_record(child, parents, feats, scores, bundle=bundle)
+
     def evaluate(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:
         if not parents:
             return np.zeros(0, dtype=np.float32)
         feats = self.feature_builder(child, parents, self.topology, self.bandwidth)
-        return feats @ BASE_WEIGHTS
+        out = feats @ BASE_WEIGHTS
+        self._record_decision(child, parents, feats, out)
+        return out
 
     def evaluate_many(
         self, rounds: Sequence[tuple[Peer, Sequence[Peer]]]
@@ -531,6 +703,12 @@ class MLEvaluator(Evaluator):
         if bundle is None:
             bundle = self._serving
         feats = self.feature_builder(child, parents, self.topology, self.bandwidth)
+        # feature-drift live sketch (ISSUE 15): sampled fold of the assembled
+        # matrix — the drift detector compares exactly what scoring sees
+        # against the distribution the serving model trained on
+        d = self.drift
+        if d is not None:
+            d.observe(feats)
         child_idx = bundle.node_index.get(child.host.id) if bundle is not None else None
         if child_idx is None:
             return feats, None, None, None
@@ -560,14 +738,19 @@ class MLEvaluator(Evaluator):
         if bundle is None or not bundle.ready:
             self._count_fallback("no_scorer")
             feats = self.feature_builder(child, parents, self.topology, self.bandwidth)
+            d = self.drift
+            if d is not None:
+                d.observe(feats)
             out = self._base_from(feats)
             self._shadow_score(child, parents, feats, out)
+            self._record_decision(child, parents, feats, out)
             return out
         feats, c, p, known = self._prepare(child, parents, bundle)
         if c is None:
             self._count_fallback("unknown_hosts")
             out = self._base_from(feats)
             self._shadow_score(child, parents, feats, out)
+            self._record_decision(child, parents, feats, out)
             return out
         # Per-thread handle when a pool is attached: dispatcher workers each
         # score on their own native handle (the pool hands the constructing
@@ -581,6 +764,7 @@ class MLEvaluator(Evaluator):
                 self._count_fallback("scorer_error")
                 out = self._base_from(feats)
                 self._shadow_score(child, parents, feats, out)
+                self._record_decision(child, parents, feats, out)
                 return out
         finally:
             bundle.end()
@@ -589,6 +773,7 @@ class MLEvaluator(Evaluator):
         else:
             out = np.where(known, ml, self._base_from(feats)).astype(np.float32)
         self._shadow_score(child, parents, feats, out)
+        self._record_decision(child, parents, feats, out, bundle=bundle)
         return out
 
     def evaluate_many(
@@ -621,6 +806,7 @@ class MLEvaluator(Evaluator):
                 self._count_fallback("unknown_hosts")
                 outs[i] = self._base_from(feats)
                 self._shadow_score(child, parents, feats, outs[i])
+                self._record_decision(child, parents, feats, outs[i])
             else:
                 prepared.append((i, feats, c, p, known))
         if not prepared:
@@ -658,6 +844,8 @@ class MLEvaluator(Evaluator):
                         logger.exception("ml scorer failed; using base evaluator")
                         self._count_fallback("scorer_error")
                         outs[i] = self._base_from(f)
+                        ch, ps = rounds[i]
+                        self._record_decision(ch, ps, f, outs[i])
                         continue
                 else:
                     ml = ml_rounds[m, : len(c)]
@@ -665,6 +853,8 @@ class MLEvaluator(Evaluator):
                     outs[i] = np.asarray(ml, dtype=np.float32)
                 else:
                     outs[i] = np.where(known, ml, self._base_from(f)).astype(np.float32)
+                ch, ps = rounds[i]
+                self._record_decision(ch, ps, f, outs[i], bundle=bundle)
         finally:
             bundle.end()
         if self._shadow is not None:
@@ -689,6 +879,7 @@ class MLEvaluator(Evaluator):
             self._count_fallback("unknown_hosts")
             out = self._base_from(feats)
             self._shadow_score(child, parents, feats, out)
+            self._record_decision(child, parents, feats, out)
             return out
         # the refcount spans the await: the coalesced flush scores on this
         # bundle's primary scorer, which must not be freed under it
@@ -700,6 +891,7 @@ class MLEvaluator(Evaluator):
             self._count_fallback("scorer_error")
             out = self._base_from(feats)
             self._shadow_score(child, parents, feats, out)
+            self._record_decision(child, parents, feats, out)
             return out
         finally:
             bundle.end()
@@ -708,6 +900,7 @@ class MLEvaluator(Evaluator):
         else:
             out = np.where(known, ml, self._base_from(feats)).astype(np.float32)
         self._shadow_score(child, parents, feats, out)
+        self._record_decision(child, parents, feats, out, bundle=bundle)
         return out
 
 

@@ -495,6 +495,7 @@ class ManagerLink:
             )
         self._active_model_version = version
         self._note_swap("ok")
+        self._install_drift_reference(ev, row)
         logger.info(
             "ml evaluator upgraded to model %s (%d hosts, microbatch=%s, "
             "handle_pool=%s, warm_prev=%s)",
@@ -502,6 +503,27 @@ class ManagerLink:
             handle_pool is not None,
             self._warm_prev.version if self._warm_prev is not None else None,
         )
+
+    @staticmethod
+    def _install_drift_reference(ev, row: dict) -> None:
+        """Feature-drift baseline (ISSUE 15): load the artifact's
+        training-reference sketch (digest-covered — verify_artifact already
+        passed for this path) into the evaluator's drift detector. A
+        pre-sketch artifact clears the reference: drift must never compare
+        live traffic against a PREVIOUS model's training distribution."""
+        drift = getattr(ev, "drift", None)
+        if drift is None:
+            return
+        from dragonfly2_tpu.trainer import artifacts
+
+        sketch = None
+        try:
+            sketch = artifacts.load_sketch(row.get("artifact_path", ""))
+        except Exception:
+            logger.exception(
+                "reference sketch load failed for %s", row.get("version", "")
+            )
+        drift.set_reference(sketch, version=row.get("version", ""))
 
     async def _check_candidate(self, status: dict) -> bool:
         """Shadow-scoring leg: attach the newest candidate (digest-verified;
@@ -631,6 +653,12 @@ class ManagerLink:
             return
         bad = ev.swap_bundle(prev)  # instant: prev's handles are still warm
         self._warm_prev = None
+        # drift baseline: the bad model's reference no longer describes what
+        # serves — CLEAR rather than guess (the warm bundle carries no
+        # artifact path; the next registry-driven install re-references)
+        drift = getattr(ev, "drift", None)
+        if drift is not None:
+            drift.set_reference(None)
         bad_version = self._active_model_version
         if bad is not None:
             if bad.version:

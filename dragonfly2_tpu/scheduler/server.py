@@ -82,7 +82,9 @@ async def run_scheduler(
     if metrics_port is not None:
         from dragonfly2_tpu.observability.server import start_debug_server
 
-        debug = await start_debug_server(host=host, port=metrics_port)
+        debug = await start_debug_server(
+            host=host, port=metrics_port, decisions=service,
+        )
         logger.info("scheduler metrics on %s:%d", host, debug.port)
 
     link = None

@@ -99,7 +99,13 @@ class SchedulerService:
         seed_trigger: Callable[[Task], Awaitable[None]] | None = None,
         clock=None,
         topology_rng=None,
+        decision_sample_rate: float | None = None,
     ):
+        from dragonfly2_tpu.observability.sketches import DriftDetector
+        from dragonfly2_tpu.scheduler.evaluator import (
+            DECISION_SAMPLE_DEFAULT,
+            DecisionRecorder,
+        )
         from dragonfly2_tpu.scheduler.networktopology import NetworkTopology
         from dragonfly2_tpu.telemetry import BandwidthHistory
         from dragonfly2_tpu.utils import clock as clockmod
@@ -118,6 +124,30 @@ class SchedulerService:
         self.local_metrics = metrics.ServiceMetrics()
         self.evaluator.local_metrics = self.local_metrics
         self.scheduling = Scheduling(self.evaluator, scheduling_config)
+        # ---- ML-plane observability (ISSUE 15) ----
+        # Decision records: a bounded sampled ring of scoring rounds (who the
+        # candidates were, the feature rows as scored, scores, chosen top-k,
+        # serving version, trace id) served at /debug/decisions and the
+        # decision_records RPC; `dfml explain` replays them. Clock-injected
+        # so simulated rounds stamp virtual time (DF029).
+        if decision_sample_rate is None:
+            import os as _os2
+
+            decision_sample_rate = float(
+                _os2.environ.get("DRAGONFLY_DECISION_SAMPLE", "")
+                or DECISION_SAMPLE_DEFAULT
+            )
+        self.decisions = DecisionRecorder(
+            sample_rate=decision_sample_rate,
+            topk=self.scheduling.config.candidate_parent_limit,
+            clock=self.clock,
+        )
+        self.evaluator.decisions = self.decisions
+        # Feature drift: live-sketch feed at the evaluator's _prepare vs the
+        # training-reference sketch the ManagerLink installs from the model
+        # artifact; dormant (a None-check per round) until a reference lands.
+        self.drift = DriftDetector(clock=self.clock)
+        self.evaluator.drift = self.drift
         # Scheduler state lock (see Scheduling.state_lock): every mutator
         # below holds it around its mutating block so the round dispatcher's
         # worker threads (sample+filter) see consistent peer state. With no
@@ -744,4 +774,27 @@ class SchedulerService:
             "total_pieces": task.total_pieces,
             "peer_count": task.peer_count(),
             "size_scope": task.size_scope().value,
+        }
+
+    # ---- ML-plane observability (ISSUE 15) ----
+
+    def decision_records(
+        self,
+        *,
+        task_id: str | None = None,
+        child: str | None = None,
+        limit: int = 64,
+        with_features: bool = True,
+    ) -> dict[str, Any]:
+        """Recorded scoring decisions + the drift/serving context `dfml
+        explain` replays them against (served over the `decision_records`
+        RPC and GET /debug/decisions)."""
+        return {
+            "recorder": self.decisions.stats(),
+            "records": self.decisions.snapshot(
+                task_id=task_id, child=child, limit=limit,
+                with_features=with_features,
+            ),
+            "serving_version": getattr(self.evaluator, "serving_version", ""),
+            "drift": self.drift.snapshot(),
         }

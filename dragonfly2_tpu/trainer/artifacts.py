@@ -1,10 +1,14 @@
 """Model artifact (de)serialization for the registry.
 
 One artifact = one directory: params.msgpack (flax serialized pytree) +
-config.json (model hyperparameters + type + version). The manager's model
-registry rows point at these via artifact_path (manager/models/model.go:28-45
-kept evaluation metrics in the DB and the artifact elsewhere; same split).
-The scheduler's ml evaluator loads an artifact straight into a scorer.
+config.json (model hyperparameters + type + version) + sketch.json (the
+training-reference feature sketch drift detection compares live scoring
+features against, ISSUE 15) + the GNN's graph.npz/hosts.json/scorer.dfsc.
+The manager's model registry rows point at these via artifact_path
+(manager/models/model.go:28-45 kept evaluation metrics in the DB and the
+artifact elsewhere; same split). The scheduler's ml evaluator loads an
+artifact straight into a scorer; `artifact_digest` covers EVERY file, so
+any of them tampering fails verify_artifact before attach.
 """
 
 from __future__ import annotations
@@ -179,6 +183,28 @@ def load_native(directory: str | Path):
     if not path.exists():
         return None
     return NativeScorer(path)
+
+
+def save_sketch(directory: str | Path, sketch: Any) -> Path:
+    """Write the training-reference feature sketch beside the params
+    (ISSUE 15). Called BEFORE artifact_digest, so the digest covers it like
+    every other file — a tampered/truncated sketch fails verify_artifact the
+    same way tampered weights do."""
+    p = Path(directory) / "sketch.json"
+    p.write_text(json.dumps(sketch.to_dict()))
+    return p
+
+
+def load_sketch(directory: str | Path):
+    """The artifact's training-reference FeatureSketch, or None for
+    pre-sketch artifacts (every pre-ISSUE-15 artifact; drift detection just
+    stays dormant for them)."""
+    from dragonfly2_tpu.observability.sketches import FeatureSketch
+
+    p = Path(directory) / "sketch.json"
+    if not p.exists():
+        return None
+    return FeatureSketch.from_dict(json.loads(p.read_text()))
 
 
 def load_mlp(directory: str | Path) -> tuple[BandwidthMLP, Any]:

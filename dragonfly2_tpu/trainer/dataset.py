@@ -51,6 +51,13 @@ class Dataset:
     graph: TopoGraph
     pairs: PairBatch
     host_index: dict[bytes, int]  # host_id -> node row
+    # Training-reference feature sketch (ISSUE 15): the per-feature
+    # histogram of the pair rows this dataset trains on, frozen HERE at
+    # finalize so it describes exactly the distribution the model saw.
+    # Ships digest-covered inside the artifact (trainer/artifacts.py) and
+    # becomes the serving scheduler's drift baseline. None on the rowloop
+    # reference path (kept byte-for-byte r05-shaped for equivalence tests).
+    feature_sketch: object | None = None
 
     @property
     def num_nodes(self) -> int:
@@ -293,7 +300,17 @@ class FrozenIngest:
                 np.asarray([0.0], np.float32),
             )
         graph = TopoGraph(node_feats, neighbors, mask, edge_feats)
-        return Dataset(graph=graph, pairs=pairs, host_index=dict(self.host_index))
+        # freeze the training-reference sketch from the pair rows the model
+        # will actually fit (ISSUE 15); one vectorized pass, O(pairs x F)
+        from dragonfly2_tpu.models.features import FEATURE_NAMES
+        from dragonfly2_tpu.observability.sketches import FeatureSketch
+
+        sketch = FeatureSketch(FEATURE_DIM, names=FEATURE_NAMES)
+        sketch.update(pairs.feats)
+        return Dataset(
+            graph=graph, pairs=pairs, host_index=dict(self.host_index),
+            feature_sketch=sketch,
+        )
 
 
 class DatasetAccumulator:

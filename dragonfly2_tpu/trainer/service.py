@@ -47,9 +47,18 @@ from typing import Any
 
 import numpy as np
 
-from dragonfly2_tpu.trainer import artifacts, dataset as datasetlib, train_gnn, train_mlp
+from dragonfly2_tpu.trainer import (
+    artifacts,
+    dataset as datasetlib,
+    metrics as train_metrics,
+    train_gnn,
+    train_mlp,
+)
 
 logger = logging.getLogger(__name__)
+
+# run manifests kept for `train_history` (one per training run, bounded)
+RUN_HISTORY_CAP = 64
 
 
 def pack_records(arr: np.ndarray) -> bytes:
@@ -131,6 +140,14 @@ class TrainerService:
         self.sessions_evicted = 0
         self.pool_rotations = 0
         self.trains_coalesced = 0
+        # per-run manifests, newest last (ISSUE 15): run id, dataset size,
+        # per-model step count / final loss / bounded loss curve, wall,
+        # artifact paths — the `train_history` RPC's backing store and what
+        # `dfml train` prints. Deliberately NOT persisted: like the manager's
+        # stats-frame rings, a restarted trainer rebuilds history by training.
+        self.run_history: collections.deque[dict] = collections.deque(
+            maxlen=RUN_HISTORY_CAP
+        )
 
     # ---- RPC surface (adapter passes payload dicts straight through) ----
 
@@ -206,6 +223,27 @@ class TrainerService:
             "last_result": self.last_result,
         }
 
+    async def train_history(self, p: dict | None = None) -> dict:
+        """Per-run manifests, newest first (bounded at RUN_HISTORY_CAP).
+        `limit` trims; `with_curves=False` drops the loss curves for a
+        compact listing."""
+        p = p or {}
+        limit = int(p.get("limit", RUN_HISTORY_CAP))
+        with_curves = bool(p.get("with_curves", True))
+        runs = list(self.run_history)[-limit:][::-1]
+        if not with_curves:
+            runs = [
+                {
+                    **r,
+                    "models": {
+                        m: {k: v for k, v in info.items() if k != "curve"}
+                        for m, info in (r.get("models") or {}).items()
+                    },
+                }
+                for r in runs
+            ]
+        return {"runs": runs, "total": len(self.run_history)}
+
     async def wait_idle(self) -> None:
         while self._drainer is not None and not self._drainer.done():
             await self._drainer
@@ -272,6 +310,8 @@ class TrainerService:
         # parent = the trace of the train_close that queued this run: the
         # announcer's upload root continues through ingest into the train
         # and model publish, even though the RPC returned long ago
+        t_run = time.perf_counter()
+        started_at = time.time()
         try:
             with default_tracer().span(
                 "trainer.train_run", parent=sess.trace_ctx,
@@ -286,9 +326,66 @@ class TrainerService:
                 if self.manager is not None:
                     with default_tracer().span("trainer.publish"):
                         await self._register_models(sess, result)
+            self._note_run(sess, result, started_at, time.perf_counter() - t_run)
         except Exception:
             logger.exception("training run failed")
             self.last_result = {"error": "training failed"}
+            # same manifest shape as success/skip — ONE append path, so the
+            # schema can never drift between outcomes
+            self._note_run(
+                sess, {"version": f"run-{self.trains_started}"},
+                started_at, time.perf_counter() - t_run, status="error",
+            )
+
+    def _note_run(
+        self,
+        sess: TrainSession,
+        result: dict,
+        started_at: float,
+        wall: float,
+        *,
+        status: str | None = None,
+    ) -> None:
+        """Append the run manifest + move the run-level families. A run that
+        built a dataset but trained nothing (below min_pairs) is 'skipped' —
+        visible in history, never conflated with a trained run; a failed run
+        passes status='error' through the SAME shape."""
+        models = {
+            m: {
+                "artifact": info.get("artifact"),
+                "digest": (info.get("digest") or "")[:16],
+                "evaluation": {
+                    k: v for k, v in (info.get("evaluation") or {}).items()
+                    if k != "contributors"
+                },
+                **(info.get("telemetry") or {}),
+            }
+            for m in ("mlp", "gnn")
+            if (info := result.get(m))
+        }
+        if status is None:
+            status = "ok" if models else "skipped"
+        train_metrics.TRAIN_RUNS_TOTAL.inc(result=status)
+        final = None
+        if "gnn" in models:
+            final = models["gnn"].get("final_loss")
+        elif "mlp" in models:
+            final = models["mlp"].get("final_loss")
+        if final is not None and np.isfinite(final):
+            train_metrics.TRAIN_LAST_RUN_LOSS.set(float(final))
+        self.run_history.append({
+            "run_id": result.get("version", f"run-{self.trains_started}"),
+            "started_at": round(started_at, 3),
+            "wall_s": round(wall, 3),
+            "status": status,
+            "scheduler": sess.scheduler_hostname,
+            "dataset": {
+                "pairs": result.get("num_pairs", 0),
+                "nodes": result.get("num_nodes", 0),
+                "build_seconds": result.get("build_seconds", 0.0),
+            },
+            "models": models,
+        })
 
     async def _run_training(self, sess: TrainSession) -> dict:
         from dragonfly2_tpu.observability.tracing import default_tracer
@@ -314,10 +411,14 @@ class TrainerService:
 
         if ds.num_pairs >= self.cfg.min_pairs:
             tr, ev = datasetlib.split_pairs(ds.pairs)
+            mlp_tel = train_metrics.TrainRunTelemetry(
+                "mlp", batch_size=min(self.cfg.mlp.batch_size, len(tr.child))
+            )
             t0 = time.perf_counter()
             with default_tracer().span("trainer.train_mlp", pairs=ds.num_pairs):
                 params, evaluation = await asyncio.to_thread(
-                    train_mlp.train, self.cfg.mlp, tr, eval_pairs=ev, log=logger.info
+                    train_mlp.train, self.cfg.mlp, tr, eval_pairs=ev,
+                    log=logger.info, telemetry=mlp_tel,
                 )
             evaluation["train_seconds"] = round(time.perf_counter() - t0, 2)
             def _save_mlp() -> tuple[Path, str]:
@@ -326,13 +427,24 @@ class TrainerService:
                     model_type="mlp", version=version, params=params,
                     config={"hidden": list(self.cfg.mlp.hidden)},
                 )
+                if ds.feature_sketch is not None:
+                    # the training-reference feature sketch rides the
+                    # artifact — written BEFORE the digest, so it is
+                    # integrity-covered like every other file (ISSUE 15)
+                    artifacts.save_sketch(path, ds.feature_sketch)
                 return path, artifacts.artifact_digest(path)
 
             path, digest = await asyncio.to_thread(_save_mlp)
-            out["mlp"] = {"artifact": str(path), "digest": digest, "evaluation": evaluation}
+            out["mlp"] = {
+                "artifact": str(path), "digest": digest,
+                "evaluation": evaluation, "telemetry": mlp_tel.summary(),
+            }
 
         if ds.num_pairs >= self.cfg.min_pairs and acc.probe_rows >= self.cfg.min_probe_rows:
             cfg = self.cfg.gnn
+            gnn_tel = train_metrics.TrainRunTelemetry(
+                "gnn", batch_size=cfg.batch_size
+            )
             t0 = time.perf_counter()
             with default_tracer().span("trainer.train_gnn", nodes=ds.num_nodes):
                 state, losses = await train_gnn.train_async(
@@ -340,6 +452,7 @@ class TrainerService:
                     steps=self.cfg.gnn_steps,
                     steps_per_call=self.cfg.gnn_steps_per_call,
                     log=logger.info,
+                    telemetry=gnn_tel,
                 )
             train_seconds = time.perf_counter() - t0
             evaluation = {
@@ -359,6 +472,11 @@ class TrainerService:
                     },
                 )
                 artifacts.save_graph(path, ds.graph, ds.host_index)
+                if ds.feature_sketch is not None:
+                    # training-reference sketch, digest-covered (ISSUE 15):
+                    # the serving scheduler compares live scoring features
+                    # against THIS distribution (feature drift)
+                    artifacts.save_sketch(path, ds.feature_sketch)
                 try:
                     artifacts.save_native(path, train_gnn.make_model(cfg), state.params, ds.graph)
                 except Exception:
@@ -368,7 +486,10 @@ class TrainerService:
                 return path, artifacts.artifact_digest(path)
 
             path, digest = await asyncio.to_thread(_save_gnn)
-            out["gnn"] = {"artifact": str(path), "digest": digest, "evaluation": evaluation}
+            out["gnn"] = {
+                "artifact": str(path), "digest": digest,
+                "evaluation": evaluation, "telemetry": gnn_tel.summary(),
+            }
         return out
 
     async def _register_models(self, sess: TrainSession, result: dict) -> None:

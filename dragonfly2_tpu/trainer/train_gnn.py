@@ -79,20 +79,29 @@ def loss_fn(apply_fn: Callable, params: Any, g: TopoGraph, batch: PairBatch) -> 
     return jnp.mean((pred - batch.label) ** 2)
 
 
-def make_train_step(remat: bool = False) -> Callable:
+def make_train_step(remat: bool = False, *, with_metrics: bool = False) -> Callable:
     """One optimizer step; with `remat` the model apply is wrapped in
     jax.checkpoint, so the backward pass RECOMPUTES the GNN forward instead
     of holding its activations — the [N, K, H] message tensors dominate live
     memory at scaled node counts (16k nodes × 16 neighbors × hidden), and
     trading them for FLOPs is what lets the scaled shape fit a single chip's
     HBM. Verified structurally: the lowered HLO at the 16k-node shape gains
-    recomputation dot_generals (tests/test_trainer.py pins this)."""
+    recomputation dot_generals (tests/test_trainer.py pins this).
+
+    with_metrics=False (default) keeps the historic (state, loss) return;
+    True widens it to (state, (loss, grad_norm)) — the global pre-update
+    gradient norm the training-run telemetry exports per step (ISSUE 15).
+    Opt-in so existing jitted callers (bench, profile tools, the sharded
+    equivalence tests) keep their compiled shapes."""
 
     def step(
         state: train_state.TrainState, g: TopoGraph, batch: PairBatch
-    ) -> tuple[train_state.TrainState, jnp.ndarray]:
+    ):
         apply_fn = jax.checkpoint(state.apply_fn) if remat else state.apply_fn
         loss, grads = jax.value_and_grad(partial(loss_fn, apply_fn))(state.params, g, batch)
+        if with_metrics:
+            gnorm = optax.global_norm(grads)
+            return state.apply_gradients(grads=grads), (loss, gnorm)
         return state.apply_gradients(grads=grads), loss
 
     return step
@@ -170,6 +179,7 @@ def shard_for_training_scan(
     batch_size: int = 4096,
     steps_per_call: int = 10,
     remat: bool = False,
+    with_metrics: bool = False,
 ) -> tuple[train_state.TrainState, TopoGraph, PairBatch, Callable]:
     """Device-resident training: the pair POOL lives on device and each
     jitted call runs `steps_per_call` optimizer steps via lax.scan, sampling
@@ -190,6 +200,7 @@ def shard_for_training_scan(
     jitted = make_scan_step(
         mesh, state_sh, g_sh, pool_sh,
         batch_size=batch_size, steps_per_call=steps_per_call, remat=remat,
+        with_metrics=with_metrics,
     )
     return state, g, pairs, jitted
 
@@ -203,14 +214,17 @@ def make_scan_step(
     batch_size: int,
     steps_per_call: int,
     remat: bool = False,
+    with_metrics: bool = False,
 ) -> Callable:
     """The jitted K-step scan alone, given already-known shardings — lets a
     caller with placed arrays build variants (e.g. a 1-step lowering for
     FLOPs accounting) without re-placing state on the device. Shardings can
     be recovered from placed arrays via ``jax.tree.map(lambda x: x.sharding,
-    tree)``."""
+    tree)``. with_metrics widens the scan's ys from losses[K] to
+    (losses[K], grad_norms[K]) — the replicated out-sharding below is a
+    pytree PREFIX, so it covers either shape."""
     batch_sh = NamedSharding(mesh, P(meshlib.DATA_AXIS))
-    step = make_train_step(remat)
+    step = make_train_step(remat, with_metrics=with_metrics)
 
     def multi_step(st, gg, pool, key):
         n_pool = pool.child.shape[0]
@@ -244,6 +258,7 @@ async def train_async(
     steps_per_call: int = 10,
     log_every: int = 100,
     log: Callable[[str], None] = lambda s: None,
+    telemetry=None,
 ) -> tuple[train_state.TrainState, list[float]]:
     """Cooperative training driver for asyncio hosts (the trainer service).
 
@@ -254,17 +269,23 @@ async def train_async(
     + the compile triggered by the first call) runs in the worker too — the
     loop never blocks on XLA. Returns (state, per-step losses); loss length
     is steps rounded up to a whole number of calls.
+
+    telemetry: optional trainer.metrics.TrainRunTelemetry — per-step loss +
+    grad-norm land in the dragonfly_train_* families after every call. The
+    grad norms ride the scan's ys (with_metrics), so the telemetry costs no
+    extra D2H sync: the per-call np.asarray pull already materializes them.
     """
     mesh = mesh or meshlib.make_mesh()
     steps_per_call = max(1, min(steps_per_call, steps))
     calls = -(-steps // steps_per_call)
+    with_metrics = telemetry is not None
 
     def _setup():
         state = init_state(cfg, graph, seed)
         return shard_for_training_scan(
             state, graph, pairs, mesh,
             batch_size=cfg.batch_size, steps_per_call=steps_per_call,
-            remat=cfg.remat,
+            remat=cfg.remat, with_metrics=with_metrics,
         )
 
     state, g, pool, multi_step = await asyncio.to_thread(_setup)
@@ -272,15 +293,23 @@ async def train_async(
 
     def _one_call(st, k):
         k, sub = jax.random.split(k)
-        st, ls = multi_step(st, g, pool, sub)
+        st, ys = multi_step(st, g, pool, sub)
         # D2H pull materializes the whole call's chain before returning to
         # the loop — the same sync discipline the bench windows use
-        return st, k, np.asarray(ls)
+        if with_metrics:
+            ls, gn = ys
+            return st, k, np.asarray(ls), np.asarray(gn)
+        return st, k, np.asarray(ys), None
 
     losses: list[float] = []
     t0 = time.perf_counter()
     for i in range(calls):
-        state, key, ls = await asyncio.to_thread(_one_call, state, key)
+        state, key, ls, gn = await asyncio.to_thread(_one_call, state, key)
+        if telemetry is not None and gn is not None:
+            for lv, gv in zip(ls, gn):
+                telemetry.on_step(
+                    float(lv), float(gv), examples=cfg.batch_size
+                )
         losses.extend(float(x) for x in ls)
         done = len(losses)
         if done % log_every < steps_per_call or i == calls - 1:

@@ -41,8 +41,18 @@ def _train_step(model: BandwidthMLP, tx: Any, params: Any, opt_state: Any, x: jn
         return jnp.mean((pred - y) ** 2)
 
     loss, grads = jax.value_and_grad(loss_fn)(params)
+    # global grad norm rides every step's outputs: a diverging run shows in
+    # dragonfly_train_grad_norm steps before the loss moves (ISSUE 15); the
+    # reduction is a handful of FLOPs next to the matmuls
+    gnorm = optax.global_norm(grads)
     updates, opt_state = tx.update(grads, opt_state, params)
-    return optax.apply_updates(params, updates), opt_state, loss
+    return optax.apply_updates(params, updates), opt_state, loss, gnorm
+
+
+# host-side loss/grad-norm pull cadence for the telemetry hook: every step
+# would force a device sync per step; every Nth keeps the curve dense while
+# costing one D2H pull per N steps
+_TELEMETRY_EVERY = 10
 
 
 def train(
@@ -52,8 +62,12 @@ def train(
     eval_pairs: PairBatch | None = None,
     seed: int = 0,
     log: Callable[[str], None] = lambda s: None,
+    telemetry=None,
 ) -> tuple[Any, dict[str, float]]:
-    """Returns (params, evaluation dict with train/eval mse)."""
+    """Returns (params, evaluation dict with train/eval mse).
+
+    telemetry: optional trainer.metrics.TrainRunTelemetry — receives sampled
+    per-step loss/grad-norm/examples (the dragonfly_train_* families)."""
     model = make_model(cfg)
     rng = np.random.default_rng(seed)
     params = model.init(jax.random.PRNGKey(seed), jnp.zeros((8, pairs.feats.shape[1])))
@@ -61,11 +75,21 @@ def train(
     opt_state = tx.init(params)
     n = len(pairs.child)
     loss = jnp.zeros(())
+    pending = 0
     for i in range(cfg.steps):
         idx = rng.integers(0, n, size=min(cfg.batch_size, n))
         x = jnp.asarray(pairs.feats[idx])
         y = jnp.asarray(pairs.label[idx])
-        params, opt_state, loss = _train_step(model, tx, params, opt_state, x, y)
+        params, opt_state, loss, gnorm = _train_step(model, tx, params, opt_state, x, y)
+        pending += 1
+        if telemetry is not None and (
+            pending >= _TELEMETRY_EVERY or i == cfg.steps - 1
+        ):
+            telemetry.on_step(
+                float(loss), float(gnorm),
+                steps=pending, examples=pending * len(idx),
+            )
+            pending = 0
         if (i + 1) % 100 == 0:
             log(f"mlp step {i + 1}/{cfg.steps} loss={float(loss):.5f}")
     evaluation = {"train_mse": float(loss)}

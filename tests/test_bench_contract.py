@@ -126,6 +126,38 @@ def test_metrics_plane_contract():
     assert out["alert_eval_cost_us"] > 0
 
 
+def test_ml_observability_contract():
+    # tiny shapes: pins the key set and the ISSUE 15 acceptance — decision
+    # recorder + live drift sketch imply ≤1% of the real serial round at
+    # the shipped default strides (the deterministic figure; the A/B pct
+    # carries 2-core scheduler noise of the same magnitude as the effect,
+    # exactly like the metrics_plane section, and is pinned loosely as a
+    # gross-regression canary only)
+    out = bench.bench_ml_observability(rounds=150, probes=40)
+    for key in (
+        "ml_obs_round_rps_off", "ml_obs_round_rps_on", "ml_obs_overhead_pct",
+        "ml_obs_implied_overhead_pct", "ml_obs_decision_sample_rate",
+        "decision_record_us", "sketch_update_ns_per_row", "drift_score_us",
+        "decision_ring_records",
+    ):
+        assert key in out, key
+    assert out["ml_obs_round_rps_off"] > 0
+    assert out["ml_obs_round_rps_on"] > 0
+    assert out["decision_record_us"] > 0
+    assert out["sketch_update_ns_per_row"] > 0
+    assert out["drift_score_us"] > 0
+    # rounds actually recorded at the default stride during the on legs
+    assert out["decision_ring_records"] >= 1
+    # the acceptance bound (deterministic, noise-free by construction)
+    assert out["ml_obs_implied_overhead_pct"] <= 1.0
+    # gross-regression canary: "recording moved onto every round" reads
+    # far above this; honest overhead reads inside the noise floor
+    assert abs(out["ml_obs_overhead_pct"]) < 75.0
+    # the shipped default must stay sampled (a 1.0 default would make the
+    # implied figure meaningless and the ring a per-round tax)
+    assert 0 < out["ml_obs_decision_sample_rate"] <= 0.1
+
+
 def test_federation_contract():
     # tiny shapes: pins the key set, the interleaved 1-vs-2 swarm wiring,
     # and the WATERMARK property (steady-state sync payload is O(changed

@@ -980,6 +980,121 @@ def test_df028_not_run_per_file():
 
 
 # ---------------------------------------------------------------------------
+# DF030 dead alert rules (cross-file, DF028's inverse)
+
+
+_RULE_DECL = """
+from dragonfly2_tpu.observability.metrics import default_registry
+
+_r = default_registry()
+SYNCS_TOTAL = _r.counter("syncs_total", "moved", subsystem="scheduler")
+SYNCS_TOTAL.inc()
+"""
+
+
+def test_df030_fires_on_rule_naming_undeclared_family():
+    rule = """
+    from dragonfly2_tpu.observability.alerts import AlertRule
+
+    RULES = [AlertRule(name="a", metric="dragonfly_scheduler_sync_total", bound=1.0)]
+    """
+    vs = dflint.run_sources({
+        "m.py": textwrap.dedent(_RULE_DECL), "r.py": textwrap.dedent(rule),
+    })
+    assert [v.check for v in vs] == ["DF030"]
+    assert "dragonfly_scheduler_sync_total" in vs[0].message
+
+
+def test_df030_cleared_by_declaration_in_another_file():
+    rule = """
+    from dragonfly2_tpu.observability.alerts import AlertRule
+
+    RULES = [AlertRule(name="a", metric="dragonfly_scheduler_syncs_total", bound=1.0)]
+    """
+    assert xids({"m.py": _RULE_DECL, "r.py": rule}) == []
+
+
+def test_df030_checks_denom_too():
+    rule = """
+    from dragonfly2_tpu.observability.alerts import AlertRule
+
+    R = AlertRule(name="a", kind="ratio",
+                  metric="dragonfly_scheduler_syncs_total",
+                  denom="dragonfly_scheduler_gone_total", bound=0.1)
+    """
+    vs = dflint.run_sources({
+        "m.py": textwrap.dedent(_RULE_DECL), "r.py": textwrap.dedent(rule),
+    })
+    assert [v.check for v in vs] == ["DF030"]
+    assert "denom" in vs[0].message
+
+
+def test_df030_private_namespace_matches_on_suffix():
+    # a private-namespace registry (bench probes, test fixtures) composes a
+    # different prefix; the rule matches on the subsystem_name suffix
+    decl = """
+    from dragonfly2_tpu.observability.metrics import MetricsRegistry
+
+    sreg = MetricsRegistry(namespace="bench")
+    c = sreg.counter("c0_total")
+    c.inc()
+    """
+    rule = """
+    from dragonfly2_tpu.observability.alerts import AlertRule
+
+    R = AlertRule(name="a", metric="bench_c0_total", bound=1.0)
+    """
+    assert xids({"m.py": decl, "r.py": rule}) == []
+
+
+def test_df030_instance_scope_declaration_counts():
+    # ServiceMetrics declares inside __init__ — DF030 collects declarations
+    # at ANY scope (unlike DF028's module-scope flag targets)
+    decl = """
+    from dragonfly2_tpu.observability.metrics import MetricsRegistry
+
+    class M:
+        def __init__(self):
+            self.registry = MetricsRegistry()
+            self.h = self.registry.histogram(
+                "lag_seconds", subsystem="loop")
+            self.h.observe(0.1)
+    """
+    rule = """
+    from dragonfly2_tpu.observability.alerts import AlertRule
+
+    R = AlertRule(name="a", kind="quantile",
+                  metric="dragonfly_loop_lag_seconds", bound=0.25)
+    """
+    assert xids({"m.py": decl, "r.py": rule}) == []
+
+
+def test_df030_nonconstant_metric_skipped_and_suppression_honored():
+    dynamic = """
+    from dragonfly2_tpu.observability.alerts import AlertRule
+
+    def make(name):
+        return AlertRule(name="a", metric=name, bound=1.0)
+    """
+    assert xids({"r.py": dynamic}) == []
+    sup = """
+    from dragonfly2_tpu.observability.alerts import AlertRule
+
+    R = AlertRule(name="a", metric="dragonfly_not_declared_total", bound=1.0)  # dflint: disable=DF030 family registered by an out-of-tree plugin
+    """
+    assert xids({"r.py": sup}) == []
+
+
+def test_df030_not_run_per_file():
+    rule = """
+    from dragonfly2_tpu.observability.alerts import AlertRule
+
+    R = AlertRule(name="a", metric="dragonfly_never_declared_total", bound=1.0)
+    """
+    assert "DF030" not in ids(rule)
+
+
+# ---------------------------------------------------------------------------
 # DF029 wall-clock reads inside sim/ (virtual-clock discipline)
 
 _SIM_PATH = "dragonfly2_tpu/sim/engine.py"

@@ -159,6 +159,15 @@ run_stage "metrics-smoke" env JAX_PLATFORMS=cpu python tools/metrics_smoke.py
 # metric flips with ZERO base-fallback growth after the zero-drop swap.
 run_stage "rollout-smoke" env JAX_PLATFORMS=cpu python tools/rollout_smoke.py
 
+# mlobs-smoke: the ML-plane observability loop (ISSUE 15) — in-process
+# cluster runs a real train → publish → attach cycle (artifact ships the
+# digest-covered training-reference sketch), serves live rounds through the
+# model, injects a shifted feature distribution, and asserts the
+# feature_drift alert propagates recorder → rules → stats frame → manager →
+# `dftop --once --json`, while `dfml explain` replays a real round's chosen
+# parents bit-exact from the decision record.
+run_stage "mlobs-smoke" env JAX_PLATFORMS=cpu python tools/mlobs_smoke.py
+
 # observability-smoke: one trace over the REAL rpc wire into two per-process
 # span files, reassembled by dftrace — propagation, all-or-nothing sampling,
 # and the critical-path identity (exclusive times sum to the root's wall)

@@ -58,6 +58,17 @@ Checks (see README.md "Static analysis" for the catalog):
          time into event ordering and corrupts the simulation without
          crashing it. Read time through the engine's clock (utils/clock.py);
          the engine's own events/s wall meter is the one suppressed site.
+  DF030  an AlertRule whose `metric` (or `denom`) names a family no registry
+         constructor in the linted tree declares — DF028's inverse, and the
+         second cross-file check: DF028 catches a family nobody moves, DF030
+         catches a RULE left pointing at nothing (the silent failure mode of
+         renaming a metric family: the rule never errors, it just never
+         fires again). Family names are matched against every
+         .counter/.gauge/.histogram factory call's composed name
+         (namespace_subsystem_name; private-namespace registries match on
+         the subsystem_name suffix) and direct metrics.Counter/Gauge/
+         Histogram constructions; non-constant metric expressions are
+         skipped (unresolvable statically).
   DF031  silent exception swallow: bare/overbroad except whose body is only
          pass/continue/... (no log, no narrowing)
   DF032  mutable default argument (list/dict/set literal or constructor)
@@ -102,6 +113,7 @@ CHECKS: dict[str, str] = {
     "DF027": "Tracer.span(...) not used as a `with` context manager (leaked span)",
     "DF028": "module-scope metric family never incremented/observed anywhere (dead metric)",
     "DF029": "wall-clock read or real sleep inside sim/ (virtual-clock discipline)",
+    "DF030": "AlertRule names a metric family no registry constructor declares (dead rule)",
     "DF031": "bare/overbroad except silently swallowing the error",
     "DF032": "mutable default argument",
     "DF033": "per-row numpy array construction inside a for loop (vectorize)",
@@ -1186,6 +1198,124 @@ def check_unused_metric_families(
             )
 
 
+# ---------------------------------------------------------------------------
+# DF030: dead alert rules (cross-file, DF028's inverse)
+
+# The default namespace MetricsRegistry() composes into every family name;
+# private registries (bench probes, ServiceMetrics) use their own, so rule
+# metrics are ALSO matched on the namespace-less subsystem_name suffix.
+_METRIC_DEFAULT_NAMESPACE = "dragonfly"
+
+
+def _registryish_loose(recv: ast.AST, aliases: dict[str, str]) -> bool:
+    """DF030's wider receiver heuristic: everything _registryish accepts,
+    plus any name mentioning 'reg' (sreg, test_reg, self.registry) — for
+    DECLARATION collection a looser net only ever clears more rules, the
+    safe direction for a linter."""
+    if _registryish(recv, aliases):
+        return True
+    name = dotted(recv).rsplit(".", 1)[-1].lower()
+    return "reg" in name
+
+
+def metric_declared_keys(
+    tree: ast.Module, aliases: dict[str, str]
+) -> tuple[set[str], set[str]]:
+    """(full_names, suffix_keys) every metric factory call in this file can
+    declare — ANY scope, not just module level (ServiceMetrics declares in
+    __init__): `reg.counter("name", subsystem="s")` yields full name
+    "dragonfly_s_name" and suffix key "s_name"; a direct
+    observability.metrics constructor's first arg IS the full name.
+    Non-constant names/subsystems are skipped (they cannot clear a rule)."""
+    full: set[str] = set()
+    suffix: set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr in _METRIC_FACTORY_METHODS
+            and _registryish_loose(func.value, aliases)
+        ):
+            name = None
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                name = node.args[0].value
+            subsystem = ""
+            skip = False
+            for kw in node.keywords:
+                if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                    name = kw.value.value
+                if kw.arg == "subsystem":
+                    if isinstance(kw.value, ast.Constant) and isinstance(kw.value.value, str):
+                        subsystem = kw.value.value
+                    else:
+                        skip = True  # dynamic subsystem: unresolvable
+            if name is None or skip:
+                continue
+            key = f"{subsystem}_{name}" if subsystem else name
+            suffix.add(key)
+            full.add(f"{_METRIC_DEFAULT_NAMESPACE}_{key}")
+        elif _resolved_call_name(node, aliases) in _METRIC_CTORS:
+            if node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                full.add(node.args[0].value)
+    return full, suffix
+
+
+def alert_rule_metric_refs(
+    tree: ast.Module, aliases: dict[str, str]
+) -> list[tuple[str, str, int, int]]:
+    """(kwarg, metric_name, line, col) for every AlertRule(metric=..., /
+    denom=...) call with a constant string value."""
+    out: list[tuple[str, str, int, int]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        resolved = _resolved_call_name(node, aliases)
+        if resolved.rsplit(".", 1)[-1] != "AlertRule":
+            continue
+        for kw in node.keywords:
+            if kw.arg in ("metric", "denom") \
+                    and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                out.append((kw.arg, kw.value.value, node.lineno, node.col_offset))
+    return out
+
+
+def check_dead_alert_rules(
+    parsed: list[tuple[str, ast.Module]],
+) -> Iterator[Violation]:
+    """DF030 over the WHOLE run: an AlertRule metric/denom must name a
+    family SOME file's registry constructor declares — exactly (default
+    namespace) or by subsystem_name suffix (private namespaces). Matching
+    by composed name, so renaming a family without updating its rules fails
+    the gate instead of silencing the rule forever."""
+    full: set[str] = set()
+    suffix: set[str] = set()
+    refs: list[tuple[str, str, str, int, int]] = []
+    for path, tree in parsed:
+        aliases = import_aliases(tree)
+        f, s = metric_declared_keys(tree, aliases)
+        full |= f
+        suffix |= s
+        for kwarg, metric, line, col in alert_rule_metric_refs(tree, aliases):
+            refs.append((path, kwarg, metric, line, col))
+    for path, kwarg, metric, line, col in refs:
+        if metric in full:
+            continue
+        if any(metric.endswith("_" + k) or metric == k for k in suffix):
+            continue
+        yield Violation(
+            path, line, col, "DF030",
+            f"AlertRule {kwarg}={metric!r} names a metric family no "
+            "registry constructor in the linted tree declares — the rule "
+            "can never fire (a renamed family leaves its rules silently "
+            "dead); point it at a declared family or delete it",
+        )
+
+
 ALL_CHECKS = (
     check_tracer_coercion,
     check_jnp_in_loop,
@@ -1226,8 +1356,9 @@ def _per_file_violations(
 
 def lint_source(source: str, path: str = "<string>") -> list[Violation]:
     """All PER-FILE violations for one file's source, suppressions applied.
-    DF028 is cross-file (a family declared here may be incremented anywhere)
-    and only runs in run_sources()/the CLI driver."""
+    DF028/DF030 are cross-file (a family declared here may be incremented —
+    or a rule's family declared — anywhere) and only run in run_sources()/
+    the CLI driver."""
     sup = Suppressions(source)
     if sup.skip_file:  # full opt-out, including DF001 (fixture/vendored files)
         return []
@@ -1263,11 +1394,12 @@ def discover(paths: list[str]) -> list[Path]:
 
 
 def run_sources(sources: dict[str, str]) -> list[Violation]:
-    """Per-file checks plus the cross-file passes (DF028) over one run's
-    worth of sources — each file parsed ONCE, the tree shared by both
-    passes. skip-file sources contribute their metric TOUCHES to the
-    cross-file pass (a fixture may legitimately be the only caller) but are
-    never flagged themselves."""
+    """Per-file checks plus the cross-file passes (DF028 dead families,
+    DF030 dead alert rules) over one run's worth of sources — each file
+    parsed ONCE, the tree shared by every pass. skip-file sources contribute
+    their metric TOUCHES/DECLARATIONS to the cross-file passes (a fixture
+    may legitimately be the only caller or declarer) but are never flagged
+    themselves."""
     out: list[Violation] = []
     parsed: list[tuple[str, ast.Module]] = []
     flaggable: dict[str, Suppressions] = {}
@@ -1292,10 +1424,11 @@ def run_sources(sources: dict[str, str]) -> list[Violation]:
             continue
         flaggable[path] = sup
         out.extend(_per_file_violations(tree, sup, path))
-    for v in check_unused_metric_families(parsed):
-        sup = flaggable.get(v.path)
-        if sup is not None and not sup.allows(v):
-            out.append(v)
+    for cross_check in (check_unused_metric_families, check_dead_alert_rules):
+        for v in cross_check(parsed):
+            sup = flaggable.get(v.path)
+            if sup is not None and not sup.allows(v):
+                out.append(v)
     out.sort(key=lambda v: (v.path, v.line, v.col, v.check))
     return out
 
