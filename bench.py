@@ -2626,6 +2626,68 @@ def bench_swarm_sim(
     return out
 
 
+def bench_overload(peers: int = 2_000, overload_factor: float = 4.0) -> dict:
+    """Goodput under overload, shedding ON vs OFF (ISSUE 17 brownout A/B):
+    the same flash crowd at `overload_factor` x the scheduler's modeled
+    register capacity, run twice against the REAL scheduler — once with the
+    brownout ladder attached (typed overloaded answers + retry_after spread
+    the comeback) and once without (modeled client timeouts amplify into a
+    retry storm). The scenario is scale-invariant in time (fixed burst
+    window, per-register cost derived from peers), so this reduced-peers
+    bench arm exercises the same dynamics as the 10^4-peer acceptance run.
+
+      overload_goodput_ratio          ON/OFF completions — the headline;
+                                      >= 2.0 at 4x overload is acceptance
+      overload_goodput_on_frac        completed/peers with the ladder
+      overload_goodput_off_frac       completed/peers without (the storm)
+      overload_admitted_p99_ms_on     admitted-round p99 with shedding —
+                                      bounded comeback, not infinite queueing
+      overload_max_level_on           highest rung reached (4 = admission)
+      overload_refused_on             typed overloaded answers sent
+      overload_retry_storm_off        retries the unshedded arm burned
+
+    Nulls (never 0.0) when an arm fails, per the PR 6 hygiene rule."""
+    out: dict = {
+        "overload_peers": None,
+        "overload_factor": None,
+        "overload_goodput_ratio": None,
+        "overload_goodput_on_frac": None,
+        "overload_goodput_off_frac": None,
+        "overload_admitted_p99_ms_on": None,
+        "overload_max_level_on": None,
+        "overload_refused_on": None,
+        "overload_retry_storm_off": None,
+    }
+    try:
+        from dragonfly2_tpu.sim.scenarios import overload_flash
+
+        reps: dict = {}
+        for arm, shed in (("on", True), ("off", False)):
+            sc = overload_flash(
+                peers=peers, overload_factor=overload_factor,
+                shedding=shed, telemetry_dir=None,
+            )
+            try:
+                rep = sc.sim.run()
+                sc.check(rep)  # the ON arm's scenario invariants must hold
+            finally:
+                sc.sim.close()
+            reps[arm] = rep
+        on, off = reps["on"], reps["off"]
+        out["overload_peers"] = peers
+        out["overload_factor"] = overload_factor
+        out["overload_goodput_ratio"] = round(on.completed / max(off.completed, 1), 2)
+        out["overload_goodput_on_frac"] = round(on.completed / max(peers, 1), 4)
+        out["overload_goodput_off_frac"] = round(off.completed / max(peers, 1), 4)
+        out["overload_admitted_p99_ms_on"] = on.admitted_p99_ms
+        out["overload_max_level_on"] = (on.degradation or {}).get("max_level")
+        out["overload_refused_on"] = on.overload_refused
+        out["overload_retry_storm_off"] = off.overload_retries
+    except Exception as e:  # noqa: BLE001 — section skipped, keys stay null
+        print(f"bench: overload section failed: {e!r}", file=sys.stderr)
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -2676,6 +2738,7 @@ def main() -> None:
     ml_observability = run_section("ml_observability", bench_ml_observability, {})
     federation = run_section("federation", bench_federation, {})
     swarm_sim = run_section("swarm_sim", bench_swarm_sim, {})
+    overload = run_section("overload", bench_overload, {})
     mlp_sps, mlp_mse = run_section("mlp_train", bench_mlp_train, (None, None))
     serving = run_section("evaluator_serving", bench_evaluator_serving, {})
     # headline = the production serving path: native C++ scorer when the
@@ -2784,6 +2847,12 @@ def main() -> None:
         "swarm_sim_events_per_sec": swarm_sim.get("swarm_sim_events_per_sec"),
         "swarm_sim_peers": swarm_sim.get("swarm_sim_peers"),
         "swarm_sim": swarm_sim or "skipped",
+        # graceful degradation under overload (ISSUE 17): brownout-ladder
+        # A/B at 4x register overload — goodput with shedding over goodput
+        # without (the retry storm); >= 2.0 is the acceptance bar
+        "overload_goodput_ratio": overload.get("overload_goodput_ratio"),
+        "overload_admitted_p99_ms_on": overload.get("overload_admitted_p99_ms_on"),
+        "overload": overload or "skipped",
         "backend": backend,
         **serving,
     }

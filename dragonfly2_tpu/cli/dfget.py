@@ -138,7 +138,8 @@ async def recursive_download(client: RpcClient, args: argparse.Namespace) -> int
     from dragonfly2_tpu.daemon.source import SourceRegistry
 
     sources = SourceRegistry()
-    queue: deque[tuple[str, str, int]] = deque()  # (url, output_dir, level)
+    # (url, output_dir, level) entries
+    queue: deque[tuple[str, str, int]] = deque()  # dflint: disable=DF034 BFS frontier of the finite directory tree one CLI invocation crawls, drained in this same loop — not a service-lifetime buffer
     queue.append((args.url, args.output, args.level))
     seen: set[str] = set()
     failures = 0

@@ -18,6 +18,10 @@ bench's swarm_sim section read them):
              departed, crashed}
   violations: {departed_parent_rounds}
   federation: {syncs_ok, syncs_failed, first_remote_edge_s} | null
+  overload: {refused, retries, timeouts, admitted_p50_ms, admitted_p99_ms,
+             shed_by_class} | null      (ISSUE 17 chaos packs)
+  degradation: {max_level, final_level} | null
+  manager: {agents, unreachable_declared, recovered, rejoined} | null
   telemetry: {nodes, edges, pairs, download_rows, probe_rows} | null
   assertions: {passed: bool, error: str | null}
 """
@@ -103,6 +107,25 @@ def run_scenario(
                 "crashed": rep.crashed,
             },
             "violations": {"departed_parent_rounds": rep.departed_parent_rounds},
+            "overload": (
+                {
+                    "refused": rep.overload_refused,
+                    "retries": rep.overload_retries,
+                    "timeouts": rep.register_timeouts,
+                    "admitted_p50_ms": rep.admitted_p50_ms,
+                    "admitted_p99_ms": rep.admitted_p99_ms,
+                    "shed_by_class": dict(rep.shed_by_class),
+                }
+                if (rep.overload_refused or rep.register_timeouts
+                    or rep.admitted_p99_ms)
+                else None
+            ),
+            "degradation": (
+                {"max_level": rep.degradation["max_level"],
+                 "final_level": rep.degradation["final_level"]}
+                if rep.degradation else None
+            ),
+            "manager": dict(rep.manager) if rep.manager else None,
             "federation": (
                 {k: rep.federation[k] for k in
                  ("syncs_ok", "syncs_failed", "first_remote_edge_s")}

@@ -59,7 +59,7 @@ def render(stats: dict, *, clear: bool = False) -> str:
     header = (
         f"{'member':<18} {'type':<9} {'age':>5} "
         f"{'work/s':>9} {'p95ms':>7} {'down MB/s':>10} {'up MB/s':>9} "
-        f"{'cipher':>8} {'lag p95':>8} {'util':>5} {'serving':>8} "
+        f"{'cipher':>8} {'lag p95':>8} {'util':>5} {'deg':>4} {'serving':>8} "
         f"{'drift':>6} {'rollout':>12} alerts"
     )
     lines.append(header)
@@ -71,6 +71,13 @@ def render(stats: dict, *, clear: bool = False) -> str:
         if m.get("stale"):
             name += " (stale)"
         member_alerts = ",".join(frame.get("alerts") or ()) or "-"
+        # brownout rung (ISSUE 17): 0..4 while the member's degradation
+        # ladder is engaged; a member in manager-blackout autonomy flags it
+        # next to its alerts so the operator sees BOTH failure planes here
+        if r.get("manager_unreachable"):
+            member_alerts = (
+                "mgr_down" if member_alerts == "-" else member_alerts + ",mgr_down"
+            )
         # "work/s" is each member's native unit of work: scheduling rounds
         # for a scheduler, training steps for a trainer (ISSUE 15 — a
         # trainer member finally shows live learner work, not a blank)
@@ -87,6 +94,7 @@ def render(stats: dict, *, clear: bool = False) -> str:
             f"{str(frame.get('piece_cipher', '-')):>8} "
             f"{_fmt(r.get('loop_lag_p95_ms'), 1, 8)} "
             f"{_fmt(r.get('dispatcher_utilization'), 2, 5)} "
+            f"{_fmt(r.get('degradation_level'), 0, 4)} "
             f"{str(frame.get('serving_mode', '-')):>8} "
             f"{_fmt(r.get('feature_drift_max'), 2, 6)} "
             f"{str(frame.get('rollout_state', '-')):>12} "

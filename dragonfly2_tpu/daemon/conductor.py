@@ -613,6 +613,14 @@ class PeerTaskConductor:
             jitter=0.5,
         )
         self._piece_errors: dict[int, int] = {}  # index -> worker-level failures
+        # cluster retry budgets (ISSUE 17): process-wide token buckets, one
+        # per target class. First attempts are free; RETRIES spend — beyond
+        # the budget the conductor fails fast to its next fallback (another
+        # parent, back-to-source) instead of amplifying a cluster-wide storm.
+        from dragonfly2_tpu.resilience.budget import budget_for
+
+        self._sched_budget = budget_for("scheduler")
+        self._parent_budget = budget_for("parent")
         # Successful piece reports ride a per-conductor batch buffer when the
         # client speaks report_pieces (all shipped clients do; test fakes may
         # not — they get the unary path).
@@ -656,7 +664,7 @@ class PeerTaskConductor:
                 self._pipeline_obj.close()
 
     async def _run_inner(self) -> TaskStorage:
-        reg = await self.scheduler.register_peer(self.peer_id, self.meta, self.host)
+        reg = await self._register_admitted()
         if getattr(reg, "error", ""):
             raise IOError(f"task {self.meta.task_id}: registration refused: {reg.error}")
         self.ts = self.storage.register_task(
@@ -709,6 +717,32 @@ class PeerTaskConductor:
         self.ts.mark_done()
         await self._safe_report_peer(success=True)
         return self.ts
+
+    async def _register_admitted(self) -> RegisterResult:
+        """register_peer honoring the scheduler's typed `overloaded` answer
+        (ISSUE 17 admission-control rung): the refusal carries a
+        retry_after_s hint — pre-charge the scheduler retry budget, wait it
+        out (jittered, bounded by the task budget), and re-register instead
+        of failing the task. Any other refusal surfaces unchanged."""
+        reg = await self.scheduler.register_peer(self.peer_id, self.meta, self.host)
+        for attempt in range(1, 4):
+            if getattr(reg, "error", "") != "overloaded":
+                return reg
+            retry_after = float(getattr(reg, "retry_after_s", 0.0)) or 1.0
+            self._sched_budget.charge(retry_after)
+            remaining = dl.remaining()
+            if remaining is not None and remaining <= retry_after:
+                return reg  # the wait would outlive the task budget
+            # jitter UP only: arriving before retry_after would re-hit the
+            # admission gate; spreading later de-synchronizes the shed crowd
+            delay = retry_after * (1.0 + 0.5 * random.random())
+            self.log.info(
+                "scheduler overloaded; re-registering in %.1fs (attempt %d)",
+                delay, attempt,
+            )
+            await asyncio.sleep(delay)
+            reg = await self.scheduler.register_peer(self.peer_id, self.meta, self.host)  # dflint: disable=DF025 bounded 3-attempt admission handshake paced by the server's retry_after hint — one peer re-registering, not per-item fan-out
+        return reg
 
     def _apply_task_info(self, reg: RegisterResult) -> None:
         if reg.content_length is not None and self.ts.meta.content_length < 0:
@@ -937,7 +971,8 @@ class PeerTaskConductor:
                     # than burning the reschedule budget.
                     if not self.dispatcher.usable():
                         reschedules += 1
-                        if reschedules > self.cfg.reschedule_limit:
+                        if reschedules > self.cfg.reschedule_limit \
+                                or not self._reschedule_allowed(reschedules):
                             await self._download_back_to_source()
                             return
                         reg = await self._reschedule()  # dflint: disable=DF025 one budget-bounded reschedule per empty dispatch round, not per-item chatter
@@ -962,14 +997,15 @@ class PeerTaskConductor:
                             continue
                         if time.monotonic() - last_update < self.cfg.no_progress_reschedule:
                             continue
-                    if reschedules >= self.cfg.reschedule_limit:
+                    reschedules += 1
+                    if reschedules > self.cfg.reschedule_limit \
+                            or not self._reschedule_allowed(reschedules):
                         self.log.info(
                             "peer %s: cutover to back-to-source for %d pieces",
                             self.peer_id, len(missing),
                         )
                         await self._download_back_to_source()
                         return
-                    reschedules += 1
                     reg = await self._reschedule()  # dflint: disable=DF025 one budget-bounded reschedule per no-progress window, not per-item chatter
                     if reg.back_to_source:
                         await self._download_back_to_source()
@@ -979,7 +1015,9 @@ class PeerTaskConductor:
                     await self._wait_update()
                     continue
 
-                queue: asyncio.Queue[int] = asyncio.Queue()
+                queue: asyncio.Queue[int] = asyncio.Queue(
+                    maxsize=max(1, len(available))
+                )
                 for i in available:
                     queue.put_nowait(i)
                 round_no += 1
@@ -1021,6 +1059,19 @@ class PeerTaskConductor:
             await asyncio.gather(*self._sync_tasks.values(), return_exceptions=True)
             self._sync_tasks.clear()
 
+    def _reschedule_allowed(self, reschedules: int) -> bool:
+        """The first reschedule is normal protocol (free); RETRIES spend
+        from the process-wide scheduler retry budget. Denied → the caller
+        fails fast to back-to-source instead of joining a reschedule storm
+        against an overloaded scheduler."""
+        if reschedules <= 1 or self._sched_budget.spend():
+            return True
+        self.log.info(
+            "reschedule retry budget exhausted (%s); failing fast to source",
+            self._sched_budget.name,
+        )
+        return False
+
     async def _reschedule(self) -> RegisterResult:
         """reschedule with scheduler-restart recovery: a scheduler that lost
         this peer (process restart wiped its resource pool, or GC evicted
@@ -1037,7 +1088,7 @@ class PeerTaskConductor:
             if e.code != "not_found":
                 raise
         self.log.info("scheduler lost peer %s: re-registering", self.peer_id)
-        reg = await self.scheduler.register_peer(self.peer_id, self.meta, self.host)
+        reg = await self._register_admitted()
         if getattr(reg, "error", ""):
             raise IOError(
                 f"task {self.meta.task_id}: re-registration refused: {reg.error}"
@@ -1322,7 +1373,12 @@ class PeerTaskConductor:
                 # failed so the dispatcher/cutover logic sees it immediately.
                 n = self._piece_errors.get(idx, 0) + 1
                 self._piece_errors[idx] = n
-                if n <= self.cfg.piece_requeue_limit and not self.ts.has_piece(idx):
+                if n <= self.cfg.piece_requeue_limit and not self.ts.has_piece(idx) \
+                        and self._parent_budget.spend():
+                    # the immediate re-enqueue is a RETRY and spends the
+                    # parent retry budget; denied → the piece reports failed
+                    # below and recovers via dispatch/reschedule/cutover
+                    # (another parent or the source) without the extra hammer
                     self.log.debug(
                         "piece %d worker failed (attempt %d), re-enqueueing: %r", idx, n, e
                     )

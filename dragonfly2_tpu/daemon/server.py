@@ -202,6 +202,36 @@ class DaemonRpcAdapter:
         }
 
 
+def make_address_book_resolver(manager_client, cache_path, *, ip: str | None = None):
+    """Scheduler address book with a last-good disk snapshot (ISSUE 17
+    manager-outage autonomy): while the manager answers, every successful
+    list is staleness-stamped to `cache_path`; when it stops answering, the
+    resolver serves the snapshot instead of failing — downloads keep
+    scheduling through a full manager blackout, including a daemon that
+    (re)boots mid-blackout. Raises only when the manager is dark AND no
+    snapshot was ever written (a first boot with nothing to fall back on)."""
+    from dragonfly2_tpu.utils.dynconfig import load_snapshot, store_snapshot
+
+    async def resolve() -> list[str]:
+        try:
+            rows = await manager_client.list_schedulers(ip=ip)
+        except Exception as e:
+            snap = load_snapshot(cache_path)
+            if snap is None:
+                raise
+            logging.getLogger(__name__).warning(
+                "manager unreachable; scheduler address book from disk "
+                "snapshot (age %.0fs): %s", snap.staleness_s(), e,
+            )
+            return [a for a in snap.data.get("schedulers", []) if a]
+        addrs = [f"{r['ip']}:{r['port']}" for r in rows if r.get("ip") and r.get("port")]
+        if addrs:
+            store_snapshot(cache_path, {"schedulers": addrs})
+        return addrs
+
+    return resolve
+
+
 async def run_daemon(
     *,
     scheduler_addr: str,
@@ -250,15 +280,24 @@ async def run_daemon(
     resolve = None
     resolver_manager = None
     if manager_addr:
+        from pathlib import Path as _Path
+
         from dragonfly2_tpu.rpc.manager import RemoteManagerClient
 
-        resolver_manager = RemoteManagerClient(manager_addr)
+        # manager RPCs consult the shared per-process "manager" retry budget
+        # (ISSUE 17): a blackout makes every daemon loop retry the same dead
+        # address — beyond the budget, fail fast to the cached snapshot below
+        resolver_manager = RemoteManagerClient(manager_addr, target_class="manager")
+        resolve = make_address_book_resolver(
+            resolver_manager,
+            _Path(storage_root) / "scheduler_address_book.json",
+            ip=ip,
+        )
 
-        async def resolve() -> list[str]:
-            rows = await resolver_manager.list_schedulers(ip=ip)
-            return [f"{r['ip']}:{r['port']}" for r in rows if r.get("ip") and r.get("port")]
-
-    scheduler = make_scheduler_client(scheduler_addr, resolve=resolve)
+    # wire clients consult the process-wide "scheduler" retry budget: an
+    # unreachable scheduler fails RPC retries fast past the budget instead
+    # of every conductor loop retrying it independently (ISSUE 17)
+    scheduler = make_scheduler_client(scheduler_addr, resolve=resolve, target_class="scheduler")
     if hasattr(scheduler, "start_resolver"):
         scheduler.start_resolver()
     from dragonfly2_tpu.daemon.conductor import ConductorConfig

@@ -48,7 +48,7 @@ class JobQueue:
     def _queue(self, name: str) -> asyncio.Queue:
         q = self._queues.get(name)
         if q is None:
-            q = self._queues[name] = asyncio.Queue()
+            q = self._queues[name] = asyncio.Queue()  # dflint: disable=DF034 backlog is one row per (job, cluster) in the operator-created jobs table; a maxsize would make the lease-reap requeue (put_nowait) DROP a live job instead of redelivering it
         return q
 
     async def create(

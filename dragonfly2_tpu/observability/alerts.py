@@ -165,6 +165,20 @@ def default_rules() -> list[AlertRule]:
                         "per-feature detail in dragonfly_feature_drift)",
         ),
         AlertRule(
+            name="scheduler_degraded",
+            kind="value",
+            metric="dragonfly_scheduler_degradation_level",
+            bound=0.5, window_s=60.0, for_s=0.0,
+            # the brownout ladder (scheduler/degradation.py) already applies
+            # sustain/cool hysteresis before moving the gauge, so the rule
+            # fires on the first evaluation that sees rung >= 1 — the ladder
+            # engaging IS the page-worthy event, the per-rung detail lives in
+            # the stats frame / dftop degradation column
+            description="scheduler brownout ladder engaged (load shedding "
+                        "active; see scheduler_degradation_level rung and "
+                        "README 'Overload & degradation')",
+        ),
+        AlertRule(
             name="piece_tls_handshake_failures",
             kind="rate",
             metric="dragonfly_dfdaemon_piece_tls_handshake_failures_total",

@@ -494,6 +494,17 @@ def build_stats_frame(
     # ML-plane drift (ISSUE 15): max per-feature PSI vs the serving model's
     # training reference — the number the feature_drift alert gates on
     put("feature_drift_max", r.latest("dragonfly_feature_drift_max"), 4)
+    # brownout ladder (ISSUE 17): current rung + admission shed rate — dftop
+    # shows which schedulers are degraded cluster-wide from these two keys
+    deg = r.latest("dragonfly_scheduler_degradation_level")
+    if deg is not None:
+        rates["degradation_level"] = int(deg)
+    put("admission_shed_per_s", r.rate(
+        "dragonfly_scheduler_admission_shed_total", window_s=window_s
+    ), 3)
+    mgr_down = r.latest("dragonfly_scheduler_manager_unreachable")
+    if mgr_down is not None and mgr_down >= 1.0:
+        rates["manager_unreachable"] = 1
     # loop health
     lag = r.hist_window("dragonfly_loop_lag_seconds", window_s=window_s)
     if lag is not None:

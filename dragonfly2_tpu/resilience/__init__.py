@@ -11,6 +11,10 @@ watchdog in daemon/conductor.py and nothing else):
               flags hand-rolled asyncio.sleep retry ladders outside here)
   breaker   — CircuitBreaker: per-target open/half-open/closed state so a
               dead scheduler costs one failure burst, not a timeout per call
+  budget    — RetryBudget: per-process token bucket over retries/second per
+              target class, so a thousand callers backing off in lockstep
+              cannot synchronize into a retry storm; servers' retry_after_s
+              hints pre-charge it (ISSUE 17)
   deadline  — cooperative deadline propagation (contextvar): a budget carried
               engine → conductor → scheduler-client, so nested rpc calls and
               piece fetches get min(remaining, per-op) timeouts instead of
@@ -25,6 +29,14 @@ See README.md "Resilience" for semantics and the DF_FAULTS spec grammar.
 
 from dragonfly2_tpu.resilience.backoff import BackoffPolicy
 from dragonfly2_tpu.resilience.breaker import CircuitBreaker
+from dragonfly2_tpu.resilience.budget import RetryBudget, budget_for, reset_budgets
 from dragonfly2_tpu.resilience.deadline import Deadline
 
-__all__ = ["BackoffPolicy", "CircuitBreaker", "Deadline"]
+__all__ = [
+    "BackoffPolicy",
+    "CircuitBreaker",
+    "Deadline",
+    "RetryBudget",
+    "budget_for",
+    "reset_budgets",
+]

@@ -42,9 +42,14 @@ MAX_FRAME = 256 << 20  # direct pieces / piece payloads stay well under this
 
 
 class RpcError(Exception):
-    def __init__(self, message: str, code: str = "internal"):
+    def __init__(self, message: str, code: str = "internal", retry_after_s: float = 0.0):
         super().__init__(message)
         self.code = code
+        # overload hint (ISSUE 17): a server answering "come back in N
+        # seconds" rides it in the error frame; clients pre-charge their
+        # process-wide RetryBudget with it so one overloaded answer mutes
+        # EVERY caller's retries against that target class, not just this one
+        self.retry_after_s = retry_after_s
 
 
 class ConnectionClosed(RpcError):
@@ -319,7 +324,10 @@ class RpcServer:
                     result = await handler(msg.get("p"))
                 out = {"i": rid, "r": result}
             except RpcError as e:
-                out = {"i": rid, "e": {"code": e.code, "message": str(e)}}
+                err = {"code": e.code, "message": str(e)}
+                if e.retry_after_s > 0:
+                    err["retry_after_s"] = e.retry_after_s
+                out = {"i": rid, "e": err}
             except Exception as e:
                 logger.exception("rpc handler %s failed", method)
                 out = {"i": rid, "e": {"code": "internal", "message": f"{type(e).__name__}: {e}"}}
@@ -341,12 +349,23 @@ class RpcClient:
         retry_backoff: float = 0.2,
         backoff: BackoffPolicy | None = None,
         breaker: CircuitBreaker | None = None,
+        retry_budget=None,
+        target_class: str | None = None,
         ssl: Any = None,
     ):
         self.address = address
         self.timeout = timeout
         self.retries = retries
         self.retry_backoff = retry_backoff  # kept: seeds the default policy base
+        # Cluster retry budget (ISSUE 17): per-process token bucket shared by
+        # every client retrying against the same TARGET CLASS ("scheduler",
+        # "manager", ...). None (the default) keeps per-client behavior —
+        # composition roots opt in where storm amplification is possible.
+        if retry_budget is None and target_class:
+            from dragonfly2_tpu.resilience.budget import budget_for
+
+            retry_budget = budget_for(target_class)
+        self.retry_budget = retry_budget
         # exponential + jitter, capped well under the per-op timeout so the
         # retry budget is spent on attempts, not waiting
         self.backoff = backoff or BackoffPolicy(
@@ -399,7 +418,10 @@ class RpcClient:
                     continue
                 if "e" in msg:
                     err = msg["e"]
-                    fut.set_exception(RpcError(err.get("message", ""), err.get("code", "internal")))
+                    fut.set_exception(RpcError(
+                        err.get("message", ""), err.get("code", "internal"),
+                        retry_after_s=float(err.get("retry_after_s", 0.0)),
+                    ))
                 else:
                     fut.set_result(msg.get("r"))
         except (asyncio.IncompleteReadError, OSError, asyncio.CancelledError):
@@ -478,6 +500,7 @@ class RpcClient:
                 last_err = e
                 self._drop_connection()
                 if attempt < self.retries:  # no pointless sleep before raising
+                    self._spend_retry(method, last_err)
                     await self.backoff.sleep(attempt)
             except RpcError as e:
                 if e.code == "deadline_exceeded":
@@ -491,12 +514,33 @@ class RpcClient:
                 else:
                     # any decoded response (even an error) proves the target alive
                     self.breaker.record_success()
+                if e.retry_after_s > 0 and self.retry_budget is not None:
+                    # server's overload hint: mute the WHOLE process's
+                    # retries against this target class for the window
+                    self.retry_budget.charge(e.retry_after_s)
                 if e.code == "resource_exhausted" and attempt < self.retries:
                     last_err = e
+                    self._spend_retry(method, last_err)
                     await self.backoff.sleep(attempt)
                     continue
                 raise
         raise last_err or RpcError("rpc call failed")
+
+    def _spend_retry(self, method: str, last_err: Exception | None) -> None:
+        """Consult the cluster retry budget before ONE retry attempt (first
+        attempts are free). Beyond budget — or inside a server-hinted
+        retry_after window — fail fast so the caller moves to its next
+        fallback instead of amplifying load on a sick target."""
+        b = self.retry_budget
+        if b is None:
+            return
+        if not b.spend():
+            raise RpcError(
+                f"{method}: retry budget exhausted for "
+                f"{b.name or self.address}"
+                + (f" (last: {last_err})" if last_err else ""),
+                code="unavailable",
+            )
 
     async def _call_once(
         self, method: str, payload: Any, timeout: float, trace: str | None = None

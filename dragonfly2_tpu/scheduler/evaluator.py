@@ -385,6 +385,10 @@ class Evaluator:
     # service / manager link; None = recording/drift off (library default)
     decisions: "DecisionRecorder | None" = None
     drift = None  # observability.sketches.DriftDetector
+    # Brownout ladder (ISSUE 17), attached by the scheduler service; None =
+    # no shedding (library default). Hot paths read ONE published bool per
+    # gate — the controller recomputes them on level changes.
+    degradation = None  # scheduler.degradation.DegradationController
     # Assembly seam: the bench's control_plane A/B swaps in
     # _build_pair_features_rowwise on a baseline instance; production always
     # serves the cached path.
@@ -392,10 +396,25 @@ class Evaluator:
 
     def _record_decision(self, child, parents, feats, scores, bundle=None) -> None:
         """Sampled decision-record hook (ISSUE 15): cheap None-check per
-        round when no recorder is attached; maybe_record never raises."""
+        round when no recorder is attached; maybe_record never raises.
+        Shed at brownout rung 2 (shed_obs) — recording is observability tax,
+        not serving."""
         rec = self.decisions
         if rec is not None:
+            deg = self.degradation
+            if deg is not None and deg.shed_obs:
+                return
             rec.maybe_record(child, parents, feats, scores, bundle=bundle)
+
+    def _observe_drift(self, feats) -> None:
+        """Feature-drift live-sketch feed (ISSUE 15); shed with decision
+        recording at brownout rung 2."""
+        d = self.drift
+        if d is not None:
+            deg = self.degradation
+            if deg is not None and deg.shed_obs:
+                return
+            d.observe(feats)
 
     def evaluate(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:
         if not parents:
@@ -630,6 +649,9 @@ class MLEvaluator(Evaluator):
         slot = self._shadow
         if slot is None:
             return
+        deg = self.degradation
+        if deg is not None and deg.shed_shadow:
+            return  # brownout rung 1: log-only work is the first thing shed
         tracker = slot.tracker
         try:
             if not tracker.should_sample():
@@ -706,9 +728,7 @@ class MLEvaluator(Evaluator):
         # feature-drift live sketch (ISSUE 15): sampled fold of the assembled
         # matrix — the drift detector compares exactly what scoring sees
         # against the distribution the serving model trained on
-        d = self.drift
-        if d is not None:
-            d.observe(feats)
+        self._observe_drift(feats)
         child_idx = bundle.node_index.get(child.host.id) if bundle is not None else None
         if child_idx is None:
             return feats, None, None, None
@@ -732,15 +752,22 @@ class MLEvaluator(Evaluator):
     def evaluate(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:
         if not parents:
             return np.zeros(0, dtype=np.float32)
+        deg = self.degradation
+        if deg is not None and deg.base_only:
+            # brownout rung 3: skip ML prepare/FFI entirely — the round
+            # costs one cached feature assembly + base matmul (shadow,
+            # recording, and drift are already shed at rungs 1-2)
+            self._count_fallback("degraded")
+            return self._base_from(
+                self.feature_builder(child, parents, self.topology, self.bandwidth)
+            )
         # read the serving bundle ONCE: everything below scores through this
         # reference, so a concurrent hot-swap can't produce a torn round
         bundle = self._serving
         if bundle is None or not bundle.ready:
             self._count_fallback("no_scorer")
             feats = self.feature_builder(child, parents, self.topology, self.bandwidth)
-            d = self.drift
-            if d is not None:
-                d.observe(feats)
+            self._observe_drift(feats)
             out = self._base_from(feats)
             self._shadow_score(child, parents, feats, out)
             self._record_decision(child, parents, feats, out)
@@ -792,6 +819,12 @@ class MLEvaluator(Evaluator):
         batch."""
         # one bundle read for the WHOLE batch: every round in this call
         # scores on the same model even if a swap lands mid-batch
+        deg = self.degradation
+        if deg is not None and deg.base_only:
+            # brownout rung 3: the whole batch serves base (evaluate() takes
+            # the same gate per round — kept here so the batch never touches
+            # the bundle/FFI machinery at all)
+            return [self.evaluate(c, ps) for c, ps in rounds]
         bundle = self._serving
         if bundle is None or not bundle.ready:
             return [self.evaluate(c, ps) for c, ps in rounds]
@@ -868,6 +901,9 @@ class MLEvaluator(Evaluator):
         """Micro-batched scoring: concurrent rounds on the event loop land in
         ONE native multi-round call; falls back to the sync path when no
         micro-batcher is attached, and to the base score on scorer errors."""
+        deg = self.degradation
+        if deg is not None and deg.base_only:
+            return self.evaluate(child, parents)  # rung 3: base-only gate there
         bundle = self._serving
         mb = bundle.microbatch if bundle is not None else None
         if mb is None or not getattr(mb, "ready", False):

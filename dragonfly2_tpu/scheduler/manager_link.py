@@ -128,7 +128,11 @@ class ManagerLink:
         from dragonfly2_tpu.scheduler.rollout import HealthGates, HealthSample
 
         self.service = service
-        self.manager = RemoteManagerClient(manager_addr)
+        # manager RPCs share the process-wide "manager" retry budget (ISSUE
+        # 17): during an outage every loop here retries against the same
+        # dead address — beyond the budget they fail fast instead of
+        # multiplying the reconnect storm
+        self.manager = RemoteManagerClient(manager_addr, target_class="manager")
         self.hostname = hostname or socket.gethostname()
         self.ip = ip
         self.port = port
@@ -164,6 +168,13 @@ class ManagerLink:
             base=model_watch_interval, multiplier=2.0,
             max_delay=model_watch_interval * 8, jitter=0.3,
         )
+        # ---- manager-outage autonomy (ISSUE 17) ----
+        # declared blackout state: keepalives failing or the rollout watch
+        # unable to reach the registry. While set, the scheduler keeps
+        # serving from cached dynconfig and the rollout watch is FROZEN (no
+        # promotion/attach/swap is decided on a partial view).
+        self.manager_unreachable = False
+        self._keepalive_failures = 0
         self.scheduler_id: int | None = None
         self.cluster_id: int | None = None
         # live scheduler address book from dynconfig — the federation layer's
@@ -229,13 +240,74 @@ class ManagerLink:
     async def _keepalive_loop(self) -> None:
         while True:
             await asyncio.sleep(self.keepalive_interval)
-            try:
-                await self.manager.keepalive(
-                    "scheduler", self.hostname, self.cluster_id,
-                    stats=self._stats_frame(),
-                )
-            except Exception as e:
-                logger.warning("manager keepalive failed: %s", e)
+            await self.keepalive_once()
+
+    async def keepalive_once(self) -> bool:
+        """One keepalive beat (tick body split out so tests and the sim can
+        drive it without the sleep loop). Tracks the outage state: two
+        consecutive failures declare `manager_unreachable`; the success that
+        ends an outage runs the jitter-smoothed rejoin catch-up."""
+        try:
+            await self.manager.keepalive(
+                "scheduler", self.hostname, self.cluster_id,
+                stats=self._stats_frame(),
+            )
+        except Exception as e:
+            self._keepalive_failures += 1
+            if self._keepalive_failures >= 2:  # one blip is not a blackout
+                self._set_manager_unreachable(True)
+            logger.warning(
+                "manager keepalive failed (%d consecutive): %s",
+                self._keepalive_failures, e,
+            )
+            return False
+        recovered = self.manager_unreachable
+        self._keepalive_failures = 0
+        self._set_manager_unreachable(False)
+        if recovered:
+            await self._rejoin()
+        return True
+
+    def _set_manager_unreachable(self, down: bool) -> None:
+        if down == self.manager_unreachable:
+            return
+        from dragonfly2_tpu.scheduler import metrics
+
+        self.manager_unreachable = down
+        metrics.MANAGER_UNREACHABLE.set(1.0 if down else 0.0)
+        if down:
+            logger.warning(
+                "manager unreachable: autonomous mode (cached dynconfig "
+                "serves, rollout watch frozen, keepalives keep probing)"
+            )
+
+    def _rejoin_delay(self) -> float:
+        """Deterministic per-host fraction of one keepalive interval: a
+        fleet whose blackout just ended spreads its re-registration burst
+        across the interval instead of stampeding the manager on its first
+        healthy tick (and re-killing it)."""
+        import zlib
+
+        spread = max(1.0, self.keepalive_interval)
+        return (zlib.crc32(self.hostname.encode()) % 997) / 997.0 * spread
+
+    async def _rejoin(self) -> None:
+        """Catch-up after an outage: re-register (the manager may have
+        expired this scheduler's row) and refresh dynconfig, after the
+        per-host jitter delay."""
+        delay = self._rejoin_delay()
+        logger.info("manager reachable again; rejoin catch-up in %.1fs", delay)
+        await asyncio.sleep(delay)
+        try:
+            row = await self.manager.update_scheduler(
+                self.hostname, self.ip, self.port,
+                idc=self.idc, location=self.location,
+            )
+            self.scheduler_id = row["id"]
+            self.cluster_id = row["scheduler_cluster_id"]
+            await self.dynconfig.refresh()
+        except Exception as e:
+            logger.warning("rejoin catch-up failed: %s", e)
 
     def _stats_frame(self) -> dict | None:
         """The compact windowed-health frame riding each keepalive (ISSUE
@@ -385,8 +457,17 @@ class ManagerLink:
         (RPC down, corrupt ACTIVE artifact) propagate so the loop backs off —
         a corrupt CANDIDATE is terminal (reported + rejected), never a wedge."""
         self._drain_retired()
-        await self._maybe_rollback()
-        status = await self.manager.rollout_status("gnn", self.scheduler_id or 0)
+        await self._maybe_rollback()  # local decision: runs through a blackout
+        try:
+            status = await self.manager.rollout_status("gnn", self.scheduler_id or 0)
+        except Exception:
+            # FREEZE (ISSUE 17): with the manager unreachable no promotion,
+            # attach, or swap is decided — the serving bundle, warm previous,
+            # and candidate stay exactly as they are until the registry
+            # answers again (never half-apply a promotion from a stale view)
+            self._set_manager_unreachable(True)
+            raise
+        self._set_manager_unreachable(False)
         ev = self.service.evaluator
         if hasattr(ev, "attach_candidate"):
             promoted = await self._check_candidate(status)
@@ -495,7 +576,13 @@ class ManagerLink:
             )
         self._active_model_version = version
         self._note_swap("ok")
-        self._install_drift_reference(ev, row)
+        sketch = self._install_drift_reference(ev, row)
+        # the sketch rides the serving bundle so a rollback restores the
+        # previous model's baseline (ISSUE 15 residual closed by ISSUE 17)
+        bundle = getattr(ev, "serving_bundle", None)
+        if bundle is not None and hasattr(bundle, "drift_sketch"):
+            bundle.drift_sketch = sketch
+            bundle.drift_sketch_version = version
         logger.info(
             "ml evaluator upgraded to model %s (%d hosts, microbatch=%s, "
             "handle_pool=%s, warm_prev=%s)",
@@ -505,15 +592,17 @@ class ManagerLink:
         )
 
     @staticmethod
-    def _install_drift_reference(ev, row: dict) -> None:
+    def _install_drift_reference(ev, row: dict):
         """Feature-drift baseline (ISSUE 15): load the artifact's
         training-reference sketch (digest-covered — verify_artifact already
         passed for this path) into the evaluator's drift detector. A
         pre-sketch artifact clears the reference: drift must never compare
-        live traffic against a PREVIOUS model's training distribution."""
+        live traffic against a PREVIOUS model's training distribution.
+        Returns the loaded sketch (or None) so the caller can carry it on
+        the serving bundle for rollback."""
         drift = getattr(ev, "drift", None)
         if drift is None:
-            return
+            return None
         from dragonfly2_tpu.trainer import artifacts
 
         sketch = None
@@ -524,6 +613,7 @@ class ManagerLink:
                 "reference sketch load failed for %s", row.get("version", "")
             )
         drift.set_reference(sketch, version=row.get("version", ""))
+        return sketch
 
     async def _check_candidate(self, status: dict) -> bool:
         """Shadow-scoring leg: attach the newest candidate (digest-verified;
@@ -653,12 +743,18 @@ class ManagerLink:
             return
         bad = ev.swap_bundle(prev)  # instant: prev's handles are still warm
         self._warm_prev = None
-        # drift baseline: the bad model's reference no longer describes what
-        # serves — CLEAR rather than guess (the warm bundle carries no
-        # artifact path; the next registry-driven install re-references)
+        # drift baseline follows the bundle: the restored model serves
+        # against ITS OWN training-reference sketch, carried warm on the
+        # bundle since its install — never baseline-less, never the bad
+        # model's distribution (a pre-sketch artifact restores a cleared
+        # reference, same as its original install)
         drift = getattr(ev, "drift", None)
         if drift is not None:
-            drift.set_reference(None)
+            drift.set_reference(
+                getattr(prev, "drift_sketch", None),
+                version=getattr(prev, "drift_sketch_version", "")
+                or (prev.version or ""),
+            )
         bad_version = self._active_model_version
         if bad is not None:
             if bad.version:

@@ -171,6 +171,32 @@ FEDERATION_LAST_SYNC_TIMESTAMP = _r.gauge(
     "Unix time of the last successful federation sync (0 = never)",
     subsystem="scheduler",
 )
+# Brownout ladder (ISSUE 17): the current degradation rung, 0 = normal
+# through 4 = priority-aware admission control (scheduler/degradation.py
+# LEVEL_NAMES). A stock alert rule fires on >= 1; dftop shows the rung
+# cluster-wide via the stats frame.
+DEGRADATION_LEVEL = _r.gauge(
+    "degradation_level",
+    "Brownout ladder rung (0 normal, 1 shed shadow, 2 shed observability, "
+    "3 base-only serving, 4 admission control)",
+    subsystem="scheduler",
+)
+ADMISSION_SHED_TOTAL = _r.counter(
+    "admission_shed_total",
+    "Registrations refused with a typed overloaded + retry_after_s answer "
+    "by the admission-control rung, by traffic-shaper priority class",
+    subsystem="scheduler", labels=("priority",),
+)
+# Manager-outage autonomy (ISSUE 17): 1 while the manager link is in
+# declared blackout mode — keepalives failing (2+ consecutive) or the
+# rollout watch unable to reach the registry. Scheduling and downloads
+# continue from cached state; the rollout watch is frozen.
+MANAGER_UNREACHABLE = _r.gauge(
+    "manager_unreachable",
+    "1 while the manager link is in autonomous (blackout) mode: cached "
+    "dynconfig serves, rollout watch frozen, keepalives keep probing",
+    subsystem="scheduler",
+)
 
 
 class ServiceMetrics:

@@ -164,7 +164,12 @@ class Peer:
         self.task = task
         self.host = host
         self._clock = host._clock  # one clock per pool; hosts carry it
-        self.fsm = FSM(PEER_PENDING, _PEER_EVENTS)
+        # Wildcard callback maintains the task's back-to-source occupancy
+        # counter (ISSUE 17 satellite): EVERY transition in or out of
+        # PEER_BACK_TO_SOURCE passes through fire(), so the counter is exact
+        # without the O(task-peers) scan can_back_to_source() used to run
+        # per candidate round (O(N²) across a 10^5-peer flash crowd).
+        self.fsm = FSM(PEER_PENDING, _PEER_EVENTS, callbacks={"*": self._on_transition})
         self.finished_pieces = Bitset()
         self.piece_costs_ms: deque[float] = deque(maxlen=20)
         # Rolling mean over piece_costs_ms, published as ONE scalar at append
@@ -202,6 +207,16 @@ class Peer:
 
     def bump_feat(self) -> None:
         self.feat_version += 1
+
+    def _on_transition(self, fsm: FSM, event: str, src: str, dst: str) -> None:
+        # int bumps under the FSM's own RLock (and the GIL): exact even when
+        # dfstress fires arbitrary events from chaos paths
+        if dst == PEER_BACK_TO_SOURCE and src != PEER_BACK_TO_SOURCE:
+            self.task._back_to_source_active += 1
+        elif src == PEER_BACK_TO_SOURCE and dst != PEER_BACK_TO_SOURCE:
+            self.task._back_to_source_active = max(
+                0, self.task._back_to_source_active - 1
+            )
 
     @property
     def state(self) -> str:
@@ -284,6 +299,10 @@ class Task:
         self.direct_piece: bytes = b""  # TINY scope payload
         self.dag: DAG[Peer] = DAG()
         self.back_to_source_budget = 3  # concurrent back-source peers (ref constants.go:66-70)
+        # live count of peers in PEER_BACK_TO_SOURCE, maintained by the peer
+        # FSM callback (Peer._on_transition) + delete_peer below — the O(1)
+        # read can_back_to_source() takes on the per-candidate hot path
+        self._back_to_source_active = 0
         self.created_at = self._clock.monotonic()
         self.updated_at = self.created_at
 
@@ -319,6 +338,10 @@ class Task:
         try:
             peer = self.dag.vertex(peer_id).value
             peer.host.peer_ids.discard(peer_id)
+            # a row deleted WHILE in back_to_source never fires another
+            # event, so the FSM callback can't release its budget slot
+            if peer.fsm.is_(PEER_BACK_TO_SOURCE):
+                self._back_to_source_active = max(0, self._back_to_source_active - 1)
         except VertexNotFound:
             pass
         self.dag.delete_vertex(peer_id)
@@ -394,8 +417,11 @@ class Task:
         )
 
     def can_back_to_source(self) -> bool:
-        active = sum(1 for p in self.dag.values() if p.fsm.is_(PEER_BACK_TO_SOURCE))
-        return active < self.back_to_source_budget
+        # O(1): the counter is maintained by the peer FSM callback and
+        # delete_peer — this runs per scheduling round at flash-crowd scale,
+        # where the old full-DAG scan was O(N²) across the crowd (PR 14
+        # residual, closed in ISSUE 17; sim profile pins it off the hot path)
+        return self._back_to_source_active < self.back_to_source_budget
 
     def touch(self) -> None:
         self.updated_at = self._clock.monotonic()

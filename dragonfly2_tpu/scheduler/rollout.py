@@ -81,6 +81,7 @@ class ModelBundle:
 
     __slots__ = (
         "scorer", "node_index", "microbatch", "handle_pool", "version",
+        "drift_sketch", "drift_sketch_version",
         "_lock", "_active", "_closed",
     )
 
@@ -93,6 +94,12 @@ class ModelBundle:
         self.microbatch = microbatch
         self.handle_pool = handle_pool
         self.version = version
+        # the model's training-reference feature sketch rides the bundle so
+        # an auto-rollback restores the previous model WITH its own drift
+        # baseline (the warm bundle has no artifact path to re-load it from);
+        # None = the artifact shipped no sketch
+        self.drift_sketch = None
+        self.drift_sketch_version = ""
         self._lock = threading.Lock()
         self._active = 0
         self._closed = False

@@ -67,6 +67,17 @@ async def run_scheduler(
     if service.scheduling.dispatcher is not None:
         loop_monitor.attach_dispatcher(service.scheduling.dispatcher)
     loop_monitor.start()
+    # brownout ladder (ISSUE 17): driven by the SAME instruments — loop lag
+    # p95 and dispatcher occupancy/queue depth — stepping through explicit
+    # shedding modes under sustained pressure instead of timing out opaquely
+    from dragonfly2_tpu.scheduler.degradation import DegradationController
+
+    degradation = DegradationController()
+    degradation.attach_loop_monitor(loop_monitor)
+    if service.scheduling.dispatcher is not None:
+        degradation.attach_dispatcher(service.scheduling.dispatcher)
+    service.attach_degradation(degradation)
+    degradation.start()
     # metrics plane (ISSUE 12): the timeseries recorder + SLO alert engine
     # are always on — sampling is one registry walk per ~2 s, and every
     # consumer (rollout health, stats frames, /debug/ts, dftop) needs the
@@ -170,6 +181,7 @@ async def run_scheduler(
         await run_until_signalled(ready_event)
     finally:
         gc.stop()
+        degradation.stop()
         loop_monitor.stop()
         alert_engine.stop()
         recorder.stop()

@@ -58,6 +58,9 @@ class TaskMeta:
     tag: str = ""
     application: str = ""
     filters: tuple = ()
+    # traffic-shaper tenant weight (daemon/trafficshaper.py), carried to the
+    # scheduler so the admission-control brownout rung sheds lowest first
+    priority: float = 1.0
 
 
 @dataclass
@@ -86,6 +89,10 @@ class RegisterResult:
     total_pieces: int | None = None
     digest: str = ""
     error: str = ""  # non-empty: registration refused (e.g. cache gone)
+    # error == "overloaded": come back after this many seconds — the typed
+    # brownout answer (ISSUE 17); clients pre-charge their retry budget with
+    # it so the whole process backs off, not just this request
+    retry_after_s: float = 0.0
 
 
 class SchedulerService:
@@ -179,6 +186,15 @@ class SchedulerService:
         import os as _os
 
         self.federation_epoch = _os.urandom(8).hex()
+        # Brownout ladder (ISSUE 17): attached by the composition root (or
+        # the sim); None = admit everything, shed nothing
+        self.degradation = None
+
+    def attach_degradation(self, controller) -> None:
+        """Wire a DegradationController: register_peer consults its admission
+        gate and the evaluator reads its shed flags."""
+        self.degradation = controller
+        self.evaluator.degradation = controller
 
     def close(self) -> None:
         """Release dispatcher worker threads (no-op in serial mode)."""
@@ -219,6 +235,25 @@ class SchedulerService:
     async def register_peer(
         self, peer_id: str, meta: TaskMeta, host_info: HostInfo
     ) -> RegisterResult:
+        # Admission control (brownout rung 4): refuse BEFORE any resource
+        # rows exist — a shed registration must cost one priority compare,
+        # not a peer/host/task allocation it then abandons. The typed answer
+        # (vs letting the RPC time out) turns a would-be retry storm into a
+        # scheduled comeback at retry_after_s.
+        deg = self.degradation
+        if deg is not None:
+            # consulted on EVERY registration (not just at rung 4) so the
+            # controller learns the live priority classes before it ever
+            # needs a shed cutoff; below rung 4 this is one set lookup
+            admitted, retry_after = deg.admit(getattr(meta, "priority", 1.0))
+            if not admitted:
+                metrics.ADMISSION_SHED_TOTAL.inc(
+                    priority=f"{getattr(meta, 'priority', 1.0):g}"
+                )
+                return RegisterResult(
+                    scope=SizeScope.UNKNOWN.value, task_id=meta.task_id,
+                    error="overloaded", retry_after_s=retry_after,
+                )
         with self.state_lock:
             host = self.pool.load_or_create_host(
                 host_info.id,

@@ -66,6 +66,31 @@ class SimConfig:
     reschedule_limit: int = 2  # mid-transfer parent-loss recoveries per peer
     bucket_s: float = 10.0  # per-interval stats resolution
     stream_lag_s: float = 0.1  # child completes this long after a still-running parent
+    # ---- graceful degradation under overload (ISSUE 17) ----
+    # modeled scheduler service time per registration (0 = instant): requests
+    # queue behind a per-scheduler busy horizon, and that backlog is exactly
+    # what the REAL DegradationController's queue-depth probe reads
+    register_cost_ms: float = 0.0
+    # modeled client deadline: a register whose queue wait exceeds it "times
+    # out" (the server still burns the service time on the dead request — the
+    # storm amplifier admission control exists to cut) and retries later
+    register_timeout_s: float = 0.0
+    degradation: bool = False  # attach the real brownout ladder per scheduler
+    degradation_queue_budget: float = 64.0
+    degradation_sustain_s: float = 3.0
+    degradation_cool_s: float = 10.0
+    degradation_interval_s: float = 1.0
+    overload_retry_limit: int = 20  # overloaded/timeout re-registers before giving up
+    gray_uplink_frac: float = 0.03  # a gray parent serves at this uplink fraction
+    # modeled manager plane: keepalive agents probing a manager that scenario
+    # control events blackout()/restore(); 0 agents = plane off
+    keepalive_agents: int = 0
+    keepalive_interval_s: float = 20.0
+    keepalive_horizon_s: float = 600.0
+    # True: every agent's first keepalive fires at the SAME instant (a fleet
+    # restarted by one deploy — the worst case for rejoin thundering herds);
+    # False: initial phases staggered across one interval
+    keepalive_sync_start: bool = False
 
 
 @dataclass
@@ -102,6 +127,16 @@ class SimReport:
     gc_removed: dict[str, int] = field(default_factory=dict)
     buckets: list[dict] = field(default_factory=list)
     dataset: dict[str, Any] | None = None
+    # overload / degradation plane (ISSUE 17)
+    overload_refused: int = 0  # typed `overloaded` answers received
+    overload_retries: int = 0  # re-registers scheduled after refusal/timeout
+    register_timeouts: int = 0  # modeled client-deadline expiries in queue
+    admitted_p50_ms: float = 0.0  # arrival -> successful admission latency
+    admitted_p99_ms: float = 0.0
+    shed_by_class: dict[str, int] = field(default_factory=dict)
+    gray_peers: int = 0
+    degradation: dict[str, Any] = field(default_factory=dict)
+    manager: dict[str, Any] = field(default_factory=dict)
 
 
 class _SimPeer:
@@ -109,6 +144,7 @@ class _SimPeer:
         "index", "peer_id", "host_id", "placement", "task", "host_info",
         "state", "parents", "rate_bps", "attempts", "reschedules",
         "alive", "crashed_flag", "probe_targets", "probes_left", "finish_at",
+        "priority", "gray", "arrived_at", "overload_attempts",
     )
 
     def __init__(self, index: int, task: TaskSpec, placement: Placement):
@@ -135,6 +171,26 @@ class _SimPeer:
         self.probe_targets: list = []
         self.probes_left = 0
         self.finish_at = 0.0
+        self.priority = 1.0  # traffic-shaper class (admission sheds lowest first)
+        self.gray = False  # gray parent: uplink capped at gray_uplink_frac
+        self.arrived_at = 0.0  # set on arrival; -1 once admission latency counted
+        self.overload_attempts = 0
+
+
+class _KeepaliveAgent:
+    """One modeled manager-link client (a daemon or scheduler keepalive loop).
+
+    Carries exactly the attributes ManagerLink._rejoin_delay reads (hostname,
+    keepalive_interval), so the rejoin spread the blackout scenarios measure
+    is the PRODUCTION jitter function, not a sim reimplementation."""
+
+    __slots__ = ("hostname", "keepalive_interval", "failures", "unreachable")
+
+    def __init__(self, index: int, interval: float):
+        self.hostname = f"sim-agent-{index:05d}"
+        self.keepalive_interval = interval
+        self.failures = 0
+        self.unreachable = False
 
 
 class _LoopbackFederationClient:
@@ -220,6 +276,36 @@ class Simulation:
                 )
         self._severed: set[frozenset] = set()
 
+        # ---- overload / degradation plane (ISSUE 17) ----
+        import random as _random
+
+        self._rng = _random.Random(self.config.seed + 2)  # retry jitter draws
+        self._busy_until: dict[str, float] = {n: 0.0 for n in self.names}
+        self._admit_waits: list[float] = []
+        self._deg_max = 0
+        self.degradation_controllers: dict[str, Any] = {}
+        if self.config.degradation:
+            from dragonfly2_tpu.scheduler.degradation import DegradationController
+
+            # the REAL ladder, fed by the MODELED register backlog: depth =
+            # queued registrations behind this scheduler's busy horizon
+            cost_s = max(self.config.register_cost_ms, 0.001) / 1000.0
+            for name in self.names:
+                ctrl = DegradationController(
+                    queue_depth=lambda n=name: max(
+                        0.0, (self._busy_until[n] - self.clock.monotonic()) / cost_s
+                    ),
+                    queue_budget=self.config.degradation_queue_budget,
+                    sustain_s=self.config.degradation_sustain_s,
+                    cool_s=self.config.degradation_cool_s,
+                )
+                self.services[name].attach_degradation(ctrl)
+                self.degradation_controllers[name] = ctrl
+        # modeled manager plane (blackout scenarios)
+        self.manager_down = False
+        self._agents: list[_KeepaliveAgent] = []
+        self._mgr_stats = {"unreachable_declared": 0, "recovered": 0, "rejoined": 0}
+
         # ---- event heap + run state ----
         self._heap: list[tuple[float, int, str, Any]] = []
         self._seq = 0
@@ -261,6 +347,13 @@ class Simulation:
 
     def heal(self, a: str, b: str) -> None:
         self._severed.discard(frozenset((a, b)))
+
+    def blackout(self) -> None:
+        """Take the modeled manager down (keepalive agents start failing)."""
+        self.manager_down = True
+
+    def restore(self) -> None:
+        self.manager_down = False
 
     def is_partitioned(self, a: str, b: str) -> bool:
         return frozenset((a, b)) in self._severed
@@ -311,6 +404,10 @@ class Simulation:
     def _new_peer(self, task: TaskSpec, region: str | None = None) -> _SimPeer:
         placement = self.topology.place(region)
         sp = _SimPeer(len(self._peers), task, placement)
+        sp.priority = self.workload.draw_priority()
+        sp.gray = self.workload.is_gray()
+        if sp.gray:
+            self.report.gray_peers += 1
         self._peers.append(sp)
         self._peers_by_pid[sp.peer_id] = sp
         self._placements[sp.host_id] = placement
@@ -325,6 +422,7 @@ class Simulation:
                 "arrivals": 0, "rounds": 0, "parents": 0, "same_region": 0,
                 "completions": 0, "back_to_source": 0,
                 "origin_bytes": 0, "p2p_bytes": 0,
+                "refused_overload": 0, "keepalives": 0, "rejoins": 0,
             }
         return d
 
@@ -334,6 +432,7 @@ class Simulation:
         self._live += 1
         sim_metrics.SIM_PEERS.set(float(self._live))
         self._bucket()["arrivals"] += 1
+        sp.arrived_at = self.clock.monotonic()
         # the daemon keepalive's host announce, to the host's ring owner:
         # probe rounds route there (federation shards probe ingest by source
         # host), so that member must know the host to hand out targets
@@ -342,16 +441,63 @@ class Simulation:
 
     async def _register(self, sp: _SimPeer) -> None:
         rep = self.report
+        cfg = self.config
         task = sp.task
-        client = self._for_task(task.task_id)
+        now = self.clock.monotonic()
+        name = self.ring.pick(task.task_id)
+        client = self.clients[name]
+        # modeled service time (ISSUE 17): registrations queue behind this
+        # scheduler's busy horizon; the degradation controller's queue-depth
+        # probe reads exactly this backlog
+        cost_s = cfg.register_cost_ms / 1000.0
+        wait = 0.0
+        if cost_s > 0:
+            wait = max(0.0, self._busy_until[name] - now)
+            if cfg.register_timeout_s > 0 and wait > cfg.register_timeout_s:
+                # the client's deadline expired in queue. The server still
+                # burns service time on the dead request — UNLESS the ladder's
+                # admission rung is up, in which case the request gets the
+                # cheap typed shed answer instead of full processing. This is
+                # the retry-storm amplifier the brownout ladder exists to cut.
+                deg = self.services[name].degradation
+                cheap = deg is not None and deg.admission_control
+                self._busy_until[name] = now + wait + cost_s * (0.1 if cheap else 1.0)
+                rep.register_timeouts += 1
+                self._requeue_register(
+                    sp, now + cfg.register_timeout_s * (1.0 + self._rng.random())
+                )
+                return
         res = await client.register_peer(
-            sp.peer_id, TaskMeta(task.task_id, task.url), sp.host_info
+            sp.peer_id,
+            TaskMeta(task.task_id, task.url, priority=sp.priority),
+            sp.host_info,
         )
         rep.registered += 1
+        if res.error == "overloaded":
+            # the typed brownout answer: costs one priority compare server-
+            # side; the retry_after_s hint schedules the comeback (jittered
+            # UP only, like the real conductor's _register_admitted)
+            if cost_s > 0:
+                self._busy_until[name] = max(now, self._busy_until[name]) + cost_s * 0.1
+            rep.overload_refused += 1
+            cls = f"{sp.priority:g}"
+            rep.shed_by_class[cls] = rep.shed_by_class.get(cls, 0) + 1
+            self._bucket()["refused_overload"] += 1
+            retry_after = max(float(getattr(res, "retry_after_s", 0.0)), 0.5)
+            self._requeue_register(
+                sp, now + retry_after * (1.0 + 0.5 * self._rng.random())
+            )
+            return
+        if cost_s > 0:
+            self._busy_until[name] = max(now, self._busy_until[name]) + cost_s
         if res.error:
             rep.refused += 1
             sp.state = "failed"
             return
+        if sp.arrived_at >= 0:
+            # first successful admission: arrival -> admitted latency, once
+            self._admit_waits.append((now - sp.arrived_at) + wait + cost_s)
+            sp.arrived_at = -1.0
         if res.back_to_source:
             sp.state = "origin"
             rep.back_to_source += 1
@@ -389,6 +535,17 @@ class Simulation:
             rep.failed += 1
             await client.report_peer_result(sp.peer_id, success=False)
 
+    def _requeue_register(self, sp: _SimPeer, at_s: float) -> None:
+        """Schedule a re-register after an overloaded answer or a modeled
+        client timeout; gives up (peer failed) past overload_retry_limit."""
+        sp.overload_attempts += 1
+        if sp.overload_attempts > self.config.overload_retry_limit:
+            sp.state = "failed"
+            self.report.failed += 1
+            return
+        self.report.overload_retries += 1
+        self._push(at_s, "register", sp)
+
     def _note_placement(self, sp: _SimPeer, parents: list) -> None:
         rep = self.report
         rep.rounds_with_parents += 1
@@ -424,9 +581,18 @@ class Simulation:
                 continue
             host = svc.pool.hosts.get(pi.host_id)
             share = max(1, host.concurrent_uploads) if host is not None else 1
+            # gray parent (ISSUE 17): alive and registered, but its uplink
+            # serves at a crawl — the degradation the scheduler can only see
+            # through bandwidth feedback, never through liveness
+            parent_sp = self._peers_by_pid.get(pi.peer_id)
+            uplink = cfg.uplink_bps * (
+                self.config.gray_uplink_frac
+                if parent_sp is not None and parent_sp.gray
+                else 1.0
+            )
             total += min(
                 self.topology.link_bps(placement, sp.placement),
-                cfg.uplink_bps / share,
+                uplink / share,
             )
         return min(cfg.downlink_bps, total) if total > 0 else cfg.downlink_bps * 0.01
 
@@ -624,6 +790,57 @@ class Simulation:
                     "sample", None,
                 )
 
+    async def _on_degrade(self, _payload) -> None:
+        """One hysteresis tick on every attached brownout ladder — keeps
+        ticking past heap drain until every ladder is back at level 0, so a
+        run never ends with shedding still engaged but unevaluated."""
+        lvl = 0
+        now = self.clock.monotonic()
+        for ctrl in self.degradation_controllers.values():
+            lvl = max(lvl, ctrl.evaluate_once(now=now))
+        self._deg_max = max(self._deg_max, lvl)
+        if self._recorder is not None:
+            # the periodic "sample" tick stops when the workload drains, but
+            # the ladder may still be stepping down — sample here too so the
+            # alert engine sees the gauge reach 0, not its last loaded value
+            self._recorder.sample_once(now=self.clock.time())
+        if self._heap_has_work() or lvl > 0:
+            self._push(now + self.config.degradation_interval_s, "degrade", None)
+
+    async def _on_keepalive(self, agent: _KeepaliveAgent) -> None:
+        now = self.clock.monotonic()
+        self._bucket()["keepalives"] += 1
+        next_at = now + agent.keepalive_interval
+        if self.manager_down:
+            agent.failures += 1
+            # the real threshold (ManagerLink.keepalive_once): one blip is
+            # not a blackout, two consecutive failures are
+            if agent.failures >= 2 and not agent.unreachable:
+                agent.unreachable = True
+                self._mgr_stats["unreachable_declared"] += 1
+        else:
+            if agent.unreachable:
+                agent.unreachable = False
+                agent.failures = 0
+                self._mgr_stats["recovered"] += 1
+                # recovery catch-up after the PRODUCTION jitter function —
+                # deterministic per-host spread across the keepalive
+                # interval. The rejoin replaces this agent's next keepalive
+                # slot, exactly like the inline await in keepalive_once.
+                from dragonfly2_tpu.scheduler.manager_link import ManagerLink
+
+                delay = ManagerLink._rejoin_delay(agent)
+                self._push(now + delay, "rejoin", agent)
+                next_at = now + delay + agent.keepalive_interval
+            else:
+                agent.failures = 0
+        if next_at <= self.config.keepalive_horizon_s:
+            self._push(next_at, "keepalive", agent)
+
+    async def _on_rejoin(self, agent: _KeepaliveAgent) -> None:
+        self._bucket()["rejoins"] += 1
+        self._mgr_stats["rejoined"] += 1
+
     async def _on_control(self, fn: Callable[[], Any]) -> None:
         out = fn()
         if hasattr(out, "__await__"):
@@ -631,7 +848,7 @@ class Simulation:
 
     # ---- the loop ----
 
-    _PERIODIC = ("fed_sync", "gc", "sample")
+    _PERIODIC = ("fed_sync", "gc", "sample", "degrade")
 
     def _heap_has_work(self) -> bool:
         """True while any non-periodic event remains — periodic ticks
@@ -650,6 +867,9 @@ class Simulation:
             "fed_sync": self._on_fed_sync,
             "gc": self._on_gc,
             "sample": self._on_sample,
+            "degrade": self._on_degrade,
+            "keepalive": self._on_keepalive,
+            "rejoin": self._on_rejoin,
             "control": self._on_control,
         }
         inc = sim_metrics.SIM_EVENTS_TOTAL.inc
@@ -682,6 +902,22 @@ class Simulation:
             self._push(cfg.gc_interval_s, "gc", None)
         if self._recorder is not None:
             self._push(0.0, "sample", None)
+        if self.degradation_controllers:
+            self._push(cfg.degradation_interval_s, "degrade", None)
+        if cfg.keepalive_agents > 0:
+            # initial phases staggered across one interval (daemons start at
+            # different times) — steady-state keepalive load is uniform
+            self._agents = [
+                _KeepaliveAgent(i, cfg.keepalive_interval_s)
+                for i in range(cfg.keepalive_agents)
+            ]
+            for i, agent in enumerate(self._agents):
+                first = (
+                    cfg.keepalive_interval_s
+                    if cfg.keepalive_sync_start
+                    else (i + 1) * cfg.keepalive_interval_s / cfg.keepalive_agents
+                )
+                self._push(first, "keepalive", agent)
 
         from dragonfly2_tpu.observability.tracing import default_tracer
 
@@ -717,6 +953,25 @@ class Simulation:
                 "first_remote_edge_s": self._first_remote_edge_s(),
                 "history": self._fed_history,
             }
+        if self._admit_waits:
+            ws = sorted(self._admit_waits)
+            rep.admitted_p50_ms = round(ws[len(ws) // 2] * 1e3, 2)
+            rep.admitted_p99_ms = round(
+                ws[min(len(ws) - 1, int(0.99 * len(ws)))] * 1e3, 2
+            )
+        if self.degradation_controllers:
+            rep.degradation = {
+                "max_level": self._deg_max,
+                "final_level": max(
+                    c.level for c in self.degradation_controllers.values()
+                ),
+                "per_scheduler": {
+                    n: c.stats() for n, c in self.degradation_controllers.items()
+                },
+            }
+        if self._agents:
+            rep.manager = dict(self._mgr_stats)
+            rep.manager["agents"] = len(self._agents)
         rep.buckets = [self._buckets[k] for k in sorted(self._buckets)]
         return rep
 

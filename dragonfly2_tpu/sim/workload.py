@@ -53,6 +53,13 @@ class WorkloadConfig:
     probe_fraction: float = 0.25
     probe_rounds: int = 2
     probe_interval_s: float = 5.0
+    # gray parents (ISSUE 17): fraction of peers whose uplink serves at a
+    # crawl after completion (engine caps it at gray_uplink_frac) — degraded
+    # but alive, invisible to liveness checks
+    gray_fraction: float = 0.0
+    # traffic-shaper priority classes, drawn uniformly per peer — feeds the
+    # admission-control rung's lowest-first shed order
+    priority_classes: tuple[float, ...] = (1.0,)
 
 
 @dataclass
@@ -102,3 +109,12 @@ class Workload:
 
     def runs_probes(self) -> bool:
         return self._rng.random() < self.config.probe_fraction
+
+    def is_gray(self) -> bool:
+        return self._rng.random() < self.config.gray_fraction
+
+    def draw_priority(self) -> float:
+        classes = self.config.priority_classes
+        if not classes:
+            return 1.0
+        return classes[self._rng.randrange(len(classes))]

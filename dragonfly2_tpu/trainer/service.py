@@ -132,7 +132,7 @@ class TrainerService:
         self._pool_contributors: set[tuple[int, str]] = set()
         self._sessions: dict[str, TrainSession] = {}
         self._next = 0
-        self._queue: collections.deque[TrainSession] = collections.deque()
+        self._queue: collections.deque[TrainSession] = collections.deque()  # dflint: disable=DF034 depth is bounded by one pending close per scheduler (the drainer coalesces same-pool entries); a maxlen would silently DROP a committed training run from the far end
         self._drainer: asyncio.Task | None = None
         self.last_result: dict | None = None
         self.trains_started = 0

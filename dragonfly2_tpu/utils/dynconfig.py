@@ -5,6 +5,15 @@ manager-sourced config; specialized by scheduler/config/dynconfig.go and
 client/config/dynconfig_manager.go). Fetch from the manager, persist a disk
 cache so services boot while the manager is down, refresh on a TTL, and
 notify registered observers on change.
+
+Manager-outage autonomy (ISSUE 17): the disk cache is STALENESS-STAMPED —
+`{"data": ..., "saved_at": unix_time}` — so a consumer serving through a
+manager blackout can say (and export) exactly how old its last-good snapshot
+is, instead of presenting cached config as fresh. `staleness_s()` answers
+the age; `from_cache` says whether the current data ever confirmed against
+the manager this process lifetime. The module-level `store_snapshot` /
+`load_snapshot` helpers share the same stamped format with other last-good
+caches (the daemon's scheduler address book).
 """
 
 from __future__ import annotations
@@ -13,6 +22,7 @@ import asyncio
 import json
 import logging
 import time
+from dataclasses import dataclass
 from pathlib import Path
 from typing import Awaitable, Callable
 
@@ -20,6 +30,54 @@ logger = logging.getLogger(__name__)
 
 Fetcher = Callable[[], Awaitable[dict]]
 Observer = Callable[[dict], None]
+
+
+@dataclass
+class Snapshot:
+    """One staleness-stamped last-good cache entry."""
+
+    data: dict
+    saved_at: float  # unix time the data was last confirmed fresh
+
+    def staleness_s(self, now: float | None = None) -> float:
+        now = now if now is not None else time.time()
+        return max(0.0, now - self.saved_at)
+
+
+def store_snapshot(path: str | Path, data: dict) -> None:
+    """Atomically persist `data` with a freshness stamp (tmp + rename)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_suffix(".tmp")
+    tmp.write_text(json.dumps({"data": data, "saved_at": time.time()}))
+    tmp.replace(path)
+
+
+def load_snapshot(path: str | Path) -> Snapshot | None:
+    """Read a stamped snapshot; a legacy plain-dict cache (pre-stamp format)
+    still loads, aged by its file mtime. None on missing/corrupt."""
+    path = Path(path)
+    if not path.exists():
+        return None
+    try:
+        raw = json.loads(path.read_text())
+    except (json.JSONDecodeError, OSError):
+        return None
+    if not isinstance(raw, dict):
+        return None
+    if "data" in raw and "saved_at" in raw:
+        data = raw["data"]
+        if not isinstance(data, dict):
+            return None
+        try:
+            return Snapshot(data, float(raw["saved_at"]))
+        except (TypeError, ValueError):
+            return None
+    try:
+        mtime = path.stat().st_mtime
+    except OSError:
+        mtime = 0.0
+    return Snapshot(raw, mtime)
 
 
 class Dynconfig:
@@ -36,11 +94,23 @@ class Dynconfig:
         self._data: dict = {}
         self._observers: list[Observer] = []
         self._task: asyncio.Task | None = None
-        self._loaded_at = 0.0
+        self._loaded_at = 0.0  # when _data was last confirmed fresh
+        # True while _data came from the disk cache and has NOT been
+        # confirmed against the manager this process lifetime
+        self.from_cache = False
 
     @property
     def data(self) -> dict:
         return self._data
+
+    def staleness_s(self, now: float | None = None) -> float | None:
+        """Age of the current config: seconds since the last successful
+        manager fetch, or — when serving from the disk cache — since that
+        cache was written. None before any load succeeded at all."""
+        if not self._loaded_at:
+            return None
+        now = now if now is not None else time.time()
+        return max(0.0, now - self._loaded_at)
 
     def register(self, observer: Observer) -> None:
         """Observer fires on every successful refresh that changes the data."""
@@ -58,7 +128,10 @@ class Dynconfig:
         except Exception as e:
             if not self._load_cache():
                 raise
-            logger.warning("dynconfig: using disk cache, fetch failed: %s", e)
+            logger.warning(
+                "dynconfig: using disk cache (age %.0fs), fetch failed: %s",
+                self.staleness_s() or 0.0, e,
+            )
         if not notified:
             self._notify()
         return self._data
@@ -67,6 +140,7 @@ class Dynconfig:
         """Fetch; returns True when the config changed."""
         data = await self._fetch()
         self._loaded_at = time.time()
+        self.from_cache = False
         if data == self._data:
             return False
         self._data = data
@@ -82,21 +156,20 @@ class Dynconfig:
                 logger.exception("dynconfig observer failed")
 
     def _load_cache(self) -> bool:
-        if self.cache_path is None or not self.cache_path.exists():
+        if self.cache_path is None:
             return False
-        try:
-            self._data = json.loads(self.cache_path.read_text())
-            return True
-        except (json.JSONDecodeError, OSError):
+        snap = load_snapshot(self.cache_path)
+        if snap is None:
             return False
+        self._data = snap.data
+        self._loaded_at = snap.saved_at
+        self.from_cache = True
+        return True
 
     def _store_cache(self) -> None:
         if self.cache_path is None:
             return
-        self.cache_path.parent.mkdir(parents=True, exist_ok=True)
-        tmp = self.cache_path.with_suffix(".tmp")
-        tmp.write_text(json.dumps(self._data))
-        tmp.replace(self.cache_path)
+        store_snapshot(self.cache_path, self._data)
 
     def start(self) -> None:
         if self._task is None:

@@ -222,6 +222,33 @@ def test_swarm_sim_contract():
     assert out["swarm_sim_fed_convergence_virtual_s"] > 0
 
 
+def test_overload_contract():
+    # tiny shape: the ISSUE 17 brownout A/B at 600 peers pins the key set
+    # and the acceptance direction — the scenario is scale-invariant in
+    # time (fixed burst window, cost derived from peers), so the reduced
+    # arm exercises the same ladder/storm dynamics as the 10^4 run
+    out = bench.bench_overload(peers=600)
+    for key in (
+        "overload_peers", "overload_factor", "overload_goodput_ratio",
+        "overload_goodput_on_frac", "overload_goodput_off_frac",
+        "overload_admitted_p99_ms_on", "overload_max_level_on",
+        "overload_refused_on", "overload_retry_storm_off",
+    ):
+        assert key in out, key
+    assert out["overload_peers"] == 600
+    assert out["overload_factor"] == 4.0
+    # the headline: shedding ON sustains >= 2x the goodput of OFF at 4x
+    # overload (the ISSUE 17 acceptance bar)
+    assert out["overload_goodput_ratio"] >= 2.0, out
+    assert out["overload_goodput_on_frac"] >= 0.9
+    # the ladder reached admission control and typed refusals went out
+    assert out["overload_max_level_on"] == 4
+    assert out["overload_refused_on"] > 0
+    # the unshedded arm burned a storm of retries — that's what ON avoids
+    assert out["overload_retry_storm_off"] > out["overload_refused_on"] * 0.1
+    assert 0 < out["overload_admitted_p99_ms_on"] <= 150_000.0
+
+
 def test_piece_pipeline_contract():
     # tiny shape: pins the ISSUE 13 key set — TLS fast path (cipher A/B,
     # handshake storm, kTLS null-probe), striped-vs-single A/B over real

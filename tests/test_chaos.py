@@ -671,3 +671,147 @@ class TestStripedFetchChaos:
                     await e2.stop()
 
         run(body())
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 17: retry storms stay bounded, and the cluster rides out a full
+# manager blackout on its last-good snapshot
+
+
+class TestOverloadAutonomy:
+    def test_retry_budget_bounds_storm_amplification(self, run):
+        """Counter-asserted anti-storm proof: a fleet of clients hammering a
+        dead target through ONE shared cluster retry budget makes at most
+        N first attempts + burst budgeted retries of real wire traffic —
+        every call past the budget fails fast with the typed exhaustion
+        error instead of contributing its own retries*backoff to the storm
+        (an unbudgeted fleet would have made N x (retries+1) attempts)."""
+        from dragonfly2_tpu.resilience.budget import RetryBudget
+        from dragonfly2_tpu.rpc.core import BackoffPolicy, RpcClient, RpcError
+
+        async def body():
+            attempts = {"n": 0}
+
+            async def slam_door(reader, writer):
+                attempts["n"] += 1
+                writer.close()
+
+            server = await asyncio.start_server(slam_door, "127.0.0.1", 0)
+            port = server.sockets[0].getsockname()[1]
+            # burst 2, refill effectively zero over the test's lifetime
+            budget = RetryBudget("chaos-storm", rate=0.001, burst=2.0)
+            n_clients = 6
+            clients = [
+                RpcClient(
+                    f"127.0.0.1:{port}",
+                    retries=3,
+                    retry_budget=budget,
+                    backoff=BackoffPolicy(base=0.01, multiplier=1.0,
+                                          max_delay=0.02, jitter=0.0),
+                )
+                for _ in range(n_clients)
+            ]
+            errors = []
+            try:
+                for c in clients:
+                    with pytest.raises(RpcError) as ei:
+                        await c.call("register_peer", {})  # dflint: disable=DF025 one call per DISTINCT client is the storm under test — the budget must bound their combined wire attempts
+                    errors.append(ei.value)
+            finally:
+                for c in clients:
+                    await c.close()
+                server.close()
+                await server.wait_closed()
+
+            st = budget.stats()
+            assert st["spent"] <= 2, st  # budgeted retries never exceed burst
+            assert st["denied"] >= n_clients - 1, st  # the rest failed fast
+            # wire attempts: one free first attempt per call + the burst.
+            # 24 would have hit the wire without the budget (6 x 4 attempts).
+            assert attempts["n"] <= n_clients + 2, (attempts, st)
+            # every caller got the TYPED budget error: fallback-able, not a
+            # mystery timeout
+            assert all(e.code == "unavailable" for e in errors), errors
+            assert all("retry budget exhausted" in str(e) for e in errors), errors
+
+        run(body())
+
+    def test_manager_blackout_download_bit_exact_from_snapshot(
+        self, run, tmp_path, payload
+    ):
+        """Manager-outage autonomy end to end: while the manager answers,
+        the daemon's address-book resolver stamps a last-good snapshot;
+        then the manager goes FULLY dark. Both the running resolver and one
+        booted mid-blackout (fresh resolver, same cache dir — a daemon
+        restart during the outage) still name the REAL wire scheduler from
+        the snapshot, and a P2P download scheduled through that scheduler
+        completes bit-exact while the manager never answers again."""
+        from dragonfly2_tpu.daemon.server import make_address_book_resolver
+        from dragonfly2_tpu.rpc.core import RpcServer
+        from dragonfly2_tpu.rpc.scheduler import (
+            SCHEDULER_METHODS,
+            RemoteSchedulerClient,
+            SchedulerRpcAdapter,
+        )
+
+        class FlakyManager:
+            def __init__(self, rows):
+                self.rows = rows
+                self.dark = False
+                self.lists = 0
+
+            async def list_schedulers(self, ip=None):
+                self.lists += 1
+                if self.dark:
+                    raise ConnectionError("manager blackout")
+                return self.rows
+
+        async def body():
+            svc = SchedulerService()
+            server = RpcServer(port=0)
+            server.register_service(SchedulerRpcAdapter(svc), SCHEDULER_METHODS)
+            await server.start()
+            cache = tmp_path / "autonomy" / "scheduler_address_book.json"
+            mgr = FlakyManager([{"ip": "127.0.0.1", "port": server.port}])
+            client = None
+            try:
+                resolve = make_address_book_resolver(mgr, cache)
+                addrs = await resolve()
+                assert addrs == [f"127.0.0.1:{server.port}"]
+                assert cache.exists(), "last-good snapshot never stamped"
+
+                mgr.dark = True  # blackout starts; manager stays dark below
+                assert await resolve() == addrs  # live resolver rides the snapshot
+
+                # a daemon that (re)boots mid-blackout: new resolver, same
+                # cache dir, manager dark from its very first call
+                born_dark = FlakyManager([])
+                born_dark.dark = True
+                addrs2 = await make_address_book_resolver(born_dark, cache)()
+                assert addrs2 == addrs and born_dark.lists == 1
+
+                client = RemoteSchedulerClient(addrs2[0])
+                async with Origin({"f.bin": payload}) as origin:
+                    e1 = await _seed_parent(tmp_path, client, origin, payload)
+                    e2 = make_engine(tmp_path, client, "blackout-child")
+                    await e2.start()
+                    try:
+                        out = tmp_path / "blackout.bin"
+                        ts = await asyncio.wait_for(
+                            e2.download_task(origin.url("f.bin"), output=out), 60
+                        )
+                        assert ts.is_complete() and ts.meta.done
+                        assert out.read_bytes() == payload  # bit-exact, mid-blackout
+                        # the rounds really rode the snapshot-named scheduler
+                        st = svc.stat_task(ts.meta.task_id)
+                        assert st["state"] == "succeeded"
+                    finally:
+                        await e1.stop()
+                        await e2.stop()
+                assert mgr.dark and born_dark.dark  # nobody quietly revived it
+            finally:
+                if client is not None:
+                    await client.close()
+                await server.stop()
+
+        run(body())

@@ -1,0 +1,348 @@
+"""Brownout ladder + cluster retry budget unit tests (ISSUE 17).
+
+The DegradationController is driven here entirely through injected probes
+and explicit `now=` timestamps — no sleeps, no loop — pinning the hysteresis
+contract the smoke leg and the overload-flash chaos pack rely on: one rung
+per sustained window, spikes rejected, recovery slower than engagement,
+class-by-class shed escalation within rung 4, and the typed `overloaded`
+answer from a real SchedulerService. RetryBudget gets the same treatment on
+a fake clock.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from dragonfly2_tpu.resilience.budget import (
+    RetryBudget,
+    budget_for,
+    budget_stats,
+    reset_budgets,
+)
+from dragonfly2_tpu.scheduler import metrics as sched_metrics
+from dragonfly2_tpu.scheduler.degradation import (
+    LEVEL_NAMES,
+    MAX_LEVEL,
+    DegradationController,
+)
+from dragonfly2_tpu.scheduler.service import HostInfo, SchedulerService, TaskMeta
+
+
+class Probe:
+    """Settable zero-arg pressure probe."""
+
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self):
+        return self.value
+
+
+def make_ctrl(**kw):
+    """Controller on a queue-depth probe with budget 10 (value==pressure*10)."""
+    probe = Probe(0.0)
+    kw.setdefault("queue_budget", 10.0)
+    kw.setdefault("sustain_s", 3.0)
+    kw.setdefault("cool_s", 10.0)
+    return DegradationController(queue_depth=probe, **kw), probe
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def monotonic(self) -> float:
+        return self.t
+
+    def time(self) -> float:
+        return self.t
+
+
+class TestLadderHysteresis:
+    def test_climbs_one_rung_per_sustained_window(self):
+        ctrl, probe = make_ctrl()
+        probe.value = 100.0  # pressure 10x
+        assert ctrl.evaluate_once(now=0.0) == 0  # window opens, no step yet
+        assert ctrl.evaluate_once(now=2.9) == 0  # not sustained long enough
+        levels = []
+        for t in (3.0, 6.0, 9.0, 12.0):
+            levels.append(ctrl.evaluate_once(now=t))
+        # the window restarts after every step: rung by rung, never a jump
+        assert levels == [1, 2, 3, 4]
+        assert ctrl.stats()["mode"] == LEVEL_NAMES[MAX_LEVEL] == "admission"
+        assert ctrl.transitions_up == 4
+
+    def test_flag_progression_matches_levels(self):
+        ctrl, probe = make_ctrl(sustain_s=1.0)
+        probe.value = 100.0
+        seen = []
+        t = 0.0
+        while ctrl.level < MAX_LEVEL:
+            ctrl.evaluate_once(now=t)
+            t += 1.0
+            seen.append((ctrl.level, ctrl.shed_shadow, ctrl.shed_obs,
+                         ctrl.base_only, ctrl.admission_control))
+        by_level = {lvl: flags for lvl, *flags in seen}
+        assert by_level[1] == [True, False, False, False]
+        assert by_level[2] == [True, True, False, False]
+        assert by_level[3] == [True, True, True, False]
+        assert by_level[4] == [True, True, True, True]
+
+    def test_short_spike_never_sheds(self):
+        ctrl, probe = make_ctrl()
+        probe.value = 100.0
+        ctrl.evaluate_once(now=0.0)
+        ctrl.evaluate_once(now=2.0)  # spike shorter than sustain_s=3
+        probe.value = 0.0
+        ctrl.evaluate_once(now=2.5)
+        assert ctrl.level == 0
+        # the window restarted: the NEXT burst needs its own full sustain
+        probe.value = 100.0
+        ctrl.evaluate_once(now=10.0)
+        ctrl.evaluate_once(now=12.5)
+        assert ctrl.level == 0
+        ctrl.evaluate_once(now=13.0)
+        assert ctrl.level == 1
+
+    def test_between_thresholds_resets_both_windows(self):
+        """Pressure stuck between exit (0.5) and enter (1.0) moves nothing —
+        neither trend is sustained, so the ladder holds its rung forever."""
+        ctrl, probe = make_ctrl(sustain_s=1.0)
+        probe.value = 100.0
+        ctrl.evaluate_once(now=0.0)
+        ctrl.evaluate_once(now=1.0)
+        assert ctrl.level == 1
+        probe.value = 7.0  # pressure 0.7: in the dead band
+        for t in range(2, 60):
+            ctrl.evaluate_once(now=float(t))
+        assert ctrl.level == 1  # no recovery, no further shedding
+        assert ctrl.transitions_up == 1 and ctrl.transitions_down == 0
+
+    def test_recovery_is_slower_and_rung_by_rung(self):
+        ctrl, probe = make_ctrl(sustain_s=1.0, cool_s=10.0)
+        probe.value = 100.0
+        t = 0.0
+        while ctrl.level < MAX_LEVEL:
+            ctrl.evaluate_once(now=t)
+            t += 1.0
+        probe.value = 0.0
+        ctrl.evaluate_once(now=t)  # opens the cool window
+        assert ctrl.evaluate_once(now=t + 9.9) == MAX_LEVEL  # not cooled yet
+        down = []
+        for dt in (10.0, 20.0, 30.0, 40.0):
+            down.append(ctrl.evaluate_once(now=t + dt))
+        assert down == [3, 2, 1, 0]
+        assert not ctrl.shed_shadow and not ctrl.admission_control
+        # a re-spike mid-cooldown restarts the cool window
+        probe.value = 100.0
+        ctrl.evaluate_once(now=t + 41.0)
+        probe.value = 0.0
+        ctrl.evaluate_once(now=t + 42.0)
+        ctrl.evaluate_once(now=t + 51.0)  # only 9s quiet since the respike
+        assert ctrl.transitions_down == 4
+
+    def test_dead_probe_reads_as_quiet_not_crash(self):
+        def dying():
+            raise RuntimeError("probe backend gone")
+
+        ctrl = DegradationController(queue_depth=dying, sustain_s=1.0)
+        assert ctrl.pressure() == 0.0
+        ctrl.evaluate_once(now=0.0)
+        ctrl.evaluate_once(now=5.0)
+        assert ctrl.level == 0
+
+    def test_pressure_is_max_over_probes(self):
+        lag, util, queue = Probe(125.0), Probe(0.475), Probe(32.0)
+        ctrl = DegradationController(
+            lag_p95_ms=lag, utilization=util, queue_depth=queue,
+            lag_budget_ms=250.0, utilization_budget=0.95, queue_budget=64.0,
+        )
+        assert ctrl.pressure() == pytest.approx(0.5)
+        queue.value = 128.0  # worst signal wins
+        assert ctrl.pressure() == pytest.approx(2.0)
+        util.value = None  # signal absent: ignored, not zeroed
+        assert ctrl.pressure() == pytest.approx(2.0)
+
+    def test_gauge_follows_ladder(self):
+        ctrl, probe = make_ctrl(sustain_s=1.0, cool_s=1.0)
+        assert sched_metrics.DEGRADATION_LEVEL.value == 0.0
+        probe.value = 100.0
+        for t in range(5):
+            ctrl.evaluate_once(now=float(t))
+        assert sched_metrics.DEGRADATION_LEVEL.value == float(MAX_LEVEL)
+        probe.value = 0.0
+        for t in range(5, 12):
+            ctrl.evaluate_once(now=float(t))
+        assert ctrl.level == 0
+        assert sched_metrics.DEGRADATION_LEVEL.value == 0.0
+
+
+class TestAdmissionControl:
+    def _at_rung4(self, **kw):
+        ctrl, probe = make_ctrl(sustain_s=0.0, cool_s=1e9, **kw)
+        probe.value = 100.0
+        t = 0.0
+        while ctrl.level < MAX_LEVEL:
+            ctrl.evaluate_once(now=t)
+            t += 1.0
+        return ctrl, probe, t
+
+    def test_below_rung4_everything_admitted(self):
+        ctrl, _ = make_ctrl()
+        for prio in (0.5, 1.0, 9.0):
+            assert ctrl.admit(prio) == (True, 0.0)
+        assert ctrl.sheds == 0
+
+    def test_rung4_sheds_lowest_class_first(self):
+        ctrl, _, _ = self._at_rung4()
+        # classes learned from traffic (any admit() call notes them)
+        for prio in (1.0, 5.0, 10.0):
+            ctrl.admit(prio)
+        ok_low, retry_low = ctrl.admit(1.0)
+        ok_mid, _ = ctrl.admit(5.0)
+        ok_high, _ = ctrl.admit(10.0)
+        assert (ok_low, ok_mid, ok_high) == (False, True, True)
+        assert retry_low > 0
+        assert ctrl.stats()["shed_rank"] == 1
+
+    def test_sustained_pressure_escalates_shed_rank_class_by_class(self):
+        ctrl, _, t = self._at_rung4()
+        for prio in (1.0, 5.0, 10.0):
+            ctrl.admit(prio)
+        ctrl.evaluate_once(now=t)  # rung 4 + still hot: rank 1 -> 2
+        assert ctrl.stats()["shed_rank"] == 2
+        assert ctrl.admit(5.0)[0] is False
+        assert ctrl.admit(10.0)[0] is True
+        ctrl.evaluate_once(now=t + 1.0)  # rank 3: even the top class sheds
+        assert ctrl.admit(10.0)[0] is False
+        # capped at the number of observed classes
+        ctrl.evaluate_once(now=t + 2.0)
+        assert ctrl.stats()["shed_rank"] == 3
+
+    def test_cooldown_deescalates_rank_before_level(self):
+        ctrl, probe = make_ctrl(sustain_s=0.0, cool_s=1.0)
+        for prio in (1.0, 5.0, 10.0):  # classes known before the storm
+            ctrl.admit(prio)
+        probe.value = 100.0
+        t = 0.0
+        for _ in range(7):  # window-open tick + 4 rungs + 2 rank escalations
+            ctrl.evaluate_once(now=t)
+            t += 1.0
+        assert ctrl.stats()["shed_rank"] == 3
+        probe.value = 0.0
+        ctrl.evaluate_once(now=t)
+        ctrl.evaluate_once(now=t + 1.0)
+        assert ctrl.level == MAX_LEVEL and ctrl.stats()["shed_rank"] == 2
+        ctrl.evaluate_once(now=t + 2.0)
+        assert ctrl.level == MAX_LEVEL and ctrl.stats()["shed_rank"] == 1
+        ctrl.evaluate_once(now=t + 3.0)
+        assert ctrl.level == 3  # only then does the LEVEL step down
+
+    def test_retry_after_scales_with_pressure_capped_at_4x(self):
+        ctrl, probe, _ = self._at_rung4(retry_after_s=5.0)
+        ctrl.admit(1.0)
+        probe.value = 25.0  # pressure 2.5
+        ctrl.evaluate_once(now=1e6)
+        assert ctrl.admit(1.0) == (False, pytest.approx(12.5))
+        probe.value = 1000.0  # pressure 100: hint capped, not unbounded
+        ctrl.evaluate_once(now=1e6 + 1)
+        assert ctrl.admit(1.0) == (False, pytest.approx(20.0))
+
+    def test_service_answers_typed_overloaded(self, run):
+        """register_peer through a real SchedulerService at rung 4: the shed
+        class gets error='overloaded' + retry_after_s (and the shed counter
+        moves); the higher class is admitted in the same breath."""
+
+        async def body():
+            ctrl, _, _ = self._at_rung4()
+            svc = SchedulerService()
+            svc.attach_degradation(ctrl)
+            shed0 = sched_metrics.ADMISSION_SHED_TOTAL.value
+
+            def host(i):
+                return HostInfo(id=f"d{i}", ip=f"10.9.0.{i}",
+                                hostname=f"deg{i}", download_port=7000 + i)
+
+            # both classes seen once so the cutoff has data
+            ctrl.admit(1.0)
+            ctrl.admit(5.0)
+            low = await svc.register_peer(
+                "p-low", TaskMeta("t-x", "http://o/f", priority=1.0), host(1))
+            high = await svc.register_peer(
+                "p-high", TaskMeta("t-x", "http://o/f", priority=5.0), host(2))
+            assert low.error == "overloaded" and low.retry_after_s > 0, low
+            assert not high.error, high
+            assert sched_metrics.ADMISSION_SHED_TOTAL.value - shed0 == 1
+
+        run(body())
+
+    def test_start_stop_idempotent_on_loop(self, run):
+        async def body():
+            ctrl, _ = make_ctrl()
+            assert not ctrl.running
+            ctrl.start()
+            ctrl.start()  # idempotent
+            assert ctrl.running
+            await asyncio.sleep(0)
+            ctrl.stop()
+            ctrl.stop()
+            assert not ctrl.running
+
+        run(body())
+
+
+class TestRetryBudgetUnit:
+    def test_burst_then_fail_fast_then_refill(self):
+        clk = FakeClock()
+        b = RetryBudget("unit", rate=2.0, burst=4.0, clock=clk)
+        assert all(b.spend() for _ in range(4))
+        assert not b.spend()  # beyond burst: deny immediately, never block
+        clk.t += 1.0  # 2 tokens back
+        assert b.spend() and b.spend() and not b.spend()
+        st = b.stats()
+        assert st["spent"] == 6 and st["denied"] == 2, st
+
+    def test_refill_never_exceeds_burst(self):
+        clk = FakeClock()
+        b = RetryBudget("unit", rate=100.0, burst=3.0, clock=clk)
+        clk.t += 3600.0
+        assert [b.spend() for _ in range(4)] == [True, True, True, False]
+
+    def test_charge_horizon_only_extends(self):
+        clk = FakeClock()
+        b = RetryBudget("unit", rate=1.0, burst=5.0, clock=clk)
+        b.charge(10.0)
+        b.charge(2.0)  # shorter hint must not shrink the standing window
+        assert b.retry_after_remaining() == pytest.approx(10.0)
+        assert not b.spend()
+        clk.t += 10.5
+        assert b.spend()
+        assert b.stats()["charges"] == 2
+
+    def test_zero_or_negative_hint_ignored(self):
+        b = RetryBudget("unit", rate=1.0, burst=1.0, clock=FakeClock())
+        b.charge(0.0)
+        b.charge(-3.0)
+        assert b.retry_after_remaining() == 0.0 and b.stats()["charges"] == 0
+
+    def test_bad_config_rejected(self):
+        with pytest.raises(ValueError):
+            RetryBudget("bad", rate=0.0)
+        with pytest.raises(ValueError):
+            RetryBudget("bad", burst=-1.0)
+
+    def test_registry_shares_one_bucket_per_class(self):
+        reset_budgets()
+        try:
+            a = budget_for("unit-x", rate=1.0, burst=2.0)
+            assert budget_for("unit-x") is a  # creation kwargs apply once
+            assert a.rate == 1.0 and a.burst == 2.0
+            assert budget_for("unit-y") is not a
+            names = {s["name"] for s in budget_stats()}
+            assert names == {"unit-x", "unit-y"}
+        finally:
+            reset_budgets()
+        assert budget_for("unit-x") is not a  # reset really dropped it
+        reset_budgets()

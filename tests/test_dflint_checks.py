@@ -267,7 +267,9 @@ def test_df021_silent_inside_functions():
         async with lock:
             pass
     """
-    assert ids(src) == []
+    # (the unbounded Queue still draws DF034 — DF021's scope check is what
+    # this fixture pins: function-local primitives bind the right loop)
+    assert "DF021" not in ids(src)
 
 
 # ---------------------------------------------------------------------------
@@ -307,7 +309,7 @@ def test_df021_catches_from_import_alias():
         L = Lock()
     """
     vs = dflint.lint_source(textwrap.dedent(src), "m.py")
-    assert [v.check for v in vs] == ["DF021", "DF021"]
+    assert [v.check for v in vs if v.check == "DF021"] == ["DF021", "DF021"]
 
 
 def test_df022_silent_in_sync_def_and_asyncio_sleep():
@@ -884,6 +886,74 @@ def test_df033_suppression_with_reason():
     def f(rows):
         for row in rows:
             x = np.asarray(row)  # dflint: disable=DF033 rowloop reference
+    """
+    assert ids(src) == []
+
+
+# ---------------------------------------------------------------------------
+# DF034 unbounded queue in service code
+
+
+def test_df034_fires_on_unbounded_queue_and_deque():
+    src = """
+    import asyncio
+    import collections
+
+    class S:
+        def start(self):
+            self.q = asyncio.Queue()
+            self.pq = asyncio.PriorityQueue()
+            self.buf = collections.deque()
+    """
+    assert ids(src) == ["DF034"]
+    assert lines(src) == [7, 8, 9]
+
+
+def test_df034_fires_on_explicitly_unbounded_spellings():
+    # maxsize=0 / maxlen=None are the unbounded DEFAULTS written out — still
+    # a buffer that grows without limit, still needs the suppression + reason
+    src = """
+    import asyncio
+    from collections import deque
+
+    def f():
+        q = asyncio.Queue(maxsize=0)
+        d = deque(maxlen=None)
+    """
+    assert lines(src) == [6, 7]
+
+
+def test_df034_silent_on_bounded():
+    src = """
+    import asyncio
+    import collections
+
+    def f(items, cap):
+        q = asyncio.Queue(maxsize=cap)
+        q2 = asyncio.Queue(64)
+        d = collections.deque(maxlen=256)
+        d2 = collections.deque(items, 32)
+    """
+    assert ids(src) == []
+
+
+def test_df034_silent_in_tests():
+    src = """
+    import asyncio
+
+    def f():
+        q = asyncio.Queue()
+    """
+    assert ids(src, "tests/test_mod.py") == []
+    assert ids(src, "dragonfly2_tpu/daemon/test_helper.py") == []
+
+
+def test_df034_suppression_with_reason():
+    src = """
+    import collections
+
+    def f():
+        d = collections.deque()  # dflint: disable=DF034 drained same-loop
     """
     assert ids(src) == []
 

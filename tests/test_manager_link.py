@@ -261,3 +261,121 @@ def test_cache_task_with_no_holders_refused_cleanly(run, tmp_path):
             await downloader.stop()
 
     run(body())
+
+
+class _OutageManager:
+    """Manager stub for the link's outage state machine: flip `dark` to make
+    every RPC raise; counters record the rejoin catch-up traffic."""
+
+    def __init__(self):
+        self.dark = False
+        self.registrations = 0
+        self.config_pulls = 0
+
+    def _gate(self):
+        if self.dark:
+            raise ConnectionError("manager dark")
+
+    async def keepalive(self, kind, hostname, cluster_id, stats=None):
+        self._gate()
+
+    async def update_scheduler(self, hostname, ip, port, idc="", location=""):
+        self._gate()
+        self.registrations += 1
+        return {"id": 7, "scheduler_cluster_id": 1}
+
+    async def cluster_config(self, cluster_id):
+        self._gate()
+        self.config_pulls += 1
+        return {"seed_peers": [], "schedulers": []}
+
+    async def rollout_status(self, name, scheduler_id):
+        self._gate()
+        return {"active": None}
+
+
+def _outage_link(svc, mgr, *, hostname="sch-a"):
+    link = ManagerLink(svc, "127.0.0.1:1", hostname=hostname, ip="127.0.0.1", port=1)
+    link.manager = mgr
+    link.cluster_id = 1
+    link._rejoin_delay = lambda: 0.0  # jitter pinned separately, below
+    return link
+
+
+def test_keepalive_outage_declared_after_two_failures_then_rejoin(run):
+    """One missed keepalive is a blip; the second declares the blackout
+    (gauge up). The success that ends it re-registers + refreshes dynconfig
+    exactly once — the rejoin catch-up — and clears the gauge."""
+
+    async def body():
+        from dragonfly2_tpu.scheduler import metrics
+
+        svc = SchedulerService()
+        mgr = _OutageManager()
+        link = _outage_link(svc, mgr)
+
+        assert await link.keepalive_once()
+        assert not link.manager_unreachable
+
+        mgr.dark = True
+        assert not await link.keepalive_once()
+        assert not link.manager_unreachable  # first miss: not yet declared
+        assert not await link.keepalive_once()
+        assert link.manager_unreachable
+        assert metrics.MANAGER_UNREACHABLE.value == 1.0
+
+        mgr.dark = False
+        regs_before = mgr.registrations
+        assert await link.keepalive_once()
+        assert not link.manager_unreachable
+        assert metrics.MANAGER_UNREACHABLE.value == 0.0
+        assert mgr.registrations == regs_before + 1  # rejoin re-registered
+        assert mgr.config_pulls >= 1                 # and refreshed dynconfig
+        # a healthy beat after recovery does NOT re-run the catch-up
+        assert await link.keepalive_once()
+        assert mgr.registrations == regs_before + 1
+
+    run(body())
+
+
+def test_rejoin_delay_is_deterministic_per_host_and_spread():
+    """The rejoin jitter is a pure function of hostname, bounded by one
+    keepalive interval — the same scheduler always rejoins at the same
+    offset (restart-stable) while a fleet spreads across the interval."""
+    svc = SchedulerService()
+    mgr = _OutageManager()
+    delays = []
+    for name in ("sch-%02d" % i for i in range(16)):
+        link = ManagerLink(svc, "127.0.0.1:1", hostname=name, ip="127.0.0.1", port=1)
+        link.manager = mgr
+        d = link._rejoin_delay()
+        assert 0.0 <= d < link.keepalive_interval
+        assert d == link._rejoin_delay()  # deterministic
+        delays.append(d)
+    assert len({round(d, 6) for d in delays}) >= 12  # spread, not a stampede
+
+
+def test_rollout_watch_freezes_during_registry_outage(run):
+    """A registry error on the rollout tick declares the blackout and
+    propagates (so the watch loop backs off); nothing about the serving
+    model is decided. The first healthy tick clears the state."""
+
+    async def body():
+        svc = SchedulerService()
+        mgr = _OutageManager()
+        link = _outage_link(svc, mgr)
+        link.scheduler_id = 7
+        scorer_before = svc.evaluator.scorer if hasattr(svc.evaluator, "scorer") else None
+
+        mgr.dark = True
+        with pytest.raises(ConnectionError):
+            await link._check_model()
+        assert link.manager_unreachable
+        if scorer_before is not None:
+            assert svc.evaluator.scorer is scorer_before  # frozen, no swap
+
+        mgr.dark = False
+        await link._check_model()
+        assert not link.manager_unreachable
+
+    run(body())

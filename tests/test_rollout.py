@@ -931,3 +931,115 @@ def test_dfmodel_status_promote_rollback(run, tmp_path, capsys):
             await manager.stop()
 
     run(body())
+
+
+def test_rollback_reinstalls_previous_models_drift_sketch(run, tmp_path, monkeypatch):
+    """ISSUE 17 satellite (closing the ISSUE 15 residual): the training-
+    reference sketch rides each model's serving bundle, so the auto-rollback
+    restores the previous model WITH its own drift baseline. Before this,
+    rollback CLEARED the reference (the warm bundle has no artifact path to
+    re-load from) and the restored model served baseline-less until the next
+    registry-driven install."""
+    from dragonfly2_tpu.observability.sketches import FeatureSketch
+
+    def sketched_artifact(version: str, fill: float):
+        path, _ = make_artifact(tmp_path, version)
+        sk = FeatureSketch(2, names=("na", "nb"))
+        sk.update(np.full((8, 2), fill))
+        artifacts.save_sketch(path, sk)
+        # digest AFTER the sketch lands: it is covered like every other file
+        return path, artifacts.artifact_digest(path), sk
+
+    async def body():
+        gates = R.HealthGates(
+            window_s=30.0, min_rounds=6,
+            max_error_rate_increase=0.2, max_fallback_rate_increase=0.2,
+        )
+        async with _LinkHarness(tmp_path, monkeypatch, health_gates=gates) as h:
+            await h.mc.set_config("model_rollout", {
+                "enabled": True, "types": ["gnn"], "auto_promote": True,
+                "gates": {"min_rounds": 4, "min_topk_overlap": 0.0,
+                          "min_rank_corr": -1.0, "max_mean_abs_delta": 100.0,
+                          "max_error_rate": 1.0},
+            })
+            drift = h.svc.drift
+            p1, d1, sk1 = sketched_artifact("v1", 0.25)
+            await h.publish("v1", scorer=VersionScorer(0.5),
+                            path=p1, digest=d1)
+            await h.tick()
+            await h.drive_rounds(6)
+            await h.tick()  # v1 promoted + swapped; v1's sketch installed
+            assert h.svc.evaluator.serving_version == "v1"
+            assert drift.reference_version == "v1"
+            assert np.array_equal(drift.reference.counts, sk1.counts)
+            await h.drive_rounds(10)
+
+            v2 = VersionScorer(0.9)
+            p2, d2, sk2 = sketched_artifact("v2", 0.75)
+            await h.publish("v2", scorer=v2, path=p2, digest=d2)
+            await h.tick()
+            await h.drive_rounds(6)
+            await h.tick()  # v2 promoted: ITS sketch replaces v1's
+            assert h.svc.evaluator.serving_version == "v2"
+            assert drift.reference_version == "v2"
+            assert np.array_equal(drift.reference.counts, sk2.counts)
+
+            v2.boom = True
+            await h.drive_rounds(8)
+            await h.tick()  # health verdict -> rollback to warm v1
+            assert h.svc.evaluator.serving_version == "v1"
+            # the pin: v1 serves against v1's OWN training distribution
+            assert drift.reference_version == "v1"
+            assert drift.reference is not None
+            assert np.array_equal(drift.reference.counts, sk1.counts)
+
+    run(body())
+
+
+def test_rollback_of_presketch_model_restores_cleared_reference(
+    run, tmp_path, monkeypatch
+):
+    """A pre-sketch v1 (no sketch.json) rolls back from a sketched v2: the
+    restored baseline is CLEARED — exactly v1's original install state —
+    never v2's distribution left standing."""
+    from dragonfly2_tpu.observability.sketches import FeatureSketch
+
+    async def body():
+        gates = R.HealthGates(
+            window_s=30.0, min_rounds=6,
+            max_error_rate_increase=0.2, max_fallback_rate_increase=0.2,
+        )
+        async with _LinkHarness(tmp_path, monkeypatch, health_gates=gates) as h:
+            await h.mc.set_config("model_rollout", {
+                "enabled": True, "types": ["gnn"], "auto_promote": True,
+                "gates": {"min_rounds": 4, "min_topk_overlap": 0.0,
+                          "min_rank_corr": -1.0, "max_mean_abs_delta": 100.0,
+                          "max_error_rate": 1.0},
+            })
+            drift = h.svc.drift
+            await h.publish("v1", scorer=VersionScorer(0.5))  # no sketch
+            await h.tick()
+            await h.drive_rounds(6)
+            await h.tick()
+            assert h.svc.evaluator.serving_version == "v1"
+            assert drift.reference is None and drift.reference_version == ""
+
+            v2 = VersionScorer(0.9)
+            p2, _ = make_artifact(tmp_path, "v2")
+            sk2 = FeatureSketch(2, names=("na", "nb"))
+            sk2.update(np.full((4, 2), 0.5))
+            artifacts.save_sketch(p2, sk2)
+            await h.publish("v2", scorer=v2, path=p2,
+                            digest=artifacts.artifact_digest(p2))
+            await h.tick()
+            await h.drive_rounds(6)
+            await h.tick()
+            assert drift.reference_version == "v2"
+
+            v2.boom = True
+            await h.drive_rounds(8)
+            await h.tick()
+            assert h.svc.evaluator.serving_version == "v1"
+            assert drift.reference is None and drift.reference_version == ""
+
+    run(body())

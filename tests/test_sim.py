@@ -18,9 +18,14 @@ from dragonfly2_tpu.scheduler.resource import GCPolicy, HostType
 from dragonfly2_tpu.scheduler.service import HostInfo, SchedulerService, TaskMeta
 from dragonfly2_tpu.sim.clockloop import run_virtual
 from dragonfly2_tpu.sim.scenarios import (
+    SCENARIOS,
     cross_region_cold_start,
     flash_crowd,
+    gray_parents,
+    manager_blackout,
+    overload_flash,
     partition_and_heal,
+    thundering_rejoin,
 )
 from dragonfly2_tpu.utils.clock import SYSTEM, VirtualClock
 
@@ -236,6 +241,94 @@ class TestScenarios:
             assert ds["nodes"] > 50_000 and ds["edges"] > 0 and ds["pairs"] > 0
         finally:
             sc.sim.close()
+
+
+# ---------------------------------------------------------------------------
+# chaos packs (ISSUE 17): overload, manager blackout, gray parents, rejoin
+# herd. The packs are scale-invariant in time (overload) or agent-count
+# invariant (keepalive plane), so these reduced-scale runs exercise the same
+# dynamics as the 10^4-peer acceptance shapes in check.sh/bench.
+
+
+class TestChaosScenarios:
+    def test_registry_names_every_chaos_pack(self):
+        for name in ("overload-flash", "manager-blackout",
+                     "gray-parents", "thundering-rejoin"):
+            assert name in SCENARIOS, name
+
+    def test_overload_flash_ladder_engages_and_recovers(self):
+        sc = overload_flash(peers=800)
+        try:
+            rep = sc.sim.run()
+            sc.check(rep)  # ladder 0->4->0, alert fired+resolved, goodput
+        finally:
+            sc.sim.close()
+        assert rep.degradation["max_level"] == 4
+        assert rep.degradation["final_level"] == 0
+        assert rep.overload_refused > 0
+        # lowest traffic-shaper class shed first, never the inverse
+        assert rep.shed_by_class.get("1", 0) >= rep.shed_by_class.get("5", 0)
+        assert rep.completed >= 0.9 * 800
+
+    def test_overload_flash_unshedded_arm_storms(self):
+        """The OFF arm is the disease the ladder cures: same offered load,
+        no admission control — client deadlines expire in the backlog and
+        the retries amplify the overload into a collapse."""
+        sc = overload_flash(peers=400, shedding=False)
+        try:
+            rep = sc.sim.run()
+            sc.check(rep)  # no-op for the OFF arm (bench A/B baseline)
+        finally:
+            sc.sim.close()
+        assert rep.register_timeouts > 0
+        assert rep.overload_retries > 400  # more retries than peers: a storm
+        assert rep.completed <= 0.6 * 400, rep.completed
+        assert not rep.degradation  # no controller attached
+
+    def test_overload_flash_deterministic_by_seed(self):
+        def one():
+            sc = overload_flash(peers=400, seed=3)
+            try:
+                rep = sc.sim.run()
+            finally:
+                sc.sim.close()
+            return (rep.events, rep.completed, rep.overload_refused,
+                    rep.admitted_p99_ms, rep.shed_by_class,
+                    rep.degradation["max_level"],
+                    sum(s["transitions_up"]
+                        for s in rep.degradation["per_scheduler"].values()))
+
+        assert one() == one()
+
+    def test_manager_blackout_swarm_invariants(self):
+        sc = manager_blackout(peers=200, agents=10)
+        try:
+            rep = sc.sim.run()
+            sc.check(rep)  # all declared/recovered/rejoined, jitter bound
+        finally:
+            sc.sim.close()
+        assert rep.manager["unreachable_declared"] == 10
+        assert rep.manager["rejoined"] == 10
+        assert rep.completed >= 0.97 * 200 and rep.failed == 0
+
+    def test_gray_parents_drain_without_origin_stampede(self):
+        sc = gray_parents(peers=600)
+        try:
+            rep = sc.sim.run()
+            sc.check(rep)  # gray population, completion, bounded egress
+        finally:
+            sc.sim.close()
+        assert rep.gray_peers > 0
+        assert rep.completed >= 0.95 * 600
+
+    def test_thundering_rejoin_jitter_spreads_the_wave(self):
+        sc = thundering_rejoin(peers=800)
+        try:
+            rep = sc.sim.run()
+            sc.check(rep)  # worst bucket <= 1.75x a synchronized poll tick
+        finally:
+            sc.sim.close()
+        assert rep.manager["rejoined"] == 800
 
 
 # ---------------------------------------------------------------------------

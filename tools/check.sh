@@ -152,6 +152,14 @@ print('sim smoke ok:', {'peers': out['peers'], 'events_per_sec': out['events_per
 # SLO alert through recorder → rule engine → stats frame → manager → dftop.
 run_stage "metrics-smoke" env JAX_PLATFORMS=cpu python tools/metrics_smoke.py
 
+# degradation-smoke: graceful degradation under overload (ISSUE 17) — the
+# brownout ladder climbs 0->4->0 on the wall clock with the stock
+# scheduler_degraded alert firing and resolving, register_peer answers
+# typed overloaded + retry_after for the shed class, the cluster retry
+# budget fails fast / absorbs server hints, and the overload-flash +
+# manager-blackout chaos packs re-prove their invariants at reduced scale.
+run_stage "degradation-smoke" env JAX_PLATFORMS=cpu python tools/degradation_smoke.py
+
 # rollout-smoke: the live-model safe-rollout loop against real seams —
 # publish a digest-verified candidate into the manager registry, shadow N
 # live scheduling rounds on an ml scheduler (divergence window reported +

@@ -1,0 +1,184 @@
+"""check.sh degradation-smoke leg (ISSUE 17): graceful degradation proven
+against the REAL objects, four legs:
+
+  1. Brownout ladder on the wall clock: a DegradationController with fast
+     sustain/cool cadences climbs rung by rung under a pressured queue-depth
+     probe, the `dragonfly_scheduler_degradation_level` gauge travels
+     through a real MetricsRecorder, and the STOCK `scheduler_degraded`
+     alert rule fires while browned out and resolves after recovery —
+     the production paging path end to end, in one process.
+  2. Typed refusals: a real SchedulerService with the ladder attached at
+     rung 4 answers register_peer with error="overloaded" + retry_after_s
+     for the lowest traffic-shaper priority class while admitting the
+     higher class — the admission contract daemons retry against.
+  3. Cluster retry budget: token-bucket exhaustion fails fast (spend ->
+     False, callers fall through to source instead of amplifying), a
+     server's retry_after hint pre-charges the budget for the WHOLE
+     process, and the bucket refills once the hint expires.
+  4. Chaos packs at reduced scale: the overload-flash and manager-blackout
+     scenarios (scale-invariant time dynamics) run their full invariant
+     checks — ladder 0->4->0, goodput, jitter-spread rejoin.
+
+Run directly or via tools/check.sh:
+
+    JAX_PLATFORMS=cpu python tools/degradation_smoke.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+
+class _FakeClock:
+    """Settable clock for the budget leg (no real sleeps)."""
+
+    def __init__(self):
+        self.now = 100.0
+
+    def monotonic(self) -> float:
+        return self.now
+
+    def time(self) -> float:
+        return self.now
+
+
+def leg_ladder_and_alert() -> None:
+    from dragonfly2_tpu.observability.alerts import AlertEngine
+    from dragonfly2_tpu.observability.timeseries import MetricsRecorder
+    from dragonfly2_tpu.scheduler.degradation import DegradationController
+
+    pressure = {"depth": 0.0}
+    ctrl = DegradationController(
+        queue_depth=lambda: pressure["depth"],
+        queue_budget=8.0,
+        sustain_s=0.1, cool_s=0.2, interval=0.03,
+    )
+    recorder = MetricsRecorder(interval=0.05)
+    engine = AlertEngine(recorder, export=False)
+
+    def degraded_active() -> bool:
+        recorder.sample_once()
+        engine.evaluate_once()
+        return "scheduler_degraded" in {a["name"] for a in engine.active()}
+
+    assert not degraded_active(), "alert active before any pressure"
+
+    pressure["depth"] = 100.0  # 12.5x the budget
+    deadline = time.monotonic() + 10.0
+    while ctrl.level < 4 and time.monotonic() < deadline:
+        ctrl.evaluate_once()
+        time.sleep(0.03)
+    assert ctrl.level == 4, f"ladder stuck at {ctrl.level} under pressure"
+    assert degraded_active(), "scheduler_degraded did not fire at rung 4"
+
+    pressure["depth"] = 0.0
+    deadline = time.monotonic() + 15.0
+    while ctrl.level > 0 and time.monotonic() < deadline:
+        ctrl.evaluate_once()
+        time.sleep(0.03)
+    assert ctrl.level == 0, f"ladder never recovered (level {ctrl.level})"
+    assert not degraded_active(), "scheduler_degraded still firing after recovery"
+    st = ctrl.stats()
+    assert st["transitions_up"] >= 4 and st["transitions_down"] >= 4, st
+    print(f"degradation smoke: ladder 0->4->0 ok "
+          f"(up {st['transitions_up']}, down {st['transitions_down']}, "
+          f"alert fired and resolved)")
+
+
+def leg_typed_refusal() -> None:
+    from dragonfly2_tpu.scheduler.degradation import DegradationController
+    from dragonfly2_tpu.scheduler.service import (
+        HostInfo, SchedulerService, TaskMeta,
+    )
+
+    async def body() -> None:
+        ctrl = DegradationController(
+            queue_depth=lambda: 100.0, queue_budget=8.0,
+            sustain_s=0.0, cool_s=1e9,
+        )
+        svc = SchedulerService()
+        svc.attach_degradation(ctrl)
+
+        def host(i: int) -> HostInfo:
+            return HostInfo(id=f"h{i}", ip=f"10.0.0.{i}",
+                            hostname=f"smoke{i}", download_port=8000 + i)
+
+        # level 0: both classes admitted (and their priorities learned)
+        low = await svc.register_peer(
+            "p-low", TaskMeta("t-deg", "http://o/f", priority=1.0), host(1))
+        high = await svc.register_peer(
+            "p-high", TaskMeta("t-deg", "http://o/f", priority=5.0), host(2))
+        assert not low.error and not high.error, (low, high)
+
+        # climb to rung 4 (sustain 0: one step per evaluation tick)
+        t = 0.0
+        while ctrl.level < 4:
+            ctrl.evaluate_once(now=t)
+            t += 1.0
+        refused = await svc.register_peer(
+            "p-low2", TaskMeta("t-deg", "http://o/f", priority=1.0), host(3))
+        admitted = await svc.register_peer(
+            "p-high2", TaskMeta("t-deg", "http://o/f", priority=5.0), host(4))
+        assert refused.error == "overloaded", refused
+        assert refused.retry_after_s and refused.retry_after_s > 0, refused
+        assert not admitted.error, admitted
+        print(f"degradation smoke: typed refusal ok (low shed with "
+              f"retry_after {refused.retry_after_s:.1f}s, high admitted)")
+
+    asyncio.run(body())
+
+
+def leg_retry_budget() -> None:
+    from dragonfly2_tpu.resilience.budget import RetryBudget
+
+    clk = _FakeClock()
+    b = RetryBudget("smoke", rate=1.0, burst=3.0, clock=clk)
+    assert all(b.spend() for _ in range(3)), "burst should be spendable"
+    assert not b.spend(), "beyond burst must fail fast, not queue"
+    clk.now += 2.0  # refill 2 tokens
+    assert b.spend()
+    b.charge(30.0)  # server hint: whole-process back-off
+    assert not b.spend(), "charged window must deny even with tokens"
+    clk.now += 31.0
+    assert b.spend(), "budget must recover after the hint expires"
+    st = b.stats()
+    assert st["denied"] == 2 and st["charges"] == 1, st
+    print(f"degradation smoke: retry budget ok "
+          f"(spent {st['spent']}, denied {st['denied']}, charged {st['charges']})")
+
+
+def leg_chaos_packs() -> None:
+    from dragonfly2_tpu.cli.dfsim import run_scenario
+
+    out = run_scenario("overload-flash", peers=800, telemetry=False)
+    assert out["assertions"]["passed"], out["assertions"]["error"]
+    deg = out["degradation"]
+    print(f"degradation smoke: overload-flash ok (completed "
+          f"{out['outcomes']['completed']}/800, ladder max {deg['max_level']} "
+          f"final {deg['final_level']}, refused {out['overload']['refused']})")
+
+    out = run_scenario("manager-blackout", peers=200, agents=10, telemetry=False)
+    assert out["assertions"]["passed"], out["assertions"]["error"]
+    mgr = out["manager"]
+    print(f"degradation smoke: manager-blackout ok (completed "
+          f"{out['outcomes']['completed']}/200, agents {mgr['agents']} all "
+          f"declared/recovered/rejoined)")
+
+
+def main() -> int:
+    leg_ladder_and_alert()
+    leg_typed_refusal()
+    leg_retry_budget()
+    leg_chaos_packs()
+    print("degradation smoke: ALL OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

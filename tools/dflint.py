@@ -76,6 +76,13 @@ Checks (see README.md "Static analysis" for the catalog):
          for loop — the numpy twin of DF012: one tiny allocation per row
          turns a columnar pass into O(rows) Python (vectorize with field
          slicing, unique/bincount/reduceat instead)
+  DF034  unbounded queue in service code: asyncio.Queue()/LifoQueue()/
+         PriorityQueue() without a positive maxsize, or collections.deque()
+         without a maxlen, outside tests — under overload an unbounded
+         buffer converts backpressure into memory growth and turns a
+         brownout into an OOM kill (the ISSUE 17 degradation rule: every
+         service-side buffer is bounded or carries a suppression explaining
+         why unbounded is safe here)
 
 Suppression:
   - same line:   <code>  # dflint: disable=DF023 <reason>   (comma-separate ids;
@@ -117,6 +124,7 @@ CHECKS: dict[str, str] = {
     "DF031": "bare/overbroad except silently swallowing the error",
     "DF032": "mutable default argument",
     "DF033": "per-row numpy array construction inside a for loop (vectorize)",
+    "DF034": "unbounded asyncio.Queue/deque in service code (overload memory bomb)",
 }
 
 # numpy constructors whose per-row use inside a loop marks an unvectorized
@@ -1316,6 +1324,56 @@ def check_dead_alert_rules(
         )
 
 
+def check_unbounded_queue(tree: ast.Module, path: str) -> Iterator[Violation]:
+    """DF034: asyncio.Queue()/LifoQueue()/PriorityQueue() without a positive
+    maxsize, or collections.deque() without a maxlen, in service code.
+
+    Any explicit maxsize/maxlen argument clears the check (a variable bound
+    means the author chose one; only the all-defaults spelling — which is
+    unbounded — is flagged, and an explicit maxsize=0/maxlen=None reads as
+    deliberately unbounded and needs the suppression + reason instead).
+    Tests are exempt: a test's queue lives for one case, not for a node's
+    uptime under overload."""
+    parts = Path(path).parts
+    if "tests" in parts or Path(path).name.startswith("test_"):
+        return
+    aliases = import_aliases(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _resolved_call_name(node, aliases)
+        tail = name.split(".")[-1]
+        if name.startswith("asyncio.") and tail in (
+            "Queue", "LifoQueue", "PriorityQueue"
+        ):
+            bounded = bool(node.args) or any(
+                kw.arg == "maxsize"
+                and not (isinstance(kw.value, ast.Constant) and not kw.value.value)
+                for kw in node.keywords
+            )
+            if not bounded:
+                yield Violation(
+                    path, node.lineno, node.col_offset, "DF034",
+                    f"{name}() without maxsize is an unbounded buffer — under "
+                    "overload it converts backpressure into memory growth; "
+                    "pass a bound (or suppress with the reason it can't grow)",
+                )
+        elif name in ("collections.deque", "deque"):
+            # deque(iterable, maxlen) — a second positional IS the bound
+            bounded = len(node.args) >= 2 or any(
+                kw.arg == "maxlen"
+                and not (isinstance(kw.value, ast.Constant) and kw.value.value is None)
+                for kw in node.keywords
+            )
+            if not bounded:
+                yield Violation(
+                    path, node.lineno, node.col_offset, "DF034",
+                    "deque() without maxlen is an unbounded buffer — under "
+                    "overload it converts backpressure into memory growth; "
+                    "pass maxlen (or suppress with the reason it can't grow)",
+                )
+
+
 ALL_CHECKS = (
     check_tracer_coercion,
     check_jnp_in_loop,
@@ -1332,6 +1390,7 @@ ALL_CHECKS = (
     check_silent_swallow,
     check_mutable_defaults,
     check_np_ctor_in_row_loop,
+    check_unbounded_queue,
 )
 
 
