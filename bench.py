@@ -2539,9 +2539,226 @@ def bench_ml_observability(rounds: int = 1200, probes: int = 400) -> dict:
             record_us * DECISION_SAMPLE_DEFAULT + sketch_us / stride
         ) / round_us * 100.0
         out["ml_obs_implied_overhead_pct"] = round(implied, 3)
+
+        # ---- batched shadow scoring (ISSUE 18 satellite): the candidate
+        # model's per-round cost at sample rate 1.0, sync per-round leg vs
+        # the multi-round batched FFI entry the native round driver feeds
+        # (_shadow_score_batch). Needs the native toolchain; nulls otherwise.
+        out["shadow_round_us_serial"] = None
+        out["shadow_round_us_batched"] = None
+        out["shadow_batched_recovery_pct"] = None
+        try:
+            import tempfile as _tempfile
+
+            from dragonfly2_tpu.native import NativeScorer
+            from dragonfly2_tpu.sim.engine import _synthetic_scorer_artifact
+
+            with _tempfile.TemporaryDirectory() as td:
+                art = _synthetic_scorer_artifact(
+                    os.path.join(td, "shadow.dfsc"), n_nodes=256, seed=3
+                )
+                shadow_scorer = NativeScorer(art)
+                try:
+                    node_index = {
+                        h.id: j % 256
+                        for j, h in enumerate(svc.pool.hosts.values())
+                    }
+                    svc.evaluator.attach_candidate(
+                        shadow_scorer, node_index,
+                        version="bench-shadow", sample_rate=1.0,
+                    )
+                    batch = 8
+                    items = [
+                        (children[r % len(children)], cands, feats, scores)
+                        for r in range(batch)
+                    ]
+                    svc.evaluator._shadow_score_batch(items)  # warm
+                    for it in items:
+                        svc.evaluator._shadow_score(*it)
+                    reps = max(probes // batch, 8)
+                    ser_t, bat_t = [], []
+                    for _rep in range(3):  # interleaved, same rounds
+                        t0 = time.perf_counter()
+                        for _ in range(reps):
+                            for it in items:
+                                svc.evaluator._shadow_score(*it)
+                        ser_t.append(
+                            (time.perf_counter() - t0) / (reps * batch) * 1e6
+                        )
+                        t0 = time.perf_counter()
+                        for _ in range(reps):
+                            svc.evaluator._shadow_score_batch(items)
+                        bat_t.append(
+                            (time.perf_counter() - t0) / (reps * batch) * 1e6
+                        )
+                    ser_us = float(np.median(ser_t))
+                    bat_us = float(np.median(bat_t))
+                    out["shadow_round_us_serial"] = round(ser_us, 2)
+                    out["shadow_round_us_batched"] = round(bat_us, 2)
+                    out["shadow_batched_recovery_pct"] = round(
+                        (ser_us - bat_us) / ser_us * 100.0, 1
+                    )
+                finally:
+                    svc.evaluator.detach_candidate()
+                    shadow_scorer.close()
+        except Exception as e:  # noqa: BLE001 — shadow keys stay null
+            print(f"bench: shadow batch leg skipped: {e!r}", file=sys.stderr)
         svc.close()
     except Exception as e:  # noqa: BLE001 — leg skipped, keys stay null
         print(f"bench: ml_observability leg failed: {e!r}", file=sys.stderr)
+    return out
+
+
+def bench_round_loop(
+    rounds: int = 1200, batch: int = 8, candidates: int = 40, hosts: int = 256,
+) -> dict:
+    """Native round driver vs the serial Python round loop (ISSUE 18): the
+    SAME batches of full scheduling rounds (sample + filter + score + stable
+    top-k) through `find_candidate_parents_batch` (Python: evaluate_many +
+    argsort) and `find_candidate_parents_batch_native` (snapshot under the
+    lock → ONE GIL-released df_round_drive FFI → commit), interleaved
+    same-run median-of-3 with identical rng draws per leg.
+
+      native_rounds_per_s / serial_rounds_per_s   the A/B medians
+      speedup                                     native / serial
+      ffi_calls_per_round                         drive FFI calls / native
+                                                  rounds (1/batch when the
+                                                  driver carries every round)
+      commit_ms                                   Python tail per ROUND after
+                                                  the FFI returns (outs +
+                                                  records + shadow), in ms
+      native_coverage                             natively-scored fraction —
+                                                  a silent fallback would
+                                                  void the A/B
+      equivalent                                  parent lists byte-identical
+                                                  across the legs
+
+    Needs the C++ toolchain + a synthetic scorer artifact (no jax). Nulls
+    (never 0.0) when unavailable — VERDICT #8 bench hygiene."""
+    import random as _random
+    import tempfile
+
+    out: dict = {
+        "native_rounds_per_s": None,
+        "serial_rounds_per_s": None,
+        "speedup": None,
+        "ffi_calls_per_round": None,
+        "commit_ms": None,
+        "native_coverage": None,
+        "equivalent": None,
+    }
+    try:
+        from dragonfly2_tpu.native import NativeScorer
+        from dragonfly2_tpu.scheduler.evaluator import new_evaluator
+        from dragonfly2_tpu.scheduler.resource import HostType
+        from dragonfly2_tpu.scheduler.scheduling import Scheduling
+        from dragonfly2_tpu.scheduler.service import SchedulerService
+        from dragonfly2_tpu.sim.engine import _synthetic_scorer_artifact
+
+        with tempfile.TemporaryDirectory() as td:
+            scorer = NativeScorer(
+                _synthetic_scorer_artifact(
+                    os.path.join(td, "rl.dfsc"), n_nodes=1024, seed=5
+                )
+            )
+            ev = new_evaluator("ml")
+            svc = SchedulerService(evaluator=ev)
+            task = svc.pool.load_or_create_task("rl-task", "http://origin/rl.bin")
+            task.set_metadata(1 << 30, 4 << 20)
+            children, all_hosts = [], []
+            for i in range(hosts):
+                h = svc.pool.load_or_create_host(
+                    f"rlh{i}", f"10.7.{i // 256}.{i % 256}", f"rlhost{i}",
+                    download_port=8000, host_type=HostType.NORMAL,
+                    idc=f"idc-{i % 3}", location=f"r{i % 2}|z{i % 5}",
+                )
+                h.upload_limit = 10_000
+                all_hosts.append(h)
+                p = svc.pool.create_peer(f"rlp{i}", task, h)
+                for evname in ("register", "download"):
+                    if p.fsm.can(evname):
+                        p.fsm.fire(evname)
+                if i < batch:
+                    children.append(p)
+                else:
+                    for idx in range(8):
+                        p.finished_pieces.set(idx)
+                    p.bump_feat()
+            rng = _random.Random(13)
+            for c in children:
+                for h in all_hosts[:64]:
+                    svc.topology.enqueue(c.host.id, h.id, rng.uniform(0.2, 30.0))
+                    svc.bandwidth.observe(h.id, c.host.id, rng.uniform(1e8, 1e9))
+            node_index = {h.id: i % 1024 for i, h in enumerate(all_hosts)}
+            ev.attach_scorer(scorer, node_index, version="bench-round-loop")
+
+            reqs = [(c, set()) for c in children]
+            n_batches = max(rounds // batch, 1)
+
+            # equivalence spot-check: same seed, same pool → byte-identical
+            # parent lists (the tests pin this exhaustively; the bench only
+            # guards against a silently-voided A/B)
+            s_ser, s_nat = Scheduling(ev), Scheduling(ev)
+            a = s_ser.find_candidate_parents_batch(list(reqs))
+            b = s_nat.find_candidate_parents_batch_native(list(reqs))
+            out["equivalent"] = (
+                [[p.id for p in r] for r in a] == [[p.id for p in r] for r in b]
+            )
+
+            # count drive FFI calls + time the post-FFI commit tail via a
+            # class-level probe (bench-only; restored in finally)
+            drive_stats = {"calls": 0, "t_ret": 0.0}
+            orig_bound = NativeScorer.drive_rounds_bound
+
+            def _probed(self, binding, **kw):
+                drive_stats["calls"] += 1
+                try:
+                    return orig_bound(self, binding, **kw)
+                finally:
+                    drive_stats["t_ret"] = time.perf_counter()
+
+            NativeScorer.drive_rounds_bound = _probed
+            try:
+                ser_rates, nat_rates = [], []
+                commit_s = 0.0
+                served0 = 0
+                for _rep in range(3):
+                    sched = Scheduling(ev)  # fresh seeded rng: same draws
+                    t0 = time.perf_counter()
+                    for _ in range(n_batches):
+                        sched.find_candidate_parents_batch(reqs)
+                    ser_rates.append(
+                        n_batches * batch / (time.perf_counter() - t0)
+                    )
+                    sched = Scheduling(ev)
+                    served0 -= sched.native_rounds_served
+                    t0 = time.perf_counter()
+                    for _ in range(n_batches):
+                        drive_stats["t_ret"] = 0.0
+                        sched.find_candidate_parents_batch_native(reqs)
+                        if drive_stats["t_ret"]:
+                            commit_s += time.perf_counter() - drive_stats["t_ret"]
+                    nat_rates.append(
+                        n_batches * batch / (time.perf_counter() - t0)
+                    )
+                    served0 += sched.native_rounds_served
+            finally:
+                NativeScorer.drive_rounds_bound = orig_bound
+            nat = float(np.median(nat_rates))
+            ser = float(np.median(ser_rates))
+            total_native_rounds = 3 * n_batches * batch
+            out["native_rounds_per_s"] = round(nat, 1)
+            out["serial_rounds_per_s"] = round(ser, 1)
+            out["speedup"] = round(nat / ser, 3)
+            out["native_coverage"] = round(served0 / total_native_rounds, 3)
+            out["ffi_calls_per_round"] = round(
+                drive_stats["calls"] / max(served0, 1), 3
+            )
+            out["commit_ms"] = round(commit_s / total_native_rounds * 1e3, 4)
+            svc.close()
+            scorer.close()
+    except Exception as e:  # noqa: BLE001 — section skipped, keys stay null
+        print(f"bench: round_loop leg failed: {e!r}", file=sys.stderr)
     return out
 
 
@@ -2736,6 +2953,7 @@ def main() -> None:
     observability = run_section("observability", bench_observability, {})
     metrics_plane = run_section("metrics_plane", bench_metrics_plane, {})
     ml_observability = run_section("ml_observability", bench_ml_observability, {})
+    round_loop = run_section("round_loop", bench_round_loop, {})
     federation = run_section("federation", bench_federation, {})
     swarm_sim = run_section("swarm_sim", bench_swarm_sim, {})
     overload = run_section("overload", bench_overload, {})
@@ -2835,6 +3053,12 @@ def main() -> None:
             "decision_record_us"
         ),
         "ml_observability": ml_observability or "skipped",
+        # native round loop (ISSUE 18): whole scheduling rounds through ONE
+        # df_round_drive FFI vs the Python batch leg, same draws, interleaved
+        # same-run; nulls (never 0.0) when the C++ toolchain is absent
+        "round_loop_native_rounds_per_s": round_loop.get("native_rounds_per_s"),
+        "round_loop_speedup": round_loop.get("speedup"),
+        "round_loop": round_loop or "skipped",
         # scheduler federation (ISSUE 10): swarm rounds/s through the
         # 2-scheduler ring, one-hop topology-sync convergence, watermarked
         # payload counter-assert, and ring re-shard churn bounds

@@ -84,6 +84,17 @@ def run_scenario(
             "virtual_s": rep.virtual_s,
             "events_per_sec": rep.events_per_sec,
             "time_compression": rep.time_compression,
+            "scheduler": {
+                # ISSUE 18: the sim-scale round-loop meter. scoring reports
+                # what actually served (an ml-* request degrades to "base"
+                # when the native toolchain is missing); rounds_per_s is
+                # rounds / seconds INSIDE schedule_candidate_parents.
+                "scoring": rep.scoring,
+                "rounds": rep.sched_rounds,
+                "sched_s": rep.sched_s,
+                "rounds_per_s": rep.sched_rounds_per_s,
+                "native_rounds": rep.native_rounds,
+            },
             "placement": {
                 "rounds": rep.rounds_with_parents,
                 "same_region_frac": rep.same_region_frac,
@@ -189,15 +200,30 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--no-telemetry", action="store_true",
                     help="skip record capture + dataset bridge (pure control plane)")
+    ap.add_argument("--scoring", choices=("base", "ml-serial", "ml-native"),
+                    default="base",
+                    help="scoring plane: base (no model), ml-serial (synthetic "
+                         "native model, per-round Python loop), ml-native (same "
+                         "model through the df_round_drive round driver). "
+                         "flash-crowd only; ml legs skip the placement-quality "
+                         "checks (policy under a synthetic model is not the "
+                         "scenario contract — the round-loop A/B is)")
     ap.add_argument("--json", action="store_true", help="one JSON object on stdout")
     args = ap.parse_args(argv)
 
+    kw: dict[str, Any] = {}
+    if args.scoring != "base":
+        if args.scenario != "flash-crowd":
+            ap.error("--scoring is flash-crowd only")
+        kw["scoring"] = args.scoring
+        kw["check"] = False
     out = run_scenario(
         args.scenario,
         peers=args.peers,
         schedulers=args.schedulers,
         seed=args.seed,
         telemetry=not args.no_telemetry,
+        **kw,
     )
     if args.json:
         out.pop("_buckets", None)

@@ -233,6 +233,64 @@ class PieceReportBuffer:
             "dropping %d unreported piece results at task close", len(self._buf)
         )
 
+    async def close_with_result(self, *, success: bool,
+                                bandwidth_bps: float = 0.0) -> bool:
+        """Task-completion close that rides the residual piece batch AND the
+        final peer result in ONE report_batch RPC (one frame, one scheduler
+        lock pass) instead of aclose()'s flush followed by a separate unary
+        report_peer_result. Returns True when the result landed; False when
+        the transport has no report_batch (older scheduler: unimplemented
+        over the wire, or a client predating the method) — the caller then
+        falls back to aclose() + unary report_peer_result, which this method
+        has already half-done by flushing what it could.
+
+        Retry safety matches the unary pair it replaces: both legs are
+        idempotent server-side (piece dedupe + terminal-FSM result skip), so
+        the rpc client's retries and the backed-off attempts here cannot
+        double-account."""
+        fn = getattr(self._sched, "report_batch", None)
+        if fn is None:
+            await self.aclose()
+            return False
+        if self._flusher is not None:
+            self._flusher.cancel()
+            await asyncio.gather(self._flusher, return_exceptions=True)
+            self._flusher = None
+        result = {"success": success, "bandwidth_bps": bandwidth_bps}
+        backoff = BackoffPolicy(base=0.05, max_delay=1.0)
+        for attempt in range(4):
+            if attempt:
+                await backoff.sleep(attempt - 1)
+            async with self._lock:
+                batch, self._buf = self._buf, []
+                try:
+                    with default_tracer().span(
+                        "conductor.report_close", batch=len(batch)
+                    ):
+                        await fn(self.peer_id, batch, result)
+                    self.rpcs += 1
+                    return True
+                except RpcError as e:
+                    self._buf = batch + self._buf
+                    if e.code == "unimplemented":
+                        break  # rolling upgrade: scheduler predates the method
+                    self.log.debug(
+                        "batched close of %d failed: %r", len(batch), e
+                    )
+                except Exception as e:  # noqa: BLE001 — same advisory
+                    # contract as flush(): the download never fails on a report
+                    self._buf = batch + self._buf
+                    self.log.debug(
+                        "batched close of %d failed: %r", len(batch), e
+                    )
+                except BaseException:
+                    self._buf = batch + self._buf
+                    raise
+        # could not land the combo: drain pieces the plain way and tell the
+        # caller to send the unary result itself
+        await self.aclose()
+        return False
+
 
 @dataclass
 class ParentState:
@@ -1747,13 +1805,22 @@ class PeerTaskConductor:
             from dragonfly2_tpu.daemon import metrics
 
             metrics.PIECE_STRIPE_PARENTS.observe(float(len(self.pieces_by_parent)))
+        elapsed = max(1e-6, time.monotonic() - self._t0)
+        bw = (self.bytes_from_parents + self.bytes_from_source) / elapsed
         if self._reports is not None:
             # task-completion flush BEFORE the peer result: report_peer_result
             # snapshots the peer's finished set into telemetry, so buffered
-            # pieces must land first
-            await self._reports.aclose()
-        elapsed = max(1e-6, time.monotonic() - self._t0)
-        bw = (self.bytes_from_parents + self.bytes_from_source) / elapsed
+            # pieces must land first. close_with_result rides both in ONE
+            # report_batch RPC when the scheduler speaks it; False means the
+            # pieces were flushed the plain way and the unary result below
+            # still owes.
+            try:
+                if await self._reports.close_with_result(
+                    success=success, bandwidth_bps=bw
+                ):
+                    return
+            except Exception:
+                self.log.exception("batched close failed for %s", self.peer_id)
         try:
             await self.scheduler.report_peer_result(
                 self.peer_id, success=success, bandwidth_bps=bw

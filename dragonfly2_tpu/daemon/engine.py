@@ -55,6 +55,9 @@ class InProcessSchedulerClient:
     async def report_pieces(self, peer_id, reports):
         return self._svc.report_pieces(peer_id, list(reports))
 
+    async def report_batch(self, peer_id, reports, result=None):
+        return self._svc.report_batch(peer_id, list(reports), result=result)
+
     async def announce_task(self, peer_id, meta, host, *, content_length, piece_size, piece_indices, digest=""):
         self._svc.announce_task(
             peer_id, meta, host, content_length=content_length,

@@ -23,6 +23,13 @@
 //   df_scorer_score_rounds — M queued rounds in ONE FFI call (the 10k-calls/s
 //                            amortized path; rounds are independent, so this
 //                            is a flat (M·B)-row batch through the same GEMMs)
+//   df_round_drive         — M whole scheduling rounds in ONE call: re-validate
+//                            the Python-snapshotted filter fields, fill the
+//                            round-constant feature columns, score every
+//                            survivor row through the same per-row pipeline as
+//                            score_rounds (bit-identical math), then stable
+//                            top-k per round. Python keeps only the snapshot
+//                            (under the scheduler state lock) and the commit.
 //
 // Thread safety: scratch buffers live in the handle, so concurrent scoring
 // calls on ONE handle are serialized by an internal mutex (ctypes releases
@@ -288,25 +295,18 @@ int32_t df_scorer_num_nodes(const DfScorer* s) { return (int32_t)s->model->hdr.n
 int32_t df_scorer_embed_dim(const DfScorer* s) { return (int32_t)s->model->hdr.d; }
 int32_t df_scorer_feature_dim(const DfScorer* s) { return (int32_t)s->model->hdr.fp; }
 
-// Score `rounds` independent scheduling rounds of `batch` (child, parent)
-// pairs each in ONE call: child/parent are [rounds*batch] i32, feats is
-// [rounds*batch, FP] row-major, out is [rounds*batch] f32. The multi-round
-// entry amortizes FFI + dispatch overhead across rounds (north-star config 5's
-// 10k-calls/s path). Returns 0 on success, -1 on an out-of-range node index.
-int32_t df_scorer_score_rounds(DfScorer* s, const int32_t* child,
-                               const int32_t* parent, const float* feats,
-                               int32_t rounds, int32_t batch, float* out) {
+// The full three-stage per-row pipeline over R pre-validated rows: child/
+// parent are PER-ROW node indices, feats row for row b is feats[row_map[b]]
+// (row_map == nullptr ⇒ identity). Both public entries funnel here, so the
+// multi-round batch path and the round driver are bit-identical by
+// construction — per-row math never depends on the batch shape.
+static void score_rows(DfScorer* s, const int32_t* child, const int32_t* parent,
+                       const float* feats, const int32_t* row_map, int32_t R,
+                       float* out) {
   const DfModel* m = s->model;
   const Header& h = m->hdr;
-  const int64_t total64 = (int64_t)rounds * batch;
-  if (total64 <= 0 || total64 > (int64_t)1 << 24) return total64 == 0 ? 0 : -2;
-  const int32_t R = (int32_t)total64;
   const int D = (int)h.d, FP = (int)h.fp, H1 = (int)h.h1, H2 = (int)h.h2;
   const int in1 = D + FP;  // contraction after the uc/up precompute
-  for (int32_t b = 0; b < R; ++b) {
-    const int32_t c = child[b], p = parent[b];
-    if (c < 0 || p < 0 || (uint32_t)c >= h.n || (uint32_t)p >= h.n) return -1;
-  }
   // Row-TILE the whole three-stage pipeline (128 rows ≈ 72 KB X + 128 KB Y1
   // scratch): running each stage over the full R first meant ~550 KB of
   // scratch churn per call — two dispatcher workers' concurrent calls then
@@ -354,7 +354,8 @@ int32_t df_scorer_score_rounds(DfScorer* s, const int32_t* child,
         const float* zc = m->z.data() + (size_t)child[b] * D;
         const float* zp = m->z.data() + (size_t)parent[b] * D;
         for (int i = 0; i < D; ++i) xb[i] = zc[i] * zp[i];
-        std::memcpy(xb + D, feats + (size_t)b * FP, FP * sizeof(float));
+        const size_t frow = row_map ? (size_t)row_map[b] : (size_t)b;
+        std::memcpy(xb + D, feats + frow * FP, FP * sizeof(float));
         float* yb = Y1 + (size_t)(b - b0) * H1;
         const float* ucr = m->uc.data() + (size_t)child[b] * H1;
         const float* upr = m->up.data() + (size_t)parent[b] * H1;
@@ -374,6 +375,25 @@ int32_t df_scorer_score_rounds(DfScorer* s, const int32_t* child,
       }
     }
   }
+}
+
+// Score `rounds` independent scheduling rounds of `batch` (child, parent)
+// pairs each in ONE call: child/parent are [rounds*batch] i32, feats is
+// [rounds*batch, FP] row-major, out is [rounds*batch] f32. The multi-round
+// entry amortizes FFI + dispatch overhead across rounds (north-star config 5's
+// 10k-calls/s path). Returns 0 on success, -1 on an out-of-range node index.
+int32_t df_scorer_score_rounds(DfScorer* s, const int32_t* child,
+                               const int32_t* parent, const float* feats,
+                               int32_t rounds, int32_t batch, float* out) {
+  const Header& h = s->model->hdr;
+  const int64_t total64 = (int64_t)rounds * batch;
+  if (total64 <= 0 || total64 > (int64_t)1 << 24) return total64 == 0 ? 0 : -2;
+  const int32_t R = (int32_t)total64;
+  for (int32_t b = 0; b < R; ++b) {
+    const int32_t c = child[b], p = parent[b];
+    if (c < 0 || p < 0 || (uint32_t)c >= h.n || (uint32_t)p >= h.n) return -1;
+  }
+  score_rows(s, child, parent, feats, nullptr, R, out);
   return 0;
 }
 
@@ -382,6 +402,123 @@ int32_t df_scorer_score(DfScorer* s, const int32_t* child,
                         const int32_t* parent, const float* feats,
                         int32_t batch, float* out) {
   return df_scorer_score_rounds(s, child, parent, feats, 1, batch, out);
+}
+
+// ── The native round driver ────────────────────────────────────────────────
+//
+// One FFI call drives a BATCH of whole scheduling rounds: re-validate →
+// fill round-constant feature columns → score → stable top-k, all with the
+// GIL released. Arena contract (all buffers owned and reused by the caller):
+//
+//   offsets    [M+1] i32 — survivor rows of round r are [offsets[r], offsets[r+1])
+//   child_idx  [M]   i32 — embedding-table index of the round's child (-1 unknown)
+//   parent_idx [T]   i32 — per survivor row (-1 unknown), T = offsets[M]
+//   feats      [T,FP]f32 — validated pair rows; round-constant columns
+//                          (10 = finished-piece ratio, 11 = log-scaled
+//                          content length, 13 = scaled schedule rounds) are
+//                          broadcast HERE from round_cols
+//   round_cols [M,3] f32 — the three round-constant values, computed in
+//                          Python with the same float32 ops as
+//                          _fill_round_columns
+//   filt       [T,4] i32 — (flags, state_code, free_upload_slots, depth)
+//                          snapshotted under the scheduler state lock
+//
+// Outputs: out_scores [T] f32 (NaN for rows the driver did not score),
+// sel [M,k] i32 local survivor indices (-1 pad), n_sel [M] i32, and
+// status [M] i32: 0 = natively scored, 1 = round must re-run on the Python
+// serial leg (unknown node index, stale embedding table, or a filter field
+// that disagrees with the snapshot predicate) — the caller routes those
+// through the bit-identical evaluate_many path, preserving serial semantics
+// for every fallback taxonomy case.
+//
+// Returns 0 on success; -2 row-cap overflow, -3 feature schema too narrow
+// for the round-constant columns, -4 malformed offsets.
+int32_t df_round_drive(DfScorer* s, const int32_t* offsets,
+                       const int32_t* child_idx, const int32_t* parent_idx,
+                       float* feats, const float* round_cols,
+                       const int32_t* filt, int32_t rounds, int32_t k,
+                       int32_t max_depth, float* out_scores, int32_t* sel,
+                       int32_t* n_sel, int32_t* status) {
+  const Header& h = s->model->hdr;
+  if (rounds <= 0) return 0;
+  const int FP = (int)h.fp;
+  if (FP <= 13) return -3;
+  const int64_t total64 = (int64_t)offsets[rounds];
+  if (total64 < 0 || total64 > (int64_t)1 << 24) return -2;
+  const int32_t T = (int32_t)total64;
+
+  // Pass 1 (per round): native-or-fallback decision, round-column broadcast,
+  // and compaction of the scorable rows (fallback rounds' rows are skipped).
+  std::vector<int32_t> crow, prow, rmap;
+  crow.reserve(T);
+  prow.reserve(T);
+  rmap.reserve(T);
+  for (int32_t r = 0; r < rounds; ++r) {
+    const int32_t t0 = offsets[r], t1 = offsets[r + 1];
+    n_sel[r] = 0;
+    for (int32_t j = 0; j < k; ++j) sel[(size_t)r * k + j] = -1;
+    if (t1 < t0 || t0 < 0) return -4;
+    if (t1 == t0) {  // no survivors: an empty round, natively resolved
+      status[r] = 0;
+      continue;
+    }
+    const int32_t c = child_idx[r];
+    bool native = c >= 0 && (uint32_t)c < h.n;
+    for (int32_t t = t0; native && t < t1; ++t) {
+      const int32_t p = parent_idx[t];
+      const int32_t* f = filt + (size_t)t * 4;
+      if (p < 0 || (uint32_t)p >= h.n ||
+          f[0] != 0 || f[1] < 0 || f[2] <= 0 || f[3] >= max_depth) {
+        native = false;
+      }
+    }
+    if (!native) {
+      status[r] = 1;
+      continue;
+    }
+    status[r] = 0;
+    const float* rc = round_cols + (size_t)r * 3;
+    for (int32_t t = t0; t < t1; ++t) {
+      float* fr = feats + (size_t)t * FP;
+      fr[10] = rc[0];
+      fr[11] = rc[1];
+      fr[13] = rc[2];
+      crow.push_back(c);
+      prow.push_back(parent_idx[t]);
+      rmap.push_back(t);
+    }
+  }
+
+  // Pass 2: one shared-pipeline scoring sweep over the compacted rows.
+  const int32_t RC = (int32_t)rmap.size();
+  std::vector<float> cs((size_t)RC);
+  if (RC > 0) score_rows(s, crow.data(), prow.data(), feats, rmap.data(), RC, cs.data());
+  for (int32_t t = 0; t < T; ++t) out_scores[t] = std::nanf("");
+  for (int32_t i = 0; i < RC; ++i) out_scores[rmap[i]] = cs[i];
+
+  // Pass 3: stable top-k per native round. Matches
+  // np.argsort(-scores, kind="stable")[:k] exactly: descending score, ties
+  // broken by survivor index, NaN ranked last (numpy sorts NaN to the end).
+  std::vector<int32_t> order;
+  for (int32_t r = 0; r < rounds; ++r) {
+    if (status[r] != 0 || k <= 0) continue;
+    const int32_t t0 = offsets[r];
+    const int32_t nr = offsets[r + 1] - t0;
+    if (nr <= 0) continue;
+    order.resize(nr);
+    for (int32_t j = 0; j < nr; ++j) order[j] = j;
+    const float* sc = out_scores + t0;
+    std::stable_sort(order.begin(), order.end(), [sc](int32_t a, int32_t b) {
+      const float xa = sc[a], xb = sc[b];
+      const bool na = std::isnan(xa), nb = std::isnan(xb);
+      if (na || nb) return nb && !na;  // non-NaN sorts before NaN
+      return xa > xb;
+    });
+    const int32_t kk = std::min<int32_t>(k, nr);
+    for (int32_t j = 0; j < kk; ++j) sel[(size_t)r * k + j] = order[j];
+    n_sel[r] = kk;
+  }
+  return 0;
 }
 
 }  // extern "C"

@@ -141,12 +141,33 @@ class NativeScorer:
         self._dll.df_scorer_set_thread_parallelism.restype = None
         self._dll.df_scorer_fork.restype = ctypes.c_void_p
         self._dll.df_scorer_fork.argtypes = [ctypes.c_void_p]
+        _pi32 = ctypes.POINTER(ctypes.c_int32)
+        _pf32 = ctypes.POINTER(ctypes.c_float)
+        self._dll.df_round_drive.restype = ctypes.c_int32
+        self._dll.df_round_drive.argtypes = [
+            ctypes.c_void_p,  # handle
+            _pi32,  # offsets [M+1]
+            _pi32,  # child_idx [M]
+            _pi32,  # parent_idx [T]
+            _pf32,  # feats [T, FP]
+            _pf32,  # round_cols [M, 3]
+            _pi32,  # filt [T, 4]
+            ctypes.c_int32,  # rounds
+            ctypes.c_int32,  # k
+            ctypes.c_int32,  # max_depth
+            _pf32,  # out_scores [T]
+            _pi32,  # sel [M, k]
+            _pi32,  # n_sel [M]
+            _pi32,  # status [M]
+        ]
         # bound-method + pointer-type lookups cached off the hot path: at the
         # 10k-calls/s target every getattr/py-object allocation per call counts
         self._score_fn = self._dll.df_scorer_score
         self._score_rounds_fn = self._dll.df_scorer_score_rounds
-        self._pi32 = ctypes.POINTER(ctypes.c_int32)
-        self._pf32 = ctypes.POINTER(ctypes.c_float)
+        self._drive_fn = self._dll.df_round_drive
+        self._pi32 = _pi32
+        self._pf32 = _pf32
+        self.drive_calls = 0  # FFI-call observability for bench/dfstress
         self._handle = self._dll.df_scorer_load(str(artifact_path).encode())
         if not self._handle:
             raise IOError(f"failed to load scorer artifact {artifact_path}")
@@ -223,6 +244,91 @@ class NativeScorer:
             raise ValueError(f"native scorer rejected batch (rc={rc}): bad node index")
         return out
 
+    def drive_rounds(
+        self,
+        offsets: np.ndarray,
+        child_idx: np.ndarray,
+        parent_idx: np.ndarray,
+        feats: np.ndarray,
+        round_cols: np.ndarray,
+        filt: np.ndarray,
+        *,
+        rounds: int,
+        k: int,
+        max_depth: int,
+        out_scores: np.ndarray,
+        sel: np.ndarray,
+        n_sel: np.ndarray,
+        status: np.ndarray,
+    ) -> None:
+        """Drive `rounds` whole scheduling rounds in ONE FFI call (GIL released).
+
+        The caller owns every buffer (a reusable per-thread arena — see
+        scheduling._RoundArena) and guarantees dtype/contiguity: offsets,
+        child_idx, parent_idx, n_sel, status and the [T,4] filt / [M,k] sel
+        blocks are int32; feats ([T,FP]), round_cols ([M,3]) and out_scores
+        ([T]) are float32. No per-call allocation or dtype coercion happens
+        here — this wrapper is on the 10k-rounds/s hot path. The driver
+        fills feats' round-constant columns, scores the survivor rows with
+        the exact score_rounds pipeline, and writes stable top-k selections;
+        per-round `status` distinguishes natively-scored rounds (0) from
+        rounds the caller must re-run on the Python serial leg (1).
+        """
+        self.drive_rounds_bound(
+            self.bind_drive(
+                offsets, child_idx, parent_idx, feats, round_cols, filt,
+                out_scores, sel, n_sel, status,
+            ),
+            rounds=rounds, k=k, max_depth=max_depth,
+        )
+
+    def bind_drive(
+        self,
+        offsets: np.ndarray,
+        child_idx: np.ndarray,
+        parent_idx: np.ndarray,
+        feats: np.ndarray,
+        round_cols: np.ndarray,
+        filt: np.ndarray,
+        out_scores: np.ndarray,
+        sel: np.ndarray,
+        n_sel: np.ndarray,
+        status: np.ndarray,
+    ) -> tuple:
+        """Precompute drive_rounds' ctypes pointer arguments for a reusable
+        buffer set. The 13 per-call `.ctypes.data_as` conversions cost ~40 µs
+        per drive — a real tax on one-round batches — and the arena's buffers
+        only move when it grows, so the binding is cached on the arena and
+        invalidated by `_RoundArena.ensure` on reallocation. Pointer-only:
+        a binding made through one forked handle is valid on any fork of the
+        same model (ctypes pointer types are process-global)."""
+        return (
+            offsets.ctypes.data_as(self._pi32),
+            child_idx.ctypes.data_as(self._pi32),
+            parent_idx.ctypes.data_as(self._pi32),
+            feats.ctypes.data_as(self._pf32),
+            round_cols.ctypes.data_as(self._pf32),
+            filt.ctypes.data_as(self._pi32),
+            out_scores.ctypes.data_as(self._pf32),
+            sel.ctypes.data_as(self._pi32),
+            n_sel.ctypes.data_as(self._pi32),
+            status.ctypes.data_as(self._pi32),
+        )
+
+    def drive_rounds_bound(
+        self, binding: tuple, *, rounds: int, k: int, max_depth: int
+    ) -> None:
+        """drive_rounds over a prebuilt `bind_drive` binding (hot path)."""
+        rc = self._drive_fn(
+            self._handle,
+            binding[0], binding[1], binding[2], binding[3], binding[4],
+            binding[5], rounds, k, max_depth,
+            binding[6], binding[7], binding[8], binding[9],
+        )
+        self.drive_calls += 1
+        if rc != 0:
+            raise ValueError(f"native round driver rejected batch (rc={rc})")
+
     def fork(self) -> "NativeScorer":
         """A second handle onto the SAME loaded model (df_scorer_fork).
 
@@ -241,6 +347,7 @@ class NativeScorer:
         if not handle:
             raise IOError("df_scorer_fork failed (closed handle?)")
         clone._handle = handle
+        clone.drive_calls = 0  # each handle counts its own FFI calls
         return clone
 
     def limit_thread_parallelism(self, n: int = 1) -> None:

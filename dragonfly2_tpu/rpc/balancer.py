@@ -229,6 +229,12 @@ class BalancedSchedulerClient:
         self._peer_addr.pop(peer_id, None)  # terminal per-peer call: evict
         await client.report_peer_result(peer_id, **kw)
 
+    async def report_batch(self, peer_id, reports, result=None):
+        client = self._for_peer(peer_id)
+        if result is not None:
+            self._peer_addr.pop(peer_id, None)  # terminal when a result rides
+        return await client.report_batch(peer_id, reports, result=result)
+
     async def reschedule(self, peer_id):
         return await self._for_peer(peer_id).reschedule(peer_id)
 

@@ -25,6 +25,7 @@ SCHEDULER_METHODS = [
     "report_task_metadata",
     "report_piece_result",
     "report_pieces",
+    "report_batch",
     "announce_task",
     "report_peer_result",
     "reschedule",
@@ -91,6 +92,14 @@ class SchedulerRpcAdapter:
             # (a payload with NEITHER key is malformed: KeyError -> rpc error)
             reports = [(i, p.get("cost_ms", 0.0), "") for i in p["piece_indices"]]
         return self.svc.report_pieces(p["peer_id"], reports)
+
+    async def report_batch(self, p: dict) -> int:
+        # task-close combo: residual piece triples + the final peer result in
+        # one frame (both legs idempotent server-side, so the rpc client's
+        # retries re-apply as no-ops)
+        return self.svc.report_batch(
+            p["peer_id"], p.get("reports", []), result=p.get("result")
+        )
 
     async def announce_task(self, p: dict) -> None:
         self.svc.announce_task(
@@ -212,6 +221,13 @@ class RemoteSchedulerClient:
             {"peer_id": peer_id, "reports": triples,
              "piece_indices": [t[0] for t in triples],
              "cost_ms": (sum(t[1] for t in triples) / len(triples)) if triples else 0.0},
+        )
+
+    async def report_batch(self, peer_id, reports, result=None):
+        return await self._rpc.call(
+            "report_batch",
+            {"peer_id": peer_id, "reports": [list(r) for r in reports],
+             "result": result},
         )
 
     async def announce_task(self, peer_id, meta, host, *, content_length, piece_size, piece_indices, digest=""):

@@ -118,8 +118,8 @@ def _build_pair_features_rowwise(
     avg_rtt = topology.avg_rtt_ms if topology is not None else None
     bw_norm = bandwidth.normalized if bandwidth is not None else None
 
-    hs = [p.host for p in parents]
-    f = np.stack([_parent_static_row(p, h) for p, h in zip(parents, hs)])
+    hs = [p.host for p in parents]  # dflint: disable=DF035 r05 rowwise reference leg: kept as the bench's A/B baseline, never on the shipping path
+    f = np.stack([_parent_static_row(p, h) for p, h in zip(parents, hs)])  # dflint: disable=DF035 r05 rowwise reference leg (bench A/B baseline)
     f[:, 4] = [1.0 if h.idc and h.idc == child_idc else 0.0 for h in hs]
     f[:, 5] = [_location_affinity_cached(h.location, child_loc) for h in hs]
     if avg_rtt is not None:
@@ -133,15 +133,29 @@ def _build_pair_features_rowwise(
     return f
 
 
+def _round_col_values(child: Peer) -> tuple[float, float, float]:
+    """The three round-constant scalars (columns 10/11/13) as Python floats.
+
+    Single source of truth for BOTH fill paths: `_fill_round_columns`
+    broadcasts them onto an assembled matrix, and the native round driver
+    receives them in a float32 side array and broadcasts in C++ — the same
+    Python-float → float32 cast either way, so the resulting feature bytes
+    are identical."""
+    task = child.task
+    return (
+        child.finished_piece_ratio(),
+        float(np.log1p(task.content_length)) / _LOG_1TIB if task.content_length else 0.0,
+        min(child.schedule_rounds, 10) / 10.0,
+    )
+
+
 def _fill_round_columns(f: np.ndarray, child: Peer) -> None:
     """Round-constant columns (child progress / task size / retry count) —
     scalar broadcasts onto the stacked matrix, shared by both assembly paths."""
-    task = child.task
-    f[:, 10] = child.finished_piece_ratio()
-    f[:, 11] = (
-        float(np.log1p(task.content_length)) / _LOG_1TIB if task.content_length else 0.0
-    )
-    f[:, 13] = min(child.schedule_rounds, 10) / 10.0
+    r10, r11, r13 = _round_col_values(child)
+    f[:, 10] = r10
+    f[:, 11] = r11
+    f[:, 13] = r13
 
 
 def build_pair_features(
@@ -179,6 +193,24 @@ def build_pair_features(
     n = len(parents)
     if n == 0:
         return np.zeros((0, FEATURE_DIM), dtype=np.float32)
+    # preallocate + per-row memcpy instead of np.stack: stack's dispatcher
+    # (asanyarray per row, shape set, concat) was the largest single item
+    # left after the caching landed (~25% of the assembled round)
+    f = np.empty((n, FEATURE_DIM), dtype=np.float32)
+    _export_pair_rows(child, parents, topology, bandwidth, f)
+    _fill_round_columns(f, child)
+    return f
+
+
+def _export_pair_rows(
+    child: Peer, parents: Sequence[Peer], topology, bandwidth, f: np.ndarray
+) -> None:
+    """Write the version-cached pair rows for `parents` into f[:n] — the
+    assembly core of `build_pair_features`, split out so the native round
+    driver (scheduling._RoundArena) can fill its flat feature arena directly
+    with zero intermediate matrix. The round-constant columns (10/11/13)
+    stay zero here: build_pair_features broadcasts them in numpy, the driver
+    broadcasts the same float32 scalars in C++ (see _round_col_values)."""
     child_host = child.host
     child_host_id = child_host.id
     child_idc = child_host.idc
@@ -186,12 +218,8 @@ def build_pair_features(
     topo_pver = topology.pair_version if topology is not None else None
     bw_pver = bandwidth.parent_version if bandwidth is not None else None
 
-    # preallocate + per-row memcpy instead of np.stack: stack's dispatcher
-    # (asanyarray per row, shape set, concat) was the largest single item
-    # left after the caching landed (~25% of the assembled round)
-    f = np.empty((n, FEATURE_DIM), dtype=np.float32)
     child_feat_ver = child_host.feat_version
-    for i, p in enumerate(parents):
+    for i, p in enumerate(parents):  # dflint: disable=DF035 this IS the kept assembly loop: version-keyed dict reads + one row memcpy per candidate feed the arena the native driver consumes
         h = p.host
         key = (
             p.feat_version, h.feat_version, child_feat_ver,
@@ -215,8 +243,6 @@ def build_pair_features(
             p._pair_rows.clear()
         p._pair_rows[child_host_id] = (key, row)
         f[i] = row
-    _fill_round_columns(f, child)
-    return f
 
 
 # ---------------------------------------------------------------------------
@@ -272,7 +298,9 @@ class DecisionRecorder:
         self.recorded = 0
         self._seq = 0
 
-    def maybe_record(self, child, parents, feats, scores, *, bundle=None) -> None:
+    def maybe_record(
+        self, child, parents, feats, scores, *, bundle=None, copy=False
+    ) -> None:
         """Record this round if the stride elects it. Cheap when it doesn't:
         one lock + counter. Never raises into the scoring path.
 
@@ -281,7 +309,9 @@ class DecisionRecorder:
         (both are freshly allocated per round and never mutated after — see
         build_pair_features/_base_from), chosen computed with the exact
         stable argsort Scheduling._top_parents runs (the bit-exact replay
-        contract), everything else deferred to snapshot()."""
+        contract), everything else deferred to snapshot(). Callers whose
+        arrays are VIEWS into a reused buffer (the native round driver's
+        arena) pass copy=True — only sampled-in rounds pay the copy."""
         stride = self._stride
         if stride == 0:
             return
@@ -292,6 +322,9 @@ class DecisionRecorder:
                     return
                 self._seq += 1
                 seq = self._seq
+            if copy:
+                scores = np.array(scores, dtype=np.float32)
+                feats = np.array(feats, dtype=np.float32)
             # EXACTLY _top_parents' selection: same negation dtype, same
             # stable argsort — the stored chosen must replay bit-for-bit
             order = np.argsort(-np.asarray(scores), kind="stable")
@@ -394,17 +427,20 @@ class Evaluator:
     # serves the cached path.
     feature_builder = staticmethod(build_pair_features)
 
-    def _record_decision(self, child, parents, feats, scores, bundle=None) -> None:
+    def _record_decision(
+        self, child, parents, feats, scores, bundle=None, copy=False
+    ) -> None:
         """Sampled decision-record hook (ISSUE 15): cheap None-check per
         round when no recorder is attached; maybe_record never raises.
         Shed at brownout rung 2 (shed_obs) — recording is observability tax,
-        not serving."""
+        not serving. copy=True when feats/scores are views into a reused
+        arena (the native round driver path)."""
         rec = self.decisions
         if rec is not None:
             deg = self.degradation
             if deg is not None and deg.shed_obs:
                 return
-            rec.maybe_record(child, parents, feats, scores, bundle=bundle)
+            rec.maybe_record(child, parents, feats, scores, bundle=bundle, copy=copy)
 
     def _observe_drift(self, feats) -> None:
         """Feature-drift live-sketch feed (ISSUE 15); shed with decision
@@ -415,6 +451,12 @@ class Evaluator:
             if deg is not None and deg.shed_obs:
                 return
             d.observe(feats)
+
+    def native_round_entry(self):
+        """Serving bundle for the native round driver, or None: the base
+        evaluator has no native scorer, so rounds always take the Python
+        path. MLEvaluator overrides with the real gate."""
+        return None
 
     def evaluate(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:
         if not parents:
@@ -665,8 +707,8 @@ class MLEvaluator(Evaluator):
             if child_idx is None:
                 tracker.record_uncovered()
                 return
-            parent_idx = [idx.get(p.host.id) for p in parents]
-            keep = [i for i, pi in enumerate(parent_idx) if pi is not None]
+            parent_idx = [idx.get(p.host.id) for p in parents]  # dflint: disable=DF035 kept serial shadow leg: the sync fallback behind _shadow_score_batch, off the served round's critical path
+            keep = [i for i, pi in enumerate(parent_idx) if pi is not None]  # dflint: disable=DF035 kept serial shadow leg (subset mask, log-only)
             if len(keep) < 2:
                 tracker.record_uncovered()
                 return
@@ -702,6 +744,141 @@ class MLEvaluator(Evaluator):
             logger.exception("shadow scoring failed (candidate %s)", tracker.version)
             tracker.record_error()
 
+    def _shadow_score_batch(self, items) -> None:
+        """Shadow-score a BATCH of rounds against the candidate model in ONE
+        multi-round FFI call instead of a sync per-round `score()` each —
+        the shadow leg riding the same amortized entry the serving path uses.
+
+        items: (child, parents, feats, served) tuples in round order.
+        Per-round outcomes are bit-identical to `_shadow_score`: the
+        sampling stride and the uncovered/error taxonomy advance in the same
+        round order, and per-row scoring math does not depend on the batch
+        shape (native scorer property pinned by tests). A batch-level scorer
+        rejection retries per round so one bad round degrades alone."""
+        slot = self._shadow
+        if slot is None or not items:
+            return
+        deg = self.degradation
+        if deg is not None and deg.shed_shadow:
+            return  # brownout rung 1: log-only work is the first thing shed
+        tracker = slot.tracker
+        bundle = slot.bundle
+        sampled = []  # (c, p, f, srv_kept) per elected round
+        try:
+            for child, parents, feats, served in items:
+                if not tracker.should_sample():
+                    continue
+                if not bundle.ready:
+                    tracker.record_uncovered()
+                    continue
+                idx = bundle.node_index
+                child_idx = idx.get(child.host.id)
+                if child_idx is None:
+                    tracker.record_uncovered()
+                    continue
+                parent_idx = [idx.get(p.host.id) for p in parents]  # dflint: disable=DF035 batched-entry prepare: per-candidate dict lookups feed ONE multi-round FFI; the scoring loop itself is native
+                keep = [i for i, pi in enumerate(parent_idx) if pi is not None]  # dflint: disable=DF035 batched-entry prepare (subset mask, log-only)
+                if len(keep) < 2:
+                    tracker.record_uncovered()
+                    continue
+                p = np.array([parent_idx[i] for i in keep], np.int32)
+                c = np.full(len(keep), child_idx, np.int32)
+                subset = len(keep) < len(parents)
+                f = np.asarray(feats)[keep] if subset else np.asarray(feats)  # dflint: disable=DF033 feats is one ROUND's [B,FP] matrix (already ndarray: no-copy view), not a per-row build
+                srv = np.asarray(served, np.float64)  # dflint: disable=DF033 one [B] vector per round; float64 copy needed for the divergence math
+                if subset:
+                    srv = srv[keep]
+                sampled.append((c, p, f, srv))
+        except Exception:
+            logger.exception("shadow batch prepare failed (candidate %s)", tracker.version)
+            tracker.record_error()
+            return
+        if not sampled:
+            return
+        bundle.begin()
+        try:
+            scorer = bundle.thread_scorer()
+            cands: list[np.ndarray | None]
+            if len(sampled) > 1 and hasattr(scorer, "score_rounds"):
+                widths = [len(c) for c, _p, _f, _s in sampled]
+                B = max(widths)
+                fp = sampled[0][2].shape[1]
+                mf = np.zeros((len(sampled), B, fp), np.float32)
+                mc = np.zeros((len(sampled), B), np.int32)
+                mp = np.zeros((len(sampled), B), np.int32)
+                for m, (c, p, f, _s) in enumerate(sampled):
+                    mf[m, : widths[m]] = f
+                    mc[m, : widths[m]] = c
+                    mp[m, : widths[m]] = p
+                try:
+                    out = scorer.score_rounds(mf, child=mc, parent=mp)
+                    cands = [out[m, : widths[m]] for m in range(len(sampled))]
+                except Exception:
+                    logger.exception(
+                        "batched shadow scoring failed (candidate %s); retrying per round",
+                        tracker.version,
+                    )
+                    cands = [None] * len(sampled)
+            else:
+                cands = [None] * len(sampled)
+            for m, (c, p, f, srv) in enumerate(sampled):
+                cand = cands[m]
+                if cand is None:
+                    try:
+                        cand = scorer.score(f, child=c, parent=p)
+                    except Exception:
+                        logger.exception(
+                            "shadow scoring failed (candidate %s)", tracker.version
+                        )
+                        tracker.record_error()
+                        continue
+                cand = np.asarray(cand, np.float64)
+                if not np.isfinite(cand).all():
+                    logger.warning(
+                        "candidate %s produced non-finite scores", tracker.version
+                    )
+                    tracker.record_error()
+                    continue
+                if not np.isfinite(srv).all():
+                    tracker.record_uncovered()
+                    continue
+                tracker.record(srv, cand)
+        finally:
+            bundle.end()
+
+    def native_round_entry(self):
+        """The serving ModelBundle the native round driver may score through,
+        or None when the round must take the serial Python path. Gated
+        exactly like the serial ML legs: a brownout at base_only (rung 3)
+        sheds the driver too, no bundle / not ready serves base, and only
+        the C++ engine (drive_rounds + matching feature schema) qualifies —
+        the jax fallback scorer keeps the per-round path."""
+        deg = self.degradation
+        if deg is not None and deg.base_only:
+            return None
+        bundle = self._serving
+        if bundle is None or not bundle.ready:
+            return None
+        scorer = bundle.scorer
+        if getattr(scorer, "engine", None) != "native" or not hasattr(scorer, "drive_rounds"):
+            return None
+        if getattr(scorer, "feature_dim", None) != FEATURE_DIM:
+            return None
+        return bundle
+
+    def finish_native_rounds(self, items, bundle) -> None:
+        """Observability tail for natively-driven rounds, in round order:
+        feature-drift folds, sampled decision records (copy-on-record —
+        feats/scores are views into the reused arena), then ONE batched
+        shadow pass. Mode-honest: records carry the serving bundle the
+        driver actually scored through."""
+        for child, parents, feats, scores in items:
+            self._observe_drift(feats)
+            self._record_decision(
+                child, parents, feats, scores, bundle=bundle, copy=True
+            )
+        self._shadow_score_batch(items)
+
     def embeddings_age_s(self) -> float | None:
         """Seconds since the serving embeddings were refreshed (staleness);
         None while no model is attached."""
@@ -733,12 +910,12 @@ class MLEvaluator(Evaluator):
         if child_idx is None:
             return feats, None, None, None
         idx = bundle.node_index
-        parent_idx = [idx.get(p.host.id) for p in parents]
+        parent_idx = [idx.get(p.host.id) for p in parents]  # dflint: disable=DF035 kept serial reference leg: the evaluate/evaluate_many path the native driver falls back to, pinned bit-identical by the equivalence tests
         if None in parent_idx:
-            known = np.array([i is not None for i in parent_idx])
+            known = np.array([i is not None for i in parent_idx])  # dflint: disable=DF035 kept serial reference leg (partial-known mask)
             if not known.any():
                 return feats, None, None, None
-            p = np.array([i if i is not None else 0 for i in parent_idx], np.int32)
+            p = np.array([i if i is not None else 0 for i in parent_idx], np.int32)  # dflint: disable=DF035 kept serial reference leg (partial-known merge)
         else:
             known = None  # all known — skip masking entirely
             p = np.array(parent_idx, np.int32)
@@ -891,10 +1068,16 @@ class MLEvaluator(Evaluator):
         finally:
             bundle.end()
         if self._shadow is not None:
-            for i, f, _c, _p, _known in prepared:
-                if outs[i] is not None:
-                    child, parents = rounds[i]
-                    self._shadow_score(child, parents, f, outs[i])
+            # one batched candidate FFI for the whole batch's shadow rounds
+            # (round order preserved — the tracker stride advances exactly
+            # as the per-round leg would)
+            self._shadow_score_batch(
+                [
+                    (rounds[i][0], rounds[i][1], f, outs[i])
+                    for i, f, _c, _p, _known in prepared
+                    if outs[i] is not None
+                ]
+            )
         return outs
 
     async def evaluate_async(self, child: Peer, parents: Sequence[Peer]) -> np.ndarray:

@@ -60,6 +60,23 @@ DISPATCHED_ROUNDS_TOTAL = _r.counter(
     "Scheduling find rounds sharded onto dispatcher worker threads",
     subsystem="scheduler",
 )
+# Native round driver (ISSUE 18): whole rounds (filter re-validation, feature
+# column fill, scoring, stable top-k) resolved by ONE df_round_drive FFI call
+# per dispatched batch. native/total-schedule ratio says whether the 10k+
+# rounds/s path is actually serving; the fallback reasons name why a round
+# stayed on the serial Python leg.
+NATIVE_ROUNDS_TOTAL = _r.counter(
+    "native_rounds_total",
+    "Scheduling rounds resolved end-to-end by the native round driver",
+    subsystem="scheduler",
+)
+NATIVE_ROUND_FALLBACK_TOTAL = _r.counter(
+    "native_round_fallback_total",
+    "Rounds routed back to the serial Python leg (no_native = no eligible "
+    "native bundle, unknown_hosts = node outside the embedding table, "
+    "driver_error = the drive call itself failed)",
+    subsystem="scheduler", labels=("reason",),
+)
 PEERS_GAUGE = _r.gauge("peers", "Live peers in the resource pool", subsystem="scheduler")
 TASKS_GAUGE = _r.gauge("tasks", "Live tasks in the resource pool", subsystem="scheduler")
 HOSTS_GAUGE = _r.gauge("hosts", "Live hosts in the resource pool", subsystem="scheduler")

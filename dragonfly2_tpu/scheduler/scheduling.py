@@ -29,9 +29,15 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from dragonfly2_tpu.models.features import FEATURE_DIM
 from dragonfly2_tpu.observability.tracing import default_tracer
 from dragonfly2_tpu.resilience.backoff import BackoffPolicy
-from dragonfly2_tpu.scheduler.evaluator import Evaluator
+from dragonfly2_tpu.scheduler.evaluator import (
+    Evaluator,
+    _export_pair_rows,
+    _round_col_values,
+    build_pair_features,
+)
 from dragonfly2_tpu.scheduler.resource import (
     PEER_BACK_TO_SOURCE,
     PEER_RUNNING,
@@ -73,6 +79,15 @@ class SchedulingConfig:
     # numpy feature assembly); the mutating apply step stays serialized
     # under the scheduler state lock either way.
     dispatch_workers: int = 0
+    # Native round driver (ISSUE 18): "auto" lets DISPATCHED batches ride
+    # df_round_drive when the evaluator serves an eligible native bundle
+    # (each round degrades to the bit-identical serial leg otherwise);
+    # "native" additionally routes the serial (no-dispatcher) async path
+    # through one-round driver batches — the swarm simulator's shape;
+    # "serial" pins the pre-ISSUE-18 Python loop everywhere (the bench/
+    # equivalence A/B leg). The serial DEFAULT path (no dispatcher, "auto")
+    # is byte-for-byte unchanged.
+    round_driver: str = "auto"
 
 
 @dataclass
@@ -82,6 +97,56 @@ class ScheduleOutcome:
     parents: list[Peer] = field(default_factory=list)
     back_to_source: bool = False
     rounds: int = 0
+
+
+class _RoundArena:
+    """Reusable flat buffers for the native round driver — ONE per calling
+    thread (dispatcher workers each own theirs; see Scheduling._arena), so a
+    drive call's inputs/outputs can never be overwritten by a concurrent
+    batch. Grow-only: steady-state batches allocate nothing.
+
+    Layout is exactly df_round_drive's arena contract (native/scorer.cc):
+    survivor rows are packed flat across the batch's rounds with an offsets
+    fence, filter fields snapshotted under the state lock ride an int32
+    [T,4] block, and the round-constant feature scalars go in a [M,3] side
+    array the driver broadcasts into columns 10/11/13.
+    """
+
+    __slots__ = (
+        "rows_cap", "rounds_cap", "k", "feats", "filt", "parent_idx",
+        "out_scores", "offsets", "child_idx", "round_cols", "sel", "n_sel",
+        "status", "binding",
+    )
+
+    def __init__(self):
+        self.rows_cap = 0
+        self.rounds_cap = 0
+        self.k = -1
+        # cached ctypes pointer tuple for drive_rounds (bind_drive); buffers
+        # only move on growth, so per-call re-marshalling would be pure waste
+        self.binding = None
+
+    def ensure(self, rounds: int, rows: int, k: int) -> None:
+        if rows > self.rows_cap:
+            cap = max(rows, 2 * self.rows_cap, 1024)
+            self.feats = np.zeros((cap, FEATURE_DIM), np.float32)
+            self.filt = np.zeros((cap, 4), np.int32)
+            self.parent_idx = np.zeros(cap, np.int32)
+            self.out_scores = np.zeros(cap, np.float32)
+            self.rows_cap = cap
+            self.binding = None
+        if rounds > self.rounds_cap or k != self.k:
+            rcap = max(rounds, 2 * self.rounds_cap, 64)
+            self.offsets = np.zeros(rcap + 1, np.int32)
+            self.child_idx = np.zeros(rcap, np.int32)
+            self.round_cols = np.zeros((rcap, 3), np.float32)
+            # row stride must equal k exactly (the driver writes sel[r*k+j])
+            self.sel = np.zeros((rcap, max(k, 1)), np.int32)
+            self.n_sel = np.zeros(rcap, np.int32)
+            self.status = np.zeros(rcap, np.int32)
+            self.rounds_cap = rcap
+            self.k = k
+            self.binding = None
 
 
 class Scheduling:
@@ -109,6 +174,12 @@ class Scheduling:
         # attached everything runs on the event loop and the uncontended
         # acquire is noise (~100 ns).
         self.state_lock = threading.RLock()
+        # per-thread native-driver arenas (dispatcher workers snapshot/drive
+        # concurrently; each thread's buffers are private and reused)
+        self._arena_local = threading.local()
+        # instance-local twin of NATIVE_ROUNDS_TOTAL (the global family mixes
+        # every service in the process; sim/bench A/Bs need THIS scheduler's)
+        self.native_rounds_served = 0
         self.dispatcher: RoundDispatcher | None = None
         if self.config.dispatch_workers > 0:
             self.attach_dispatcher(self.config.dispatch_workers)
@@ -232,6 +303,206 @@ class Scheduling:
                 outs[i] = self._top_parents(child, cands, s)
         return outs
 
+    # state-code export for the driver's filter re-validation: any state
+    # outside _OK_PARENT_STATES maps to -1 (ineligible); the dict get is
+    # semantically identical to `fsm.current not in _OK_PARENT_STATES`
+    _STATE_CODES = {s: i for i, s in enumerate(_OK_PARENT_STATES)}
+
+    def _arena(self) -> _RoundArena:
+        a = getattr(self._arena_local, "arena", None)
+        if a is None:
+            a = self._arena_local.arena = _RoundArena()
+        return a
+
+    def _find_batch_entry(self):
+        """The dispatcher's worker-side find runner: the native round driver
+        unless the config pins the serial Python leg."""
+        if self.config.round_driver == "serial":
+            return self.find_candidate_parents_batch
+        return self.find_candidate_parents_batch_native
+
+    def find_candidate_parents_batch_native(
+        self, reqs: list[tuple[Peer, set[str]]]
+    ) -> list[list[Peer]]:
+        """A batch of find rounds through the native round driver: Python
+        does exactly two jobs — snapshot candidates into the flat arena
+        under the state lock (same rng draws, same inline filter conditions
+        as `_passes`), and hand back per-round Peer lists for the caller to
+        commit under the state lock. Everything between (filter
+        re-validation, round-constant feature columns, scoring, stable
+        top-k) is ONE df_round_drive FFI call with the GIL released.
+
+        Bit-identical to `find_candidate_parents_batch`: survivor sets come
+        from the same predicate over the same sampled vertices; feature
+        rows come from the same version-keyed cache (`_export_pair_rows`)
+        with the same float32 round-constant scalars; the driver's per-row
+        scoring math and stable top-k equal the serial scorer + numpy
+        argsort (pinned by tests); and any round the driver cannot score
+        (unknown host, stale artifact, degradation rung, driver error)
+        re-runs on the UNCHANGED evaluate_many leg — including its
+        partial-known base-score merges and fallback metrics."""
+        from dragonfly2_tpu.scheduler import metrics
+
+        ev = self.evaluator
+        bundle = ev.native_round_entry()
+        if bundle is None:
+            # no eligible native bundle (base evaluator, jax fallback, not
+            # ready, or brownout rung 3) — the whole batch is the serial leg
+            metrics.NATIVE_ROUND_FALLBACK_TOTAL.inc(len(reqs), reason="no_native")
+            return self.find_candidate_parents_batch(reqs)
+        cfg = self.config
+        node_index = bundle.node_index
+        k = cfg.candidate_parent_limit
+        max_depth = cfg.max_tree_depth
+        state_codes = self._STATE_CODES
+        is_bad = ev.is_bad_node
+        M = len(reqs)
+        arena = self._arena()
+        arena.ensure(M, M * cfg.filter_parent_limit, k)
+        offsets = arena.offsets
+        filt = arena.filt
+        parent_idx = arena.parent_idx
+        child_idx = arena.child_idx
+        round_cols = arena.round_cols
+        feats = arena.feats
+        # the sim's uncached-assembly override (and the bench's rowwise A/B)
+        # must be honored: a non-default builder assembles the round's matrix
+        # itself and we copy its rows into the arena
+        default_builder = ev.feature_builder is build_pair_features
+
+        cands_per_round: list[list[Peer]] = []
+        t = 0
+        offsets[0] = 0
+        for r, (child, blocklist) in enumerate(reqs):
+            with self.state_lock:
+                # identical rng consumption and filter semantics to
+                # _sample_candidates/_passes, with the driver's re-validated
+                # fields (state code, free slots, depth) snapshotted in the
+                # same pass — same lock scope as the serial leg
+                sample = child.task.dag.random_vertices(
+                    cfg.filter_parent_limit, self._rng
+                )
+                child_id, child_host_id, block, lineage = self._filter_ctx(
+                    child, blocklist
+                )
+                cands: list[Peer] = []
+                # survivor fields accumulate as plain ints under the lock and
+                # land in the arena as ONE bulk assignment per round — per-
+                # element numpy scalar stores cost ~100 ns each, a real tax
+                # at 4+1 stores per candidate on the hot path
+                quads: list[int] = []
+                pidx: list[int] = []
+                for v in sample:
+                    p = v.value
+                    pid = p.id
+                    if pid == child_id or pid in block or pid in lineage:
+                        continue
+                    h = p.host
+                    if h.id == child_host_id:
+                        continue
+                    sc = state_codes.get(p.fsm.current, -1)
+                    if sc < 0:
+                        continue
+                    slots = h.free_upload_slots
+                    if slots <= 0:
+                        continue
+                    d = p.depth()
+                    if d >= max_depth:
+                        continue
+                    if is_bad(p):
+                        continue
+                    quads += (0, sc, slots, d)
+                    pidx.append(node_index.get(h.id, -1))
+                    cands.append(p)
+            cands_per_round.append(cands)
+            n = len(cands)
+            if n:
+                t0, t = t, t + n
+                filt[t0:t] = np.asarray(quads, dtype=np.int32).reshape(n, 4)
+                parent_idx[t0:t] = pidx
+                child_idx[r] = node_index.get(child.host.id, -1)
+                round_cols[r] = _round_col_values(child)
+                rows = feats[t0:t]
+                if default_builder:
+                    # version-cached rows written straight into the arena —
+                    # no intermediate matrix, no np.stack
+                    _export_pair_rows(child, cands, ev.topology, ev.bandwidth, rows)
+                else:
+                    rows[:] = ev.feature_builder(
+                        child, cands, ev.topology, ev.bandwidth
+                    )
+            offsets[r + 1] = t
+
+        status = arena.status
+        driver_failed = False
+        if t > 0:
+            bundle.begin()
+            try:
+                scorer = bundle.thread_scorer()
+                try:
+                    binding = arena.binding
+                    if binding is None:
+                        binding = arena.binding = scorer.bind_drive(
+                            offsets, child_idx, parent_idx, feats, round_cols,
+                            filt, arena.out_scores, arena.sel, arena.n_sel,
+                            status,
+                        )
+                    scorer.drive_rounds_bound(
+                        binding, rounds=M, k=k, max_depth=max_depth
+                    )
+                except Exception:
+                    logger.exception(
+                        "native round driver failed; batch re-runs on the serial leg"
+                    )
+                    status[:M] = 1
+                    driver_failed = True
+                    metrics.NATIVE_ROUND_FALLBACK_TOTAL.inc(
+                        float(M), reason="driver_error"
+                    )
+            finally:
+                bundle.end()
+        else:
+            status[:M] = 0  # every round sampled empty — nothing to score
+
+        outs: list[list[Peer]] = [[] for _ in reqs]
+        native_items = []
+        fb_rounds: list[int] = []
+        sel = arena.sel
+        n_sel = arena.n_sel
+        out_scores = arena.out_scores
+        for r in range(M):
+            cands = cands_per_round[r]
+            if not cands:
+                continue  # empty round: outs[r] stays [] (serial-identical)
+            if status[r] != 0:
+                fb_rounds.append(r)
+                continue
+            sel_r = sel[r]
+            outs[r] = [cands[sel_r[j]] for j in range(n_sel[r])]
+            t0, t1 = int(offsets[r]), int(offsets[r + 1])
+            native_items.append(
+                (reqs[r][0], cands, feats[t0:t1], out_scores[t0:t1])
+            )
+        if fb_rounds:
+            # rounds the driver refused re-run on the bit-identical serial
+            # leg (evaluate_many keeps its fallback taxonomy + records)
+            if not driver_failed:
+                metrics.NATIVE_ROUND_FALLBACK_TOTAL.inc(
+                    float(len(fb_rounds)), reason="unknown_hosts"
+                )
+            scores = ev.evaluate_many(
+                [(reqs[r][0], cands_per_round[r]) for r in fb_rounds]
+            )
+            for r, s in zip(fb_rounds, scores):
+                outs[r] = self._top_parents(reqs[r][0], cands_per_round[r], s)
+        if native_items:
+            metrics.NATIVE_ROUNDS_TOTAL.inc(float(len(native_items)))
+            self.native_rounds_served += len(native_items)
+            # observability tail: drift folds, mode-honest sampled decision
+            # records (copy-on-record — these are arena views), batched shadow
+            ev.finish_native_rounds(native_items, bundle)
+        return outs
+
     async def find_candidate_parents_async(
         self, child: Peer, blocklist: set[str] = frozenset()
     ) -> list[Peer]:
@@ -243,6 +514,16 @@ class Scheduling:
         # serial-vs-dispatched is a first-class span attribute: the trace
         # itself answers which serving shape a round took (ROADMAP #1)
         with default_tracer().span("scheduler.round", dispatched=False) as sp:
+            if self.config.round_driver == "native":
+                # explicit native mode without a dispatcher (the swarm
+                # simulator's single-threaded loop): each round is a
+                # one-round driver batch — snapshot + one FFI + commit-ready
+                # parents, no micro-batcher, no evaluate_many padding
+                out = self.find_candidate_parents_batch_native([(child, blocklist)])[0]
+                if sp.sampled:
+                    sp.set_attr("candidates", len(out))
+                    sp.set_attr("native_driver", True)
+                return out
             with self.state_lock:
                 candidates = self._sample_candidates(child, blocklist)
             if not candidates:
@@ -460,7 +741,10 @@ class RoundDispatcher:
         out: list = [None] * len(batch)
         errs: list = [None] * len(batch)
         for kind, runner in (
-            (self._KIND_FIND, self.scheduling.find_candidate_parents_batch),
+            # config-selected find leg: the native round driver ("auto"/
+            # "native", with per-round serial fallback inside) or the pinned
+            # serial Python loop ("serial" — the equivalence/bench A/B leg)
+            (self._KIND_FIND, self.scheduling._find_batch_entry()),
             (self._KIND_EVAL, self.scheduling.evaluator.evaluate_many),
         ):
             group = [(i, args) for i, (k, args, _f, _m) in enumerate(batch) if k == kind]

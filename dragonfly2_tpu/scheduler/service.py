@@ -26,6 +26,7 @@ from dragonfly2_tpu.scheduler.resource import (
     GCPolicy,
     HostType,
     PEER_BACK_TO_SOURCE,
+    PEER_FAILED,
     PEER_SUCCEEDED,
     Peer,
     ResourcePool,
@@ -577,47 +578,104 @@ class SchedulerService:
         if peer is None:
             return
         metrics.PEER_RESULT_TOTAL.inc(success=str(success).lower())
-        task = peer.task
         with self.state_lock:
-            if success:
-                if peer.fsm.can("succeed"):
-                    peer.fsm.fire("succeed")
-                if task.fsm.can("succeed"):
-                    task.fsm.fire("succeed")
-            else:
-                if peer.fsm.can("fail"):
-                    peer.fsm.fire("fail")
-                if not task.has_available_peer() and task.fsm.can("fail"):
-                    task.fsm.fire("fail")
-            # Record FIRST, observe SECOND: the persisted pair_features must
-            # carry the schedule-time history, not this download's own
-            # bandwidth — otherwise f[8] equals the label on first transfers
-            # and the trainer learns to read the answer off the feature
-            # (train/serve skew). Rows are BUILT here (feature snapshot
-            # pre-observe, parents still edged) but appended after the lock.
-            records = self._build_download_records(peer, success, bandwidth_bps)
-            if success and bandwidth_bps > 0:
-                # feed the bandwidth-history EWMA (feature f[8]) before the
-                # parent edges are dropped below — apportioned across parents:
-                # bandwidth_bps is the child's AGGREGATE rate, so crediting it
-                # whole to each of up to 4 parents would overstate every
-                # parent's EWMA (and the trainer's labels) by the parent-count
-                # factor
-                parents = task.parents_of(peer_id)
-                if parents:
-                    per_parent = bandwidth_bps / len(parents)
-                    for parent in parents:
-                        self.bandwidth.observe(parent.host.id, peer.host.id, per_parent)
-            # The peer stops downloading either way: release its parents'
-            # upload slots now, not at the 24h GC (it stays in the DAG as a
-            # parent).
-            task.delete_parents(peer_id)
+            records = self._apply_peer_result(
+                peer, success=success, bandwidth_bps=bandwidth_bps
+            )
         # Telemetry emit OUTSIDE the state lock: ColumnarStore.append
         # synchronously savez-rotates tens of thousands of rows to disk at
         # its cap — holding the lock across that would stall every
         # dispatcher worker's sample/filter leg for tens of ms.
         for kw in records:
             self.telemetry.downloads.append(**kw)
+
+    def report_batch(
+        self, peer_id: str, reports, result: dict | None = None
+    ) -> int:
+        """Task-completion flush + peer result in ONE RPC and ONE lock pass:
+        the conductor's close_with_result ships its residual piece batch and
+        the final report_peer_result together, collapsing the two awaited
+        control-plane round trips at task close into one.
+
+        `reports` carries the same (piece_index, cost_ms, parent_id) triples
+        as report_pieces, applied with the same dedupe=True idempotent
+        re-apply discipline. `result` (optional) is
+        {"success": bool, "bandwidth_bps": float}; its apply is ALSO
+        idempotent — a peer whose FSM already reached a terminal state is
+        skipped whole (no second result metric, no double bandwidth observe,
+        no duplicate telemetry rows), so a flush retried by the rpc client
+        after a server-side apply is an exact no-op. Unary peers keep calling
+        report_peer_result unchanged. Returns newly applied piece count."""
+        peer = self.pool.peer(peer_id)
+        if peer is None:
+            return 0
+        peer.touch()
+        metrics.PIECE_REPORT_BATCH_TOTAL.inc()
+        applied = 0
+        records: list[dict] = []
+        with self.state_lock:
+            for rep in reports:
+                if self._apply_piece_success(
+                    peer, rep[0], rep[1], rep[2], dedupe=True
+                ):
+                    applied += 1
+            if result is not None:
+                if peer.fsm.current in (PEER_SUCCEEDED, PEER_FAILED):
+                    # retried close flush: the result already landed
+                    metrics.PIECE_REPORT_DUPLICATE_TOTAL.inc()
+                else:
+                    success = bool(result.get("success"))
+                    metrics.PEER_RESULT_TOTAL.inc(success=str(success).lower())
+                    records = self._apply_peer_result(
+                        peer, success=success,
+                        bandwidth_bps=float(result.get("bandwidth_bps", 0.0)),
+                    )
+        for kw in records:
+            self.telemetry.downloads.append(**kw)
+        return applied
+
+    def _apply_peer_result(
+        self, peer: Peer, *, success: bool, bandwidth_bps: float
+    ) -> list[dict]:
+        """One peer result's full accounting — shared by the unary and the
+        batched (report_batch) paths so they cannot diverge. Caller holds
+        the state lock; the returned telemetry rows must be appended AFTER
+        the lock is released."""
+        task = peer.task
+        if success:
+            if peer.fsm.can("succeed"):
+                peer.fsm.fire("succeed")
+            if task.fsm.can("succeed"):
+                task.fsm.fire("succeed")
+        else:
+            if peer.fsm.can("fail"):
+                peer.fsm.fire("fail")
+            if not task.has_available_peer() and task.fsm.can("fail"):
+                task.fsm.fire("fail")
+        # Record FIRST, observe SECOND: the persisted pair_features must
+        # carry the schedule-time history, not this download's own
+        # bandwidth — otherwise f[8] equals the label on first transfers
+        # and the trainer learns to read the answer off the feature
+        # (train/serve skew). Rows are BUILT here (feature snapshot
+        # pre-observe, parents still edged) but appended after the lock.
+        records = self._build_download_records(peer, success, bandwidth_bps)
+        if success and bandwidth_bps > 0:
+            # feed the bandwidth-history EWMA (feature f[8]) before the
+            # parent edges are dropped below — apportioned across parents:
+            # bandwidth_bps is the child's AGGREGATE rate, so crediting it
+            # whole to each of up to 4 parents would overstate every
+            # parent's EWMA (and the trainer's labels) by the parent-count
+            # factor
+            parents = task.parents_of(peer.id)
+            if parents:
+                per_parent = bandwidth_bps / len(parents)
+                for parent in parents:
+                    self.bandwidth.observe(parent.host.id, peer.host.id, per_parent)
+        # The peer stops downloading either way: release its parents'
+        # upload slots now, not at the 24h GC (it stays in the DAG as a
+        # parent).
+        task.delete_parents(peer.id)
+        return records
 
     def _build_download_records(
         self, peer: Peer, success: bool, bandwidth_bps: float

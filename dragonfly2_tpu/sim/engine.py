@@ -54,6 +54,17 @@ class SimConfig:
     topology: TopologyConfig = field(default_factory=TopologyConfig)
     workload: WorkloadConfig = field(default_factory=WorkloadConfig)
     evaluator: str = "ml"  # the real MLEvaluator (base-fallback until a model attaches)
+    # ---- scoring plane (ISSUE 18: the native round driver at sim scale) ----
+    # "base": no model attached — the evaluator serves its numpy fallback
+    #         (HEAD behavior; the placement-quality scenario checks assume it).
+    # "ml-serial": a synthetic native scorer attaches and every round scores
+    #         through the pre-ISSUE-18 per-round Python loop.
+    # "ml-native": same model, but rounds ride df_round_drive — the A/B twin
+    #         proving the driver at 10^5-peer scale: placement is bit-exact vs
+    #         ml-serial for the same seed, only sched_rounds_per_s moves.
+    # Both ml modes degrade to "base" (with a warning) when the native
+    # toolchain is unavailable — the sim never hard-requires g++.
+    scoring: str = "base"
     telemetry_dir: str | None = None  # None: no record capture (pure control-plane run)
     telemetry_rotate_rows: int = 16384
     federation_interval_s: float = 2.0
@@ -137,6 +148,16 @@ class SimReport:
     gray_peers: int = 0
     degradation: dict[str, Any] = field(default_factory=dict)
     manager: dict[str, Any] = field(default_factory=dict)
+    # scoring plane (ISSUE 18): which scoring mode actually served (may read
+    # "base" after an ml-* request degraded for lack of a toolchain), rounds
+    # through schedule_candidate_parents across all schedulers, seconds spent
+    # inside them (local schedule_duration histograms), and the quotient —
+    # the sim-scale scheduler rounds/s the native driver is accountable to
+    scoring: str = "base"
+    sched_rounds: int = 0
+    sched_s: float = 0.0
+    sched_rounds_per_s: float = 0.0
+    native_rounds: int = 0
 
 
 class _SimPeer:
@@ -230,6 +251,33 @@ class Simulation:
         self.names = [f"sim-sch-{i}" for i in range(max(1, self.config.schedulers))]
         self.services: dict[str, SchedulerService] = {}
         self._telemetry = {}
+        self._scoring = self.config.scoring
+        self._scorers: list[Any] = []  # native handles to close after run()
+        self._scorer_artifact: str | None = None
+        if self._scoring not in ("base", "ml-serial", "ml-native"):
+            raise ValueError(f"unknown scoring mode {self._scoring!r}")
+        if self._scoring != "base":
+            import tempfile
+
+            self._scorer_artifact = _synthetic_scorer_artifact(
+                tempfile.mktemp(prefix="dfsim-scorer-", suffix=".dfsc"),
+                seed=self.config.seed,
+            )
+            # one model load for the whole cluster: each service attaches a
+            # fork (shared weights, private handle). A missing toolchain
+            # degrades the RUN to base scoring here — before any service is
+            # built — so every member sees the same round_driver config.
+            try:
+                from dragonfly2_tpu.native import NativeScorer
+
+                self._scorers.append(NativeScorer(self._scorer_artifact))
+            except Exception as e:  # noqa: BLE001 — no g++: degrade, honestly
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "sim scoring %s degraded to base (%r)", self._scoring, e
+                )
+                self._scoring = "base"
         for i, name in enumerate(self.names):
             telemetry = None
             if self.config.telemetry_dir is not None:
@@ -247,6 +295,7 @@ class Simulation:
                 telemetry=telemetry,
                 gc_policy=self.config.gc_policy,
                 clock=self.clock,
+                scheduling_config=self._scheduling_config(),
                 # seeded per member: probe-target draws (and so the probe
                 # telemetry and the bridged dataset) replay bit-identically
                 # for a given SimConfig.seed
@@ -257,6 +306,7 @@ class Simulation:
             # only cost memory here (O(rounds × candidates) rows at 10^5
             # peers, measured ~1 GB) — disable it; the static-row cache stays.
             svc.evaluator.feature_builder = _uncached_pair_features
+            self._attach_sim_scorer(svc)
             self.services[name] = svc
         self.ring = ConsistentHashRing(self.names)
         self.clients = {
@@ -397,6 +447,30 @@ class Simulation:
 
     def _for_task(self, task_id: str):
         return self.clients[self.ring.pick(task_id)]
+
+    def _scheduling_config(self):
+        """round_driver pins the A/B leg: ml-native routes the sim's async
+        rounds through df_round_drive one-round batches, ml-serial pins the
+        per-round Python loop on the SAME attached model. Everything else
+        (filters, rng, retries) is the shared default config."""
+        from dragonfly2_tpu.scheduler.scheduling import SchedulingConfig
+
+        if self._scoring == "ml-native":
+            return SchedulingConfig(round_driver="native")
+        if self._scoring == "ml-serial":
+            return SchedulingConfig(round_driver="serial")
+        return None
+
+    def _attach_sim_scorer(self, svc: SchedulerService) -> None:
+        """Attach a fork of the synthetic native model (ml-* scoring modes):
+        shared weights, one handle per service."""
+        if self._scoring == "base" or not self._scorers:
+            return
+        scorer = self._scorers[0].fork()
+        self._scorers.append(scorer)
+        svc.evaluator.attach_scorer(
+            scorer, _ModNodeIndex(scorer.num_nodes), version="sim-synthetic"
+        )
 
     def _for_host(self, host_id: str):
         return self.clients[self.ring.pick(host_id)]
@@ -935,6 +1009,28 @@ class Simulation:
         wall = _walltime.perf_counter() - t0  # dflint: disable=DF029 same meter
 
         rep = self.report
+        # scoring plane (ISSUE 18): rounds + seconds off each service's
+        # PRIVATE schedule_duration histogram (wall time inside scheduling,
+        # this run's services only — the global family would mix in other
+        # sims of the process)
+        rep.scoring = self._scoring
+        sched_child = [
+            svc.local_metrics.schedule_duration.labels()
+            for svc in self.services.values()
+        ]
+        rep.sched_rounds = int(sum(c.count for c in sched_child))
+        rep.sched_s = round(sum(c.total for c in sched_child), 3)
+        if rep.sched_s > 0:
+            rep.sched_rounds_per_s = round(rep.sched_rounds / rep.sched_s, 1)
+        rep.native_rounds = sum(
+            svc.scheduling.native_rounds_served for svc in self.services.values()
+        )
+        for scorer in self._scorers:
+            try:
+                scorer.close()
+            except Exception:  # noqa: BLE001  # dflint: disable=DF031 teardown best-effort: a failed scorer close must not clobber the finished report
+                pass
+        self._scorers.clear()
         rep.peers = len(self._peers)
         rep.wall_s = round(wall, 3)
         rep.virtual_s = round(self.clock.monotonic(), 3)
@@ -1041,3 +1137,50 @@ def _uncached_pair_features(child, parents, topology=None, bandwidth=None):
     from dragonfly2_tpu.scheduler.evaluator import _build_pair_features_rowwise
 
     return _build_pair_features_rowwise(child, parents, topology, bandwidth)
+
+
+class _ModNodeIndex(dict):
+    """node_index over the open-ended sim host population: any `sim-hNNNNNNN`
+    id maps to NNNNNNN mod n_nodes (peer count is not known at service
+    construction, and the evaluator only ever calls .get). Non-sim ids miss,
+    exercising the unknown-host fallback exactly like production."""
+
+    def __init__(self, n_nodes: int):
+        super().__init__()
+        self._n = n_nodes
+
+    def __bool__(self):
+        # truthy despite holding no materialized entries — ModelBundle
+        # normalizes a falsy node_index to a plain empty dict
+        return True
+
+    def get(self, key, default=None):
+        if isinstance(key, str) and key.startswith("sim-h"):
+            try:
+                return int(key[5:]) % self._n
+            except ValueError:
+                return default
+        return default
+
+
+def _synthetic_scorer_artifact(path: str, *, n_nodes: int = 256,
+                               seed: int = 0) -> str:
+    """A structurally valid scorer artifact with seeded random weights — the
+    sim's scoring A/B measures ROUND-LOOP mechanics (serial Python loop vs
+    df_round_drive), for which any fixed model serves; no jax needed."""
+    import struct
+
+    import numpy as np
+
+    from dragonfly2_tpu.scheduler.evaluator import FEATURE_DIM
+
+    d, h1, h2 = 32, 64, 32
+    rng = np.random.default_rng(seed)
+    din = 3 * d + FEATURE_DIM
+    with open(path, "wb") as f:
+        f.write(struct.pack("<7I", 0x44465343, 1, n_nodes, d, FEATURE_DIM, h1, h2))
+        for shape, scale in (((n_nodes, d), 1.0), ((din, h1), 0.2), ((h1,), 0.1),
+                             ((h1, h2), 0.2), ((h2,), 0.1), ((h2, 1), 0.2),
+                             ((1,), 0.1)):
+            f.write((rng.standard_normal(shape) * scale).astype(np.float32).tobytes())
+    return path

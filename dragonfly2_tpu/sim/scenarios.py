@@ -91,11 +91,13 @@ def flash_crowd(
     churn_lifetime_mean_s: float = 600.0,
     churn_crash_fraction: float = 0.25,
     sample_interval_s: float = 10.0,
+    scoring: str = "base",
 ) -> Scenario:
     task = _task()
     cfg = SimConfig(
         schedulers=schedulers,
         seed=seed,
+        scoring=scoring,
         topology=TopologyConfig(regions=regions),
         workload=WorkloadConfig(
             flash_crowds=(FlashCrowd(1.0, peers, crowd_window_s),),

@@ -486,6 +486,13 @@ class TestBatchedReportFaults:
                     batches.append(list(reports))
                     return await inner.report_pieces(peer_id, reports)
 
+                async def report_batch(self, peer_id, reports, result=None):
+                    # the close flush (residual pieces + final result in one
+                    # RPC) is ALSO the batched path — successes riding it
+                    # satisfy the "successes never go unary" contract
+                    batches.append(list(reports))
+                    return await inner.report_batch(peer_id, reports, result=result)
+
             client = _Spy()
             async with Origin({"f.bin": payload}) as origin:
                 e1 = await _seed_parent(tmp_path, client, origin, payload)

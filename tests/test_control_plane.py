@@ -215,6 +215,13 @@ class _CountingClient:
         self.batches.append(list(reports))
         return await self._inner.report_pieces(peer_id, reports)
 
+    async def report_batch(self, peer_id, reports, result=None):
+        # the task-close combo RPC: residual pieces count as a batch (an
+        # empty residual is just the result riding alone, not a flush)
+        if reports:
+            self.batches.append(list(reports))
+        return await self._inner.report_batch(peer_id, reports, result=result)
+
 
 def _engine(tmp_path, client, name, **cfg_kw):
     # long flush interval: only the deterministic round-end / task-close

@@ -959,6 +959,72 @@ def test_df034_suppression_with_reason():
 
 
 # ---------------------------------------------------------------------------
+# DF035 per-candidate Python loop on the scoring hot path (ISSUE 18)
+
+_DF035_HOT_SRC = """
+def evaluate(self, child, parents):
+    rows = [self.row(p) for p in parents]
+    for p in parents:
+        touch(p)
+    return rows
+"""
+
+
+def test_df035_fires_in_hot_function():
+    path = "dragonfly2_tpu/scheduler/evaluator.py"
+    assert ids(_DF035_HOT_SRC, path) == ["DF035"]
+    assert lines(_DF035_HOT_SRC, path) == [3, 4]  # comp + for loop
+
+
+def test_df035_fires_on_candidate_named_attributes():
+    # the iterable can be an attribute chain (self.candidates) — the NAME
+    # match covers attribute segments too
+    src = """
+    def _prepare(self, child, parents):
+        return [x for x in self.candidates]
+    """
+    assert ids(src, "dragonfly2_tpu/scheduler/rollout.py") == ["DF035"]
+
+
+def test_df035_silent_outside_hot_functions():
+    src = """
+    def commit(self, parents):
+        for p in parents:
+            touch(p)
+    """
+    assert ids(src, "dragonfly2_tpu/scheduler/service.py") == []
+
+
+def test_df035_silent_on_non_candidate_iterables():
+    src = """
+    def evaluate(self, child, rows):
+        for r in rows:
+            touch(r)
+    """
+    assert ids(src, "dragonfly2_tpu/scheduler/evaluator.py") == []
+
+
+def test_df035_exempt_paths():
+    # the native layer, the snapshot loop's module, and tests keep their
+    # per-candidate loops without suppressions
+    for path in (
+        "dragonfly2_tpu/native/scorer.py",
+        "dragonfly2_tpu/scheduler/scheduling.py",
+        "tests/test_round_driver.py",
+    ):
+        assert ids(_DF035_HOT_SRC, path) == [], path
+
+
+def test_df035_suppression_with_reason():
+    src = """
+    def evaluate(self, child, parents):
+        for p in parents:  # dflint: disable=DF035 kept serial reference leg
+            touch(p)
+    """
+    assert ids(src, "dragonfly2_tpu/scheduler/evaluator.py") == []
+
+
+# ---------------------------------------------------------------------------
 # DF028 dead metric family (cross-file: run_sources, not lint_source)
 
 

@@ -1,0 +1,516 @@
+"""Native round loop (ISSUE 18): serial-vs-native bit-exact equivalence on
+randomized pools, the fallback taxonomy (base evaluator, partial node index,
+driver error), arena reuse + pointer-binding invalidation on growth,
+mode-honest decision records on the native path (`dfml explain` replays a
+native round bit-exact; a scorer-error round records mode=base), and the
+report_batch close-flush idempotency the conductor's batched result rides.
+
+The equivalence discipline mirrors test_dispatch: two identical pools, the
+serial leg and the native leg run from the SAME rng state, and every
+observable — per-round parent lists, committed DAG edges — must match
+bit-for-bit. Fallback rounds must be equally invisible: a round the driver
+refuses re-runs on the unchanged evaluate_many leg, so outputs never differ,
+only the fallback counters do.
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.scheduler import metrics
+from dragonfly2_tpu.scheduler.evaluator import new_evaluator
+from dragonfly2_tpu.scheduler.resource import PEER_SUCCEEDED, HostType
+from dragonfly2_tpu.scheduler.service import SchedulerService
+
+pytestmark = pytest.mark.concurrency
+
+needs_gxx = pytest.mark.skipif(
+    __import__("shutil").which("g++") is None, reason="g++ not available"
+)
+
+
+def build_pool(svc: SchedulerService, *, n_hosts: int = 48, n_children: int = 6,
+               seed: int = 0):
+    """Same randomized-pool shape as test_dispatch.build_pool: children
+    downloading, parents holding pieces, probe RTTs + bandwidth on pairs."""
+    rng = random.Random(seed)
+    task = svc.pool.load_or_create_task(f"task-{seed}", "http://origin/t.bin")
+    task.set_metadata(1 << 30, 4 << 20)
+    children, parents = [], []
+    for i in range(n_hosts):
+        h = svc.pool.load_or_create_host(
+            f"h{seed}-{i}", f"10.{seed % 256}.{i // 256}.{i % 256}", f"host{i}",
+            download_port=8000, host_type=HostType.NORMAL,
+            idc=f"idc-{i % 3}", location=f"r{i % 2}|z{i % 5}",
+        )
+        h.upload_limit = 1000
+        p = svc.pool.create_peer(f"peer{seed}-{i}", task, h)
+        for evn in ("register", "download"):
+            if p.fsm.can(evn):
+                p.fsm.fire(evn)
+        if i < n_children:
+            children.append(p)
+        else:
+            for idx in range(rng.randrange(1, 12)):
+                p.finished_pieces.set(idx)
+            p.add_piece_cost(rng.uniform(1.0, 50.0))
+            p.bump_feat()
+            parents.append(p)
+    for c in children:
+        for p in parents:
+            svc.topology.enqueue(c.host.id, p.host.id, rng.uniform(0.2, 30.0))
+            svc.bandwidth.observe(p.host.id, c.host.id, rng.uniform(1e8, 1e9))
+    return task, children, parents
+
+
+def _artifact(tmp_path, *, seed: int = 0) -> str:
+    from dragonfly2_tpu.sim.engine import _synthetic_scorer_artifact
+
+    return _synthetic_scorer_artifact(
+        str(tmp_path / f"rd{seed}.dfsc"), n_nodes=64, seed=seed
+    )
+
+
+def _ml_pair(tmp_path, *, seed: int, partial_index: bool = False,
+             decision_sample_rate: float = 0.0):
+    """Two identical ML-serving services over the same artifact + node index;
+    returns (svc_serial, svc_native, children_a, children_b, scorers)."""
+    from dragonfly2_tpu.native import NativeScorer
+
+    art = _artifact(tmp_path, seed=seed)
+    out = []
+    scorers = []
+    kids = []
+    for leg in ("a", "b"):
+        ev = new_evaluator("ml")
+        svc = SchedulerService(
+            evaluator=ev, decision_sample_rate=decision_sample_rate
+        )
+        _task, children, parents = build_pool(svc, seed=seed)
+        sc = NativeScorer(art)
+        scorers.append(sc)
+        ni = {p.host.id: i % 64 for i, p in enumerate(parents + children)}
+        if partial_index:
+            for p in (parents + children)[::7]:
+                ni.pop(p.host.id, None)
+        ev.attach_scorer(sc, ni, version=f"rd-{seed}")
+        out.append(svc)
+        kids.append(children)
+    return out[0], out[1], kids[0], kids[1], scorers
+
+
+def _close(*objs):
+    for o in objs:
+        o.close()
+
+
+def _run_matched(sched_a, sched_b, reqs_a, reqs_b):
+    """Serial batch on A and native batch on B from the same rng state;
+    returns the two per-round parent-id list-of-lists."""
+    sched_b._rng.setstate(sched_a._rng.getstate())
+    serial = sched_a.find_candidate_parents_batch(reqs_a)
+    native = sched_b.find_candidate_parents_batch_native(reqs_b)
+    return (
+        [[p.id for p in out] for out in serial],
+        [[p.id for p in out] for out in native],
+    )
+
+
+@needs_gxx
+class TestEquivalence:
+    @pytest.mark.parametrize("seed", [1, 2, 5])
+    def test_randomized_pools_bit_identical(self, tmp_path, seed):
+        """Per-round parent lists match the serial leg exactly, across
+        repeated batches (rng state advances identically round over round)."""
+        svc_a, svc_b, ch_a, ch_b, scs = _ml_pair(tmp_path, seed=seed)
+        sched_a, sched_b = svc_a.scheduling, svc_b.scheduling
+        # the dispatcher's worker entry IS the native driver by default
+        assert (
+            sched_b._find_batch_entry()
+            == sched_b.find_candidate_parents_batch_native
+        )
+        native0 = sched_b.native_rounds_served
+        for _trial in range(4):
+            ids_s, ids_n = _run_matched(
+                sched_a, sched_b,
+                [(c, set()) for c in ch_a], [(c, set()) for c in ch_b],
+            )
+            assert ids_s == ids_n
+        # coverage proof: the native leg actually drove rounds (it didn't
+        # silently fall back and pass equivalence via the serial path)
+        assert sched_b.native_rounds_served > native0
+        assert scs[1].drive_calls > 0
+        _close(*scs, svc_a, svc_b)
+
+    def test_partial_node_index_falls_back_identically(self, tmp_path):
+        """Rounds with hosts missing from the node index re-run on the
+        serial evaluate_many leg — outputs identical, fallback counted."""
+        svc_a, svc_b, ch_a, ch_b, scs = _ml_pair(
+            tmp_path, seed=3, partial_index=True
+        )
+        fb0 = metrics.NATIVE_ROUND_FALLBACK_TOTAL.labels(
+            reason="unknown_hosts"
+        ).value
+        ids_s, ids_n = _run_matched(
+            svc_a.scheduling, svc_b.scheduling,
+            [(c, set()) for c in ch_a], [(c, set()) for c in ch_b],
+        )
+        assert ids_s == ids_n
+        assert metrics.NATIVE_ROUND_FALLBACK_TOTAL.labels(
+            reason="unknown_hosts"
+        ).value > fb0
+        _close(*scs, svc_a, svc_b)
+
+    def test_driver_error_falls_back_bit_identical(self, tmp_path):
+        """A drive_rounds FFI failure degrades the BATCH to the serial leg
+        (status=1 for every round), never the outputs."""
+        svc_a, svc_b, ch_a, ch_b, scs = _ml_pair(tmp_path, seed=4)
+
+        def boom(*a, **kw):
+            raise RuntimeError("injected drive failure")
+
+        scs[1].drive_rounds_bound = boom
+        fb0 = metrics.NATIVE_ROUND_FALLBACK_TOTAL.labels(
+            reason="driver_error"
+        ).value
+        native0 = svc_b.scheduling.native_rounds_served
+        ids_s, ids_n = _run_matched(
+            svc_a.scheduling, svc_b.scheduling,
+            [(c, set()) for c in ch_a], [(c, set()) for c in ch_b],
+        )
+        assert ids_s == ids_n
+        assert metrics.NATIVE_ROUND_FALLBACK_TOTAL.labels(
+            reason="driver_error"
+        ).value == fb0 + len(ch_b)
+        assert svc_b.scheduling.native_rounds_served == native0
+        _close(*scs, svc_a, svc_b)
+
+    def test_committed_dag_edges_identical_through_schedule(self, tmp_path, run):
+        """End-to-end through schedule_candidate_parents: the committed DAG
+        edges (what download plans actually follow) match the serial leg."""
+        svc_a, svc_b, ch_a, ch_b, scs = _ml_pair(tmp_path, seed=6)
+        svc_a.scheduling.config.round_driver = "serial"
+        svc_b.scheduling.config.round_driver = "native"
+        svc_b.scheduling._rng.setstate(svc_a.scheduling._rng.getstate())
+
+        async def commit(svc, children):
+            outs = []
+            for c in children:
+                outs.append(await svc.scheduling.schedule_candidate_parents(c))
+            return outs
+
+        outs_a = run(commit(svc_a, ch_a))
+        outs_b = run(commit(svc_b, ch_b))
+        for oa, ob in zip(outs_a, outs_b):
+            assert [p.id for p in oa.parents] == [p.id for p in ob.parents]
+            assert oa.back_to_source == ob.back_to_source
+        for ca, cb in zip(ch_a, ch_b):
+            ea = sorted(p.id for p in ca.task.parents_of(ca.id))
+            eb = sorted(p.id for p in cb.task.parents_of(cb.id))
+            assert ea == eb
+        assert svc_b.scheduling.native_rounds_served > 0
+        _close(*scs, svc_a, svc_b)
+
+
+@needs_gxx
+class TestChaosHammer:
+    def test_native_hammer_preserves_serial_semantics(self, tmp_path, run):
+        """test_dispatch's chaos hammer, on the NATIVE driver: dispatcher
+        workers drive native round batches while probe syncs, piece reports,
+        and failure reports mutate the pool; quiesced, every child's next
+        round must be bit-identical between the serial Python leg and the
+        native driver on the SAME pool state — concurrent mutation must not
+        corrupt the arena snapshot, the version-keyed row cache, or any
+        filter input."""
+        import asyncio
+
+        from dragonfly2_tpu.native import NativeScorer
+        from dragonfly2_tpu.scheduler.scheduling import SchedulingConfig
+
+        async def body():
+            ev = new_evaluator("ml")
+            svc = SchedulerService(
+                evaluator=ev,
+                scheduling_config=SchedulingConfig(dispatch_workers=2),
+            )
+            task, children, parents = build_pool(svc, n_hosts=40, n_children=6)
+            sc = NativeScorer(_artifact(tmp_path, seed=12))
+            ni = {p.host.id: i % 64 for i, p in enumerate(parents + children)}
+            ev.attach_scorer(sc, ni, version="rd-hammer")
+            sched = svc.scheduling
+            rng = random.Random(7)
+            stop = asyncio.Event()
+
+            async def round_driver(child):
+                while not stop.is_set():
+                    out = await sched.schedule_candidate_parents(child)
+                    for p in out.parents:
+                        assert p.id != child.id and p.host.id != child.host.id
+                    await asyncio.sleep(0)
+
+            async def mutator():
+                for i in range(120):
+                    kind = i % 3
+                    if kind == 0:
+                        svc.sync_probes(
+                            rng.choice(children).host.id,
+                            [{"dst_host_id": rng.choice(parents).host.id,
+                              "rtt_ms": rng.uniform(0.2, 40.0)}],
+                        )
+                    elif kind == 1:
+                        svc.report_pieces(
+                            rng.choice(children).id,
+                            [(rng.randrange(0, 256), rng.uniform(1, 30),
+                              rng.choice(parents).id)],
+                        )
+                    else:
+                        svc.report_piece_result(
+                            rng.choice(children).id, rng.randrange(0, 256),
+                            success=False, parent_id=rng.choice(parents).id,
+                        )
+                    await asyncio.sleep(0)
+                stop.set()
+
+            native0 = sched.native_rounds_served
+            await asyncio.gather(mutator(), *(round_driver(c) for c in children))
+            assert sched.native_rounds_served > native0  # the hammer WAS native
+
+            # quiesced: serial leg and native driver must agree per child
+            for c in children:
+                state = sched._rng.getstate()
+                serial = [p.id for p in
+                          sched.find_candidate_parents(c, c.block_parents)]
+                sched._rng.setstate(state)
+                native = [p.id for p in sched.find_candidate_parents_batch_native(
+                    [(c, c.block_parents)]
+                )[0]]
+                assert serial == native
+            sc.close()
+            svc.close()
+
+        run(body())
+
+
+class TestBaseEvaluatorFallback:
+    def test_base_evaluator_batch_matches_serial(self):
+        """No native bundle at all: batch_native IS the serial batch (whole
+        batch falls back, reason=no_native), bit-identical trivially."""
+        svc = SchedulerService()
+        _t, ch, _pa = build_pool(svc, seed=9)
+        sched = svc.scheduling
+        assert sched._find_batch_entry() == sched.find_candidate_parents_batch_native
+        fb0 = metrics.NATIVE_ROUND_FALLBACK_TOTAL.labels(reason="no_native").value
+        state = sched._rng.getstate()
+        a = [[p.id for p in o]
+             for o in sched.find_candidate_parents_batch([(c, set()) for c in ch])]
+        sched._rng.setstate(state)
+        b = [[p.id for p in o]
+             for o in sched.find_candidate_parents_batch_native([(c, set()) for c in ch])]
+        assert a == b
+        assert metrics.NATIVE_ROUND_FALLBACK_TOTAL.labels(
+            reason="no_native"
+        ).value == fb0 + len(ch)
+        svc.close()
+
+    def test_serial_config_pins_python_leg(self):
+        svc = SchedulerService(
+            scheduling_config=__import__(
+                "dragonfly2_tpu.scheduler.scheduling", fromlist=["SchedulingConfig"]
+            ).SchedulingConfig(round_driver="serial")
+        )
+        sched = svc.scheduling
+        assert sched._find_batch_entry() == sched.find_candidate_parents_batch
+        svc.close()
+
+
+@needs_gxx
+class TestArena:
+    def test_arena_grows_and_binding_rebinds(self, tmp_path):
+        """Arena growth (more rounds / more candidates than capacity)
+        invalidates the cached pointer binding; the rebind still scores
+        bit-identically to the serial leg."""
+        svc_a, svc_b, ch_a, ch_b, scs = _ml_pair(tmp_path, seed=7)
+        sched_b = svc_b.scheduling
+        # one-round batch warms a small arena + its binding
+        ids_s, ids_n = _run_matched(
+            svc_a.scheduling, sched_b,
+            [(ch_a[0], set())], [(ch_b[0], set())],
+        )
+        assert ids_s == ids_n
+        arena = sched_b._arena()
+        first = arena.binding
+        assert first is not None
+        # a much wider batch (same children, repeated rounds) overflows both
+        # the row arena (M * filter_parent_limit rows) and the round arena,
+        # forcing a realloc -> the binding must be re-derived
+        wide_a = [(c, set()) for c in ch_a] * 32
+        wide_b = [(c, set()) for c in ch_b] * 32
+        ids_s, ids_n = _run_matched(svc_a.scheduling, sched_b, wide_a, wide_b)
+        assert ids_s == ids_n
+        assert arena.binding is not None and arena.binding is not first
+        # steady state: the SAME binding is reused call over call
+        stable = arena.binding
+        ids_s, ids_n = _run_matched(svc_a.scheduling, sched_b, wide_a, wide_b)
+        assert ids_s == ids_n
+        assert arena.binding is stable
+        _close(*scs, svc_a, svc_b)
+
+
+@needs_gxx
+class TestDecisionRecords:
+    def test_native_round_replays_bit_exact_via_explain(self, tmp_path, capsys):
+        """A natively-driven round's decision record is mode-honest
+        (serving_mode=native, the attached version) and replays bit-exact
+        through dfml's explain path; a tampered record trips the replay
+        verdict — the CLI's exit-3 tripwire."""
+        from dragonfly2_tpu.cli import dfml
+
+        svc_a, svc_b, _ch_a, ch_b, scs = _ml_pair(
+            tmp_path, seed=8, decision_sample_rate=1.0
+        )
+        native0 = svc_b.scheduling.native_rounds_served
+        svc_b.scheduling.find_candidate_parents_batch_native(
+            [(c, set()) for c in ch_b]
+        )
+        assert svc_b.scheduling.native_rounds_served > native0
+        doc = svc_b.decision_records()
+        assert doc["records"], doc["recorder"]
+        for r in doc["records"]:
+            assert r["serving_mode"] == "native"
+            assert r["model_version"] == "rd-8"
+            # the stored scores reproduce the stored chosen top-k exactly
+            replayed = [
+                r["parents"][i]["peer"]
+                for i in dfml.replay_topk(r["scores"], r["topk"])
+            ]
+            assert replayed == r["chosen"]
+            assert dfml.explain_record(r) is True
+            # record rows ride the arena views copy-on-record: full matrix
+            assert len(r["feats"]) == len(r["parents"]) == len(r["scores"])
+        # tamper -> replay mismatch (what `dfml explain` exits 3 on)
+        bad = dict(doc["records"][0])
+        bad["chosen"] = list(reversed(bad["chosen"]))
+        assert dfml.explain_record(bad) is False
+        capsys.readouterr()
+        _close(*scs, svc_a, svc_b)
+
+    def test_scorer_error_round_records_mode_base(self, tmp_path):
+        """When the driver AND the per-round scorer both fail, the round
+        serves base scores — and its decision record says so (mode=base,
+        empty version), never claiming the dead model served it."""
+        svc_a, svc_b, _ch_a, ch_b, scs = _ml_pair(
+            tmp_path, seed=10, decision_sample_rate=1.0
+        )
+
+        def boom(*a, **kw):
+            raise RuntimeError("injected scorer failure")
+
+        sc = scs[1]
+        sc.drive_rounds_bound = boom
+        sc.score = boom
+        sc.score_rounds = boom
+        svc_b.scheduling.find_candidate_parents_batch_native(
+            [(c, set()) for c in ch_b]
+        )
+        doc = svc_b.decision_records()
+        assert doc["records"], doc["recorder"]
+        for r in doc["records"]:
+            assert r["serving_mode"] == "base"
+            assert r["model_version"] == ""
+        _close(*scs, svc_a, svc_b)
+
+    def test_native_record_scores_match_serial_scores(self, tmp_path):
+        """The recorded score vector from a native round equals the serial
+        evaluate() scores for the same candidates — the record is evidence
+        of the actual scoring math, not a reconstruction."""
+        svc_a, svc_b, ch_a, ch_b, scs = _ml_pair(
+            tmp_path, seed=11, decision_sample_rate=1.0
+        )
+        ids_s, ids_n = _run_matched(
+            svc_a.scheduling, svc_b.scheduling,
+            [(ch_a[0], set())], [(ch_b[0], set())],
+        )
+        assert ids_s == ids_n
+        doc = svc_b.decision_records(child=ch_b[0].id)
+        assert doc["records"]
+        r = doc["records"][0]
+        cand_ids = [p["peer"] for p in r["parents"]]
+        by_id = {p.id: p for p in ch_b[0].task.peers()}
+        cands = [by_id[i] for i in cand_ids]
+        serial_scores = svc_b.evaluator.evaluate(ch_b[0], cands)
+        np.testing.assert_array_equal(
+            np.asarray(r["scores"], np.float32), serial_scores
+        )
+        _close(*scs, svc_a, svc_b)
+
+
+class TestReportBatchClose:
+    """Satellite: the conductor's close_with_result flush — pieces + final
+    peer result in ONE report_batch — applied idempotently end to end."""
+
+    def _svc(self):
+        svc = SchedulerService()
+        pool = svc.pool
+        task = pool.load_or_create_task("t-close", "http://o/f")
+        task.set_metadata(8 * (4 << 20))
+        hp = pool.load_or_create_host("hp", "10.0.0.1", "hostp", download_port=8001)
+        hc = pool.load_or_create_host("hc", "10.0.0.2", "hostc", download_port=8002)
+        parent = pool.create_peer("parent", task, hp)
+        child = pool.create_peer("child", task, hc)
+        for p in (parent, child):
+            p.fsm.fire("register")
+            p.fsm.fire("download")
+        return svc, parent, child
+
+    def test_retried_close_flush_is_exact_noop(self):
+        svc, parent, child = self._svc()
+        reports = [(0, 5.0, "parent"), (1, 6.0, "parent")]
+        result = {"success": True, "bandwidth_bps": 2e8}
+        assert svc.report_batch("child", reports, result) == 2
+        assert child.fsm.current == PEER_SUCCEEDED
+        before = (
+            child.finished_pieces.to_int(),
+            parent.host.upload_count,
+            child.fsm.current,
+            metrics.PEER_RESULT_TOTAL.labels(success="true").value,
+        )
+        dups0 = metrics.PIECE_REPORT_DUPLICATE_TOTAL.value
+        # the rpc client re-delivers the SAME close flush (write fault after
+        # server apply): zero new pieces, no second result, terminal FSM
+        # skipped whole — only duplicate counters move
+        assert svc.report_batch("child", reports, result) == 0
+        assert (
+            child.finished_pieces.to_int(),
+            parent.host.upload_count,
+            child.fsm.current,
+            metrics.PEER_RESULT_TOTAL.labels(success="true").value,
+        ) == before
+        assert metrics.PIECE_REPORT_DUPLICATE_TOTAL.value > dups0
+        svc.close()
+
+    def test_batched_close_equals_unary_accounting(self):
+        reports = [(i, 4.0 + i, "parent" if i % 2 else "") for i in range(4)]
+
+        svc_b, parent_b, child_b = self._svc()
+        svc_b.report_batch(
+            "child", reports, {"success": True, "bandwidth_bps": 1e8}
+        )
+
+        svc_u, parent_u, child_u = self._svc()
+        svc_u.report_pieces("child", reports)
+        svc_u.report_peer_result("child", success=True, bandwidth_bps=1e8)
+
+        assert child_b.finished_pieces.to_int() == child_u.finished_pieces.to_int()
+        assert parent_b.host.upload_count == parent_u.host.upload_count
+        assert child_b.fsm.current == child_u.fsm.current == PEER_SUCCEEDED
+        assert list(child_b.piece_costs_ms) == list(child_u.piece_costs_ms)
+        svc_b.close()
+        svc_u.close()
+
+    def test_unknown_peer_is_noop(self):
+        svc, _, _ = self._svc()
+        assert svc.report_batch("ghost", [(0, 1.0, "")], {"success": True}) == 0
+        svc.close()

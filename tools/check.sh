@@ -111,6 +111,27 @@ run_stage "concurrency-smoke" env JAX_PLATFORMS=cpu python -m pytest tests/test_
     -m 'concurrency and not slow' \
     -p no:cacheprovider -p no:xdist -p no:randomly
 
+# roundloop-smoke: the native round driver (ISSUE 18) — serial-vs-native
+# bit-exact equivalence on randomized pools (parent lists, committed DAG
+# edges, chaos hammer), the fallback taxonomy (base evaluator, partial node
+# index, injected driver error), arena growth + pointer-binding reuse, and
+# mode-honest decision records (`dfml explain` replays a native round
+# bit-exact; a scorer-error round records mode=base). Then the bench's
+# round_loop section at a tiny shape: a broken drive path or a silent
+# serial fallback (coverage != 1.0) fails the leg without a full bench run.
+run_stage "roundloop-smoke" env JAX_PLATFORMS=cpu python -m pytest tests/test_round_driver.py -q \
+    -m 'concurrency and not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+run_stage "roundloop-bench-smoke" env JAX_PLATFORMS=cpu python -c "
+import bench
+out = bench.bench_round_loop(rounds=64, batch=8, candidates=8, hosts=48)
+assert out, 'round_loop section returned nothing'
+if out.get('native_rounds_per_s') is not None:
+    assert out['equivalent'] is True, out
+    assert out['native_coverage'] == 1.0, out
+print('round_loop smoke ok:', {k: out[k] for k in ('native_rounds_per_s', 'speedup', 'ffi_calls_per_round', 'native_coverage')})
+"
+
 # federation-smoke: the cluster-in-a-box boots manager + 2 federated
 # schedulers + 2 daemons + origin as REAL subprocesses, runs a real dfget
 # through the federation (seed + P2P, bit-exact), then asserts from the

@@ -83,6 +83,13 @@ Checks (see README.md "Static analysis" for the catalog):
          brownout into an OOM kill (the ISSUE 17 degradation rule: every
          service-side buffer is bounded or carries a suppression explaining
          why unbounded is safe here)
+  DF035  per-candidate Python loop inside a scoring hot-path function
+         (evaluate/evaluate_many/_prepare/feature builders/shadow legs)
+         outside native/ and scheduler/scheduling.py — the native round
+         driver exists because per-round Python glue was the scheduler's
+         throughput wall (ISSUE 18); each such loop re-introduces
+         O(candidates) Python work per round. Suppress with reason for a
+         deliberately-kept serial reference leg.
 
 Suppression:
   - same line:   <code>  # dflint: disable=DF023 <reason>   (comma-separate ids;
@@ -125,6 +132,7 @@ CHECKS: dict[str, str] = {
     "DF032": "mutable default argument",
     "DF033": "per-row numpy array construction inside a for loop (vectorize)",
     "DF034": "unbounded asyncio.Queue/deque in service code (overload memory bomb)",
+    "DF035": "per-candidate Python loop on the scoring hot path (drive it natively)",
 }
 
 # numpy constructors whose per-row use inside a loop marks an unvectorized
@@ -1076,6 +1084,73 @@ def check_np_ctor_in_row_loop(tree: ast.Module, path: str) -> Iterator[Violation
                 )
 
 
+# DF035: the scoring-hot-path functions whose per-round cost bounds
+# scheduler rounds/s (ISSUE 18 — the native round driver moved this work
+# into ONE GIL-released FFI call; Python loops here are the wall it removed)
+_HOT_SCORING_FNS = {
+    "evaluate", "evaluate_many", "evaluate_async", "_prepare",
+    "build_pair_features", "_build_pair_features_rowwise",
+    "_export_pair_rows", "_shadow_score", "_shadow_score_batch",
+}
+_HOT_ITER_NAME = re.compile(r"parent|cand|peer", re.I)
+
+
+def check_py_loop_on_scoring_hot_path(
+    tree: ast.Module, path: str
+) -> Iterator[Violation]:
+    """DF035: per-candidate Python loop inside a scoring hot-path function.
+
+    Fires on a for loop or comprehension whose iterable names the round's
+    candidate set (parents/candidates/peers) inside one of the scoring
+    functions the round loop calls per scheduling round. The native layer
+    (the loops live in C++ there), scheduler/scheduling.py (the snapshot
+    loop under the state lock and the kept serial reference — the
+    equivalence baseline), and tests are exempt. A deliberately-kept Python
+    leg suppresses with its reason."""
+    p = path.replace("\\", "/")
+    if (
+        "/native/" in p or p.startswith("native/")
+        or p.endswith("scheduler/scheduling.py")
+        or "tests/" in p or p.rsplit("/", 1)[-1].startswith("test_")
+    ):
+        return
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.name not in _HOT_SCORING_FNS:
+            continue
+        seen: set[tuple[int, int]] = set()
+        for node in ast.walk(fn):
+            iters: list[ast.expr] = []
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                iters = [node.iter]
+            elif isinstance(
+                node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)
+            ):
+                iters = [g.iter for g in node.generators]
+            for it in iters:
+                names = {
+                    n.id for n in ast.walk(it) if isinstance(n, ast.Name)
+                } | {
+                    n.attr for n in ast.walk(it) if isinstance(n, ast.Attribute)
+                }
+                hit = sorted(n for n in names if _HOT_ITER_NAME.search(n))
+                if not hit:
+                    continue
+                key = (node.lineno, node.col_offset)
+                if key in seen:
+                    continue
+                seen.add(key)
+                yield Violation(
+                    path, node.lineno, node.col_offset, "DF035",
+                    f"per-candidate Python loop over {hit[0]!r} in hot-path "
+                    f"{fn.name}() — O(candidates) Python work per scheduling "
+                    "round; route the round through the native driver "
+                    "(df_round_drive) or vectorize, or suppress with the "
+                    "reason this serial leg is kept",
+                )
+
+
 _MUTABLE_CTORS = {
     "list", "dict", "set", "bytearray", "collections.defaultdict",
     "defaultdict", "collections.deque", "deque", "collections.OrderedDict",
@@ -1390,6 +1465,7 @@ ALL_CHECKS = (
     check_silent_swallow,
     check_mutable_defaults,
     check_np_ctor_in_row_loop,
+    check_py_loop_on_scoring_hot_path,
     check_unbounded_queue,
 )
 
