@@ -2632,6 +2632,19 @@ def bench_round_loop(
                                                   void the A/B
       equivalent                                  parent lists byte-identical
                                                   across the legs
+      mirror_rounds_per_s / mirror_speedup        ISSUE 19 third leg: the
+                                                  delta-fed peer-table mirror
+                                                  (no Python snapshot leg);
+                                                  speedup vs the SERIAL loop
+      mirror_coverage                             fraction of mirror-leg
+                                                  rounds the mirror drove
+                                                  (native + stale-revalidated)
+      mirror_full_syncs                           MUST stay 1 — the attach
+                                                  export is the only full
+                                                  export; steady state is
+                                                  deltas or the A/B is void
+      mirror_equivalent                           mirror parents byte-equal
+                                                  to the serial leg's
 
     Needs the C++ toolchain + a synthetic scorer artifact (no jax). Nulls
     (never 0.0) when unavailable — VERDICT #8 bench hygiene."""
@@ -2646,6 +2659,11 @@ def bench_round_loop(
         "commit_ms": None,
         "native_coverage": None,
         "equivalent": None,
+        "mirror_rounds_per_s": None,
+        "mirror_speedup": None,
+        "mirror_coverage": None,
+        "mirror_full_syncs": None,
+        "mirror_equivalent": None,
     }
     try:
         from dragonfly2_tpu.native import NativeScorer
@@ -2705,6 +2723,19 @@ def bench_round_loop(
                 [[p.id for p in r] for r in a] == [[p.id for p in r] for r in b]
             )
 
+            # ISSUE 19 third leg: attach the delta-fed peer-table mirror (one
+            # full export now; everything after rides the mutation hooks) and
+            # spot-check IT against the serial leg too
+            client = svc.enable_native_mirror()
+            if client is not None and client.ready:
+                s_mir = Scheduling(ev)
+                s_mir._mirror = client  # dflint: disable=DF036 bench A/B rig: fresh leg opts into the one attached client
+                m = s_mir.find_candidate_parents_batch_native(list(reqs))
+                out["mirror_equivalent"] = (
+                    [[p.id for p in r] for r in a]
+                    == [[p.id for p in r] for r in m]
+                )
+
             # count drive FFI calls + time the post-FFI commit tail via a
             # class-level probe (bench-only; restored in finally)
             drive_stats = {"calls": 0, "t_ret": 0.0}
@@ -2719,9 +2750,9 @@ def bench_round_loop(
 
             NativeScorer.drive_rounds_bound = _probed
             try:
-                ser_rates, nat_rates = [], []
+                ser_rates, nat_rates, mir_rates = [], [], []
                 commit_s = 0.0
-                served0 = 0
+                served0 = mirror_served = 0
                 for _rep in range(3):
                     sched = Scheduling(ev)  # fresh seeded rng: same draws
                     t0 = time.perf_counter()
@@ -2742,6 +2773,19 @@ def bench_round_loop(
                         n_batches * batch / (time.perf_counter() - t0)
                     )
                     served0 += sched.native_rounds_served
+                    if client is not None and client.ready:
+                        sched = Scheduling(ev)
+                        sched._mirror = client  # dflint: disable=DF036 bench A/B rig: fresh leg opts into the one attached client
+                        t0 = time.perf_counter()
+                        for _ in range(n_batches):
+                            sched.find_candidate_parents_batch_native(reqs)
+                        mir_rates.append(
+                            n_batches * batch / (time.perf_counter() - t0)
+                        )
+                        mirror_served += (
+                            sched.mirror_rounds_served
+                            + sched.mirror_stale_rounds
+                        )
             finally:
                 NativeScorer.drive_rounds_bound = orig_bound
             nat = float(np.median(nat_rates))
@@ -2755,6 +2799,14 @@ def bench_round_loop(
                 drive_stats["calls"] / max(served0, 1), 3
             )
             out["commit_ms"] = round(commit_s / total_native_rounds * 1e3, 4)
+            if mir_rates:
+                mir = float(np.median(mir_rates))
+                out["mirror_rounds_per_s"] = round(mir, 1)
+                out["mirror_speedup"] = round(mir / ser, 3)
+                out["mirror_coverage"] = round(
+                    mirror_served / total_native_rounds, 3
+                )
+                out["mirror_full_syncs"] = int(client.stats()["full_syncs"])
             svc.close()
             scorer.close()
     except Exception as e:  # noqa: BLE001 — section skipped, keys stay null

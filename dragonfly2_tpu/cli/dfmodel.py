@@ -82,6 +82,20 @@ async def _rollout_main(args: argparse.Namespace) -> int:
                     f" {delta}"
                     f" errors={agg.get('errors', 0)}"
                 )
+                # per-population slicing (ISSUE 19): region × peer-count-band
+                # buckets expose a candidate that only mis-ranks one child
+                # population (e.g. a single region's flash crowds)
+                ws = agg.get("worst_slice")
+                sl = (agg.get("slices") or {}).get(ws)
+                if ws and sl:
+                    print(
+                        f"             worst slice {ws}:"
+                        f" rounds={sl.get('rounds', 0)}"
+                        f" topk={sl.get('topk_overlap_mean', 0.0):.3f}"
+                        f"(min={sl.get('topk_overlap_min', 0.0):.3f})"
+                        f" corr={sl.get('rank_corr_mean', 0.0):.3f}"
+                        f" delta={sl.get('abs_delta_mean', 0.0):.4f}"
+                    )
             for r in st["rejected"]:
                 reason = (r.get("rollout") or {}).get("rejected_reason", "")
                 print(f"  rejected:  {r['version']} (id {r['id']})  {reason}")

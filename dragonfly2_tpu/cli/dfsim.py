@@ -94,6 +94,13 @@ def run_scenario(
                 "sched_s": rep.sched_s,
                 "rounds_per_s": rep.sched_rounds_per_s,
                 "native_rounds": rep.native_rounds,
+                # ISSUE 19: mirror-driven split of native_rounds (cached-row
+                # fast path vs stale-revalidated) + the full-export counter —
+                # must equal the scheduler count (one attach each, then
+                # deltas only)
+                "mirror_rounds": rep.mirror_rounds,
+                "mirror_stale_rounds": rep.mirror_stale_rounds,
+                "mirror_full_syncs": rep.mirror_full_syncs,
             },
             "placement": {
                 "rounds": rep.rounds_with_parents,

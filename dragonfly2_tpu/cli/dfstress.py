@@ -265,38 +265,63 @@ async def run_scoring_stress(args: argparse.Namespace) -> dict:
         eval_lat = np.concatenate(eval_lats[best])
 
         # ---- full round (sample + filters + score + top-4) ----
-        # Four legs interleaved same-run (ISSUE 18): the shipping Python
+        # Six legs interleaved same-run (ISSUE 18 + 19): the shipping Python
         # serial loop, the dispatcher batching rounds through the PYTHON
-        # batch leg (PR 7's best shape), and the native round driver
+        # batch leg (PR 7's best shape), the native round driver
         # (df_round_drive: snapshot under the lock → ONE GIL-released FFI
         # for filter-revalidate + feature columns + score + stable top-k)
-        # on 1 and 2 dispatcher workers. round_driver is flipped per
-        # measurement on the SAME Scheduling (same pool, same rng, same
-        # lock), so the A/B isolates exactly the driver.
+        # on 1 and 2 dispatcher workers, and the MIRROR-backed driver
+        # (df_mirror_drive: no snapshot at all — sample/filter/gather/score
+        # against the C-side mirrored peer table) on the same two shapes.
+        # round_driver and the mirror attachment are flipped per measurement
+        # on the SAME Scheduling (same pool, same rng, same lock), so each
+        # A/B isolates exactly one mechanism.
         sched = svc.scheduling
+        mirror_client = svc.enable_native_mirror()
+        sched._mirror = None  # dflint: disable=DF036 A/B rig: legs opt into the attached client explicitly below
         full_legs = {
-            "serial": ("serial", lambda c: sched.find_candidate_parents_async(c)),
-            "dispatcher": ("serial", lambda c: disp2.find(c)),
-            "native_workers1": ("auto", lambda c: disp1.find(c)),
-            "native_workers2": ("auto", lambda c: disp2.find(c)),
+            "serial": ("serial", False, lambda c: sched.find_candidate_parents_async(c)),
+            "dispatcher": ("serial", False, lambda c: disp2.find(c)),
+            "native_workers1": ("auto", False, lambda c: disp1.find(c)),
+            "native_workers2": ("auto", False, lambda c: disp2.find(c)),
         }
-        for driver, fn in full_legs.values():  # warm both drivers' find paths
+        if mirror_client is not None:
+            full_legs["mirror_workers1"] = ("auto", True, lambda c: disp1.find(c))
+            full_legs["mirror_workers2"] = ("auto", True, lambda c: disp2.find(c))
+        for driver, use_mirror, fn in full_legs.values():  # warm every leg
             sched.config.round_driver = driver
+            sched._mirror = mirror_client if use_mirror else None  # dflint: disable=DF036 A/B rig: per-leg toggle of the one attached client (deltas keep flowing while detached)
             await asyncio.gather(*(fn(c) for c in children))
         full_rates: dict[str, list[float]] = {k: [] for k in full_legs}
         full_lats: dict[str, list[np.ndarray]] = {k: [] for k in full_legs}
+        # per-leg stage decomposition (ISSUE 19 satellite): Scheduling keeps
+        # cumulative ns per stage — snapshot/delta-apply (Python descriptor
+        # or snapshot build + result demux), drive (the FFI call), commit
+        # (the DAG apply, which find-only legs never run) — sliced per leg
+        # by delta around each measurement
+        full_stages: dict[str, list[int]] = {k: [0, 0, 0] for k in full_legs}
         native_driven0 = sched.native_rounds_served
+        mirror_driven0 = sched.mirror_rounds_served
         for _rep in range(3):
-            for name, (driver, fn) in full_legs.items():
+            for name, (driver, use_mirror, fn) in full_legs.items():
                 sched.config.round_driver = driver
+                sched._mirror = mirror_client if use_mirror else None  # dflint: disable=DF036 A/B rig: per-leg toggle of the one attached client
+                s0, d0, c0 = (sched.stage_snapshot_ns, sched.stage_drive_ns,
+                              sched.stage_commit_ns)
                 rps, lat = await measure(fn)
+                st = full_stages[name]
+                st[0] += sched.stage_snapshot_ns - s0
+                st[1] += sched.stage_drive_ns - d0
+                st[2] += sched.stage_commit_ns - c0
                 full_rates[name].append(rps)
                 full_lats[name].append(lat)
         sched.config.round_driver = "auto"
+        sched._mirror = mirror_client  # dflint: disable=DF036 A/B rig: restore the attached client after the leg sweep
         # coverage proof for the A/B: rounds the driver actually scored
         # natively across the native legs (0 would void the comparison —
         # every round silently riding the serial fallback)
         native_rounds_driven = sched.native_rounds_served - native_driven0
+        mirror_rounds_driven = sched.mirror_rounds_served - mirror_driven0
         med = {k: float(np.median(v)) for k, v in full_rates.items()}
         full_serial_rps = med["serial"]
         full_disp_rps = med["dispatcher"]
@@ -308,9 +333,32 @@ async def run_scoring_stress(args: argparse.Namespace) -> dict:
         nat_best = max(("native_workers1", "native_workers2"), key=lambda k: med[k])
         round_driver_rps = med[nat_best]
         native_speedup = round_driver_rps / max(med[py_best], 1e-9)
+        if mirror_client is not None:
+            mirror_best = max(("mirror_workers1", "mirror_workers2"),
+                              key=lambda k: med[k])
+            mirror_rps = med[mirror_best]
+            mirror_speedup = mirror_rps / max(med[py_best], 1e-9)
+            mirror_stats = mirror_client.stats()
+        else:
+            mirror_best = mirror_rps = mirror_speedup = mirror_stats = None
         full_best = max(full_legs, key=lambda k: med[k])
         full_rps = med[full_best]
         full_lat = np.concatenate(full_lats[full_best])
+
+        def stage_us(leg: str | None) -> dict:
+            """Per-round stage split for one leg across its 3 reps. Null
+            hygiene: a stage the leg never ran (commit on find-only legs,
+            drive on pure-Python legs) reports None, not a fake 0.0."""
+            if leg is None:
+                return {"snapshot": None, "drive": None, "commit": None}
+            snap, drv, com = full_stages[leg]
+            n = 3 * args.rounds
+            return {
+                "snapshot": round(snap / n / 1e3, 2) if snap else None,
+                "drive": round(drv / n / 1e3, 2) if drv else None,
+                "commit": round(com / n / 1e3, 2) if com else None,
+            }
+
         disp1.shutdown()
         disp2.shutdown()
 
@@ -348,6 +396,9 @@ async def run_scoring_stress(args: argparse.Namespace) -> dict:
                 scorer.score_rounds(mf, child=mc, parent=mp)
             ffi_us = (time.monotonic() - t0) / probe_n * 1e6
             ceiling_rps = 1e6 / (prepare_us + ffi_us)
+        if mirror_client is not None:
+            sched._mirror = None  # dflint: disable=DF036 A/B rig: deliberate unwiring before closing the client
+            mirror_client.close()
         handle_pool.close()
         scorer.close()
 
@@ -398,6 +449,33 @@ async def run_scoring_stress(args: argparse.Namespace) -> dict:
             "native_speedup_vs_best_py": round(native_speedup, 3),
             "best_py_config": py_best,
             "native_rounds_driven": int(native_rounds_driven),
+            # ISSUE 19 headline: the mirror-backed driver (no Python
+            # snapshot leg at all) vs the same best Python loop, plus the
+            # per-round stage split for the snapshot-native and mirror legs
+            # (None = that leg never ran the stage — find-only legs never
+            # commit, pure-Python legs never drive)
+            "round_driver_mirror_best_config": mirror_best,
+            "round_driver_mirror_rounds_per_s": (
+                round(mirror_rps, 1) if mirror_rps is not None else None
+            ),
+            "round_driver_mirror_rps_workers1": (
+                round(med["mirror_workers1"], 1) if mirror_client is not None else None
+            ),
+            "round_driver_mirror_rps_workers2": (
+                round(med["mirror_workers2"], 1) if mirror_client is not None else None
+            ),
+            "mirror_speedup_vs_best_py": (
+                round(mirror_speedup, 3) if mirror_speedup is not None else None
+            ),
+            "mirror_rounds_driven": int(mirror_rounds_driven),
+            "round_driver_stage_us": stage_us(nat_best),
+            "round_driver_mirror_stage_us": stage_us(mirror_best),
+            "mirror_full_syncs": (
+                int(mirror_stats["full_syncs"]) if mirror_stats else None
+            ),
+            "mirror_stale_rounds": (
+                int(mirror_stats["stale_rounds"]) if mirror_stats else None
+            ),
             "native_flushes": eval_flushes,
             "native_rounds": eval_rounds,
             "prepare_us_per_round": round(prepare_us, 1),

@@ -370,6 +370,197 @@ class NativeScorer:
             pass
 
 
+class NativeMirror:
+    """ctypes binding for the `df_mirror_*` surface (ISSUE 19): the C-side
+    mirror of the scheduler's per-task candidate state.
+
+    This class is the thin FFI layer only — slot allocation, the mutation
+    hooks, and the full-sync protocol live in scheduler.mirror.MirrorClient.
+    Delta methods are cached bound functions because they sit on mutation
+    hot paths (every feat bump crosses here once); `drive` marshals the
+    caller's arena pointers the same way NativeScorer.bind_drive does.
+    """
+
+    _pi32 = ctypes.POINTER(ctypes.c_int32)
+    _pi64 = ctypes.POINTER(ctypes.c_int64)
+    _pf32 = ctypes.POINTER(ctypes.c_float)
+    _pu32 = ctypes.POINTER(ctypes.c_uint32)
+
+    def __init__(self, scorer: "NativeScorer", *, feature_dim: int | None = None):
+        dll = scorer._dll
+        self._dll = dll
+        if not getattr(dll, "_df_mirror_bound", False):
+            i32, i64 = ctypes.c_int32, ctypes.c_int64
+            vp = ctypes.c_void_p
+            dll.df_mirror_new.restype = vp
+            dll.df_mirror_new.argtypes = [i32]
+            dll.df_mirror_free.restype = None
+            dll.df_mirror_free.argtypes = [vp]
+            dll.df_mirror_host_upsert.restype = i32
+            dll.df_mirror_host_upsert.argtypes = [vp, i32, i64, i32, i32]
+            dll.df_mirror_host_remove.restype = i32
+            dll.df_mirror_host_remove.argtypes = [vp, i32]
+            dll.df_mirror_task_upsert.restype = i32
+            dll.df_mirror_task_upsert.argtypes = [vp, i32]
+            dll.df_mirror_task_remove.restype = i32
+            dll.df_mirror_task_remove.argtypes = [vp, i32]
+            dll.df_mirror_peer_add.restype = i32
+            dll.df_mirror_peer_add.argtypes = [vp, i32, i32, i32, i32, i32, i64]
+            dll.df_mirror_peer_remove.restype = i32
+            dll.df_mirror_peer_remove.argtypes = [vp, i32]
+            dll.df_mirror_peer_feat.restype = i32
+            dll.df_mirror_peer_feat.argtypes = [vp, i32, i64, i32]
+            dll.df_mirror_peer_state.restype = i32
+            dll.df_mirror_peer_state.argtypes = [vp, i32, i32]
+            dll.df_mirror_set_parents.restype = i32
+            dll.df_mirror_set_parents.argtypes = [vp, i32, self._pi32, i32]
+            dll.df_mirror_topo_bump.restype = i32
+            dll.df_mirror_topo_bump.argtypes = [vp, i32, i32, i64]
+            dll.df_mirror_bw_bump.restype = i32
+            dll.df_mirror_bw_bump.argtypes = [vp, i32, i64]
+            dll.df_mirror_set_node_indices.restype = i32
+            dll.df_mirror_set_node_indices.argtypes = [vp, self._pi32, self._pi32, i32]
+            dll.df_mirror_push_rows.restype = i32
+            dll.df_mirror_push_rows.argtypes = [
+                vp, i32, i32, self._pi32, self._pi64, self._pf32,
+            ]
+            dll.df_mirror_note_sync.restype = None
+            dll.df_mirror_note_sync.argtypes = [vp]
+            dll.df_mirror_stats.restype = None
+            dll.df_mirror_stats.argtypes = [vp, self._pi64]
+            dll.df_mirror_drive.restype = i32
+            dll.df_mirror_drive.argtypes = [
+                vp, vp, i32,                       # scorer, mirror, rounds
+                self._pi32, self._pi32, self._pi32,  # task/child/child_host
+                self._pi32, self._pi32,            # blocked_off, blocked
+                self._pf32,                        # round_cols [M,3]
+                i32, i32, i32,                     # sample_n, k, max_depth
+                self._pu32,                        # rng_state [625] in/out
+                self._pi32, self._pi32,            # offsets, cand_slots
+                self._pf32, self._pf32,            # feats, out_scores
+                self._pi32, self._pi32, self._pi32,  # sel, n_sel, status
+                i32,                               # row_cap
+            ]
+            dll._df_mirror_bound = True
+        self.feature_dim = int(feature_dim or scorer.feature_dim)
+        self._handle = dll.df_mirror_new(self.feature_dim)
+        if not self._handle:
+            raise ValueError(f"df_mirror_new rejected feature_dim={self.feature_dim}")
+        # cached bound fns: the delta methods ride mutation hot paths
+        self.host_upsert_fn = dll.df_mirror_host_upsert
+        self.host_remove_fn = dll.df_mirror_host_remove
+        self.task_upsert_fn = dll.df_mirror_task_upsert
+        self.task_remove_fn = dll.df_mirror_task_remove
+        self.peer_add_fn = dll.df_mirror_peer_add
+        self.peer_remove_fn = dll.df_mirror_peer_remove
+        self.peer_feat_fn = dll.df_mirror_peer_feat
+        self.peer_state_fn = dll.df_mirror_peer_state
+        self._set_parents_fn = dll.df_mirror_set_parents
+        self.topo_bump_fn = dll.df_mirror_topo_bump
+        self.bw_bump_fn = dll.df_mirror_bw_bump
+        self._drive_fn = dll.df_mirror_drive
+        self.drive_calls = 0
+
+    @property
+    def handle(self):
+        return self._handle
+
+    def set_parents(self, child_slot: int, parent_slots) -> int:
+        n = len(parent_slots)
+        arr = (ctypes.c_int32 * n)(*parent_slots)
+        return self._set_parents_fn(self._handle, child_slot, arr, n)
+
+    def set_node_indices(self, slots: np.ndarray, idx: np.ndarray) -> int:
+        s = np.ascontiguousarray(slots, np.int32)
+        i = np.ascontiguousarray(idx, np.int32)
+        return self._dll.df_mirror_set_node_indices(
+            self._handle, s.ctypes.data_as(self._pi32),
+            i.ctypes.data_as(self._pi32), len(s),
+        )
+
+    def push_rows(
+        self, child_host_slot: int, peer_slots: np.ndarray, keys: np.ndarray,
+        rows: np.ndarray,
+    ) -> int:
+        ps = np.ascontiguousarray(peer_slots, np.int32)
+        ky = np.ascontiguousarray(keys, np.int64)
+        rw = np.ascontiguousarray(rows, np.float32)
+        return self._dll.df_mirror_push_rows(
+            self._handle, child_host_slot, len(ps),
+            ps.ctypes.data_as(self._pi32), ky.ctypes.data_as(self._pi64),
+            rw.ctypes.data_as(self._pf32),
+        )
+
+    def note_sync(self) -> None:
+        self._dll.df_mirror_note_sync(self._handle)
+
+    _STAT_KEYS = (
+        "deltas", "rows_pushed", "native_rounds", "stale_rounds",
+        "fallback_rounds", "empty_rounds", "full_syncs", "drives",
+        "peers", "hosts", "tasks", "rows_cached",
+    )
+
+    def stats(self) -> dict:
+        out = (ctypes.c_int64 * 16)()
+        self._dll.df_mirror_stats(self._handle, out)
+        return dict(zip(self._STAT_KEYS, out[: len(self._STAT_KEYS)]))
+
+    def bind_drive(
+        self, task_slot, child_slot, child_host, blocked_off, blocked,
+        round_cols, rng_state, offsets, cand_slots, feats, out_scores,
+        sel, n_sel, status,
+    ) -> tuple:
+        """Precompute the drive's ctypes pointer arguments for a reusable
+        arena (same caching contract as NativeScorer.bind_drive: the binding
+        is invalidated by the arena whenever a buffer moves)."""
+        return (
+            task_slot.ctypes.data_as(self._pi32),
+            child_slot.ctypes.data_as(self._pi32),
+            child_host.ctypes.data_as(self._pi32),
+            blocked_off.ctypes.data_as(self._pi32),
+            blocked.ctypes.data_as(self._pi32),
+            round_cols.ctypes.data_as(self._pf32),
+            ctypes.cast(rng_state, self._pu32),
+            offsets.ctypes.data_as(self._pi32),
+            cand_slots.ctypes.data_as(self._pi32),
+            feats.ctypes.data_as(self._pf32),
+            out_scores.ctypes.data_as(self._pf32),
+            sel.ctypes.data_as(self._pi32),
+            n_sel.ctypes.data_as(self._pi32),
+            status.ctypes.data_as(self._pi32),
+        )
+
+    def drive_bound(
+        self, scorer: "NativeScorer", binding: tuple, *, rounds: int,
+        sample_n: int, k: int, max_depth: int, row_cap: int,
+    ) -> None:
+        """One mirror-backed drive over a prebuilt binding (hot path). The
+        GIL is released for the whole call; arg errors raise BEFORE any rng
+        consumption (the C side validates first), so the caller can re-run
+        the batch serially on the untouched rng stream."""
+        rc = self._drive_fn(
+            scorer._handle, self._handle, rounds,
+            binding[0], binding[1], binding[2], binding[3], binding[4],
+            binding[5], sample_n, k, max_depth, binding[6],
+            binding[7], binding[8], binding[9], binding[10], binding[11],
+            binding[12], binding[13], row_cap,
+        )
+        self.drive_calls += 1
+        if rc != 0:
+            raise ValueError(f"native mirror drive rejected batch (rc={rc})")
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._dll.df_mirror_free(self._handle)
+            self._handle = None
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:  # dflint: disable=DF031 interpreter teardown can raise anything; __del__ must not
+            pass
+
+
 class ScorerHandlePool:
     """Per-thread native scorer handles behind one artifact.
 

@@ -59,6 +59,36 @@ class RolloutSection:
 
 
 @dataclass
+class DegradationSection:
+    """Brownout-ladder pressure budgets (ISSUE 17 ladder, promoted from
+    hard-coded constants in ISSUE 19): pressure = max(lag_p95/lag_budget,
+    utilization/utilization_budget, queue_depth/queue_budget); sustained
+    pressure above the enter threshold climbs the shedding ladder. Defaults
+    are the measured alert boundaries of the 2-core reference box — a wider
+    deployment raises queue_budget with its worker count."""
+
+    lag_budget_ms: float = cfgfield(
+        250.0, minimum=1.0, maximum=60_000.0,
+        help="event-loop lag p95 treated as pressure 1.0",
+    )
+    utilization_budget: float = cfgfield(
+        0.95, minimum=0.05, maximum=1.0,
+        help="dispatcher worker occupancy treated as pressure 1.0",
+    )
+    queue_budget: float = cfgfield(
+        64.0, minimum=1.0, maximum=1_000_000.0,
+        help="dispatcher queue depth treated as pressure 1.0",
+    )
+
+    def controller_kwargs(self) -> dict:
+        return {
+            "lag_budget_ms": self.lag_budget_ms,
+            "utilization_budget": self.utilization_budget,
+            "queue_budget": self.queue_budget,
+        }
+
+
+@dataclass
 class GCSection:
     """Resource TTLs in seconds (ref constants.go:81-93)."""
 
@@ -89,6 +119,7 @@ class SchedulerYaml:
     scheduling: SchedulingSection = cfgfield(default_factory=SchedulingSection)
     rollout: RolloutSection = cfgfield(default_factory=RolloutSection)
     gc: GCSection = cfgfield(default_factory=GCSection)
+    degradation: DegradationSection = cfgfield(default_factory=DegradationSection)
     tracing: TracingSection = cfgfield(default_factory=TracingSection)
 
     def validate_extra(self, path: str) -> None:

@@ -149,6 +149,25 @@ def _round_col_values(child: Peer) -> tuple[float, float, float]:
     )
 
 
+def _shadow_slice_key(child: Peer) -> str:
+    """Shadow-divergence slice label for a round's child population: coarse
+    region (first `location` segment, falling back to idc) × task peer-count
+    band. Divergence that is invisible in the global mean — a candidate model
+    mis-ranking only one region's flash crowds — shows up as a bad slice."""
+    host = child.host
+    region = (host.location.split("|", 1)[0] if host.location else "") or host.idc or "?"
+    n = len(child.task.dag)
+    if n < 100:
+        band = "p<1e2"
+    elif n < 1_000:
+        band = "p<1e3"
+    elif n < 10_000:
+        band = "p<1e4"
+    else:
+        band = "p>=1e4"
+    return f"{region}|{band}"
+
+
 def _fill_round_columns(f: np.ndarray, child: Peer) -> None:
     """Round-constant columns (child progress / task size / retry count) —
     scalar broadcasts onto the stacked matrix, shared by both assembly paths."""
@@ -739,7 +758,7 @@ class MLEvaluator(Evaluator):
                 # that is not the candidate's fault — no divergence evidence
                 tracker.record_uncovered()
                 return
-            tracker.record(srv, cand)
+            tracker.record(srv, cand, slice_key=_shadow_slice_key(child))
         except Exception:
             logger.exception("shadow scoring failed (candidate %s)", tracker.version)
             tracker.record_error()
@@ -763,7 +782,7 @@ class MLEvaluator(Evaluator):
             return  # brownout rung 1: log-only work is the first thing shed
         tracker = slot.tracker
         bundle = slot.bundle
-        sampled = []  # (c, p, f, srv_kept) per elected round
+        sampled = []  # (c, p, f, srv_kept, slice_key) per elected round
         try:
             for child, parents, feats, served in items:
                 if not tracker.should_sample():
@@ -788,7 +807,7 @@ class MLEvaluator(Evaluator):
                 srv = np.asarray(served, np.float64)  # dflint: disable=DF033 one [B] vector per round; float64 copy needed for the divergence math
                 if subset:
                     srv = srv[keep]
-                sampled.append((c, p, f, srv))
+                sampled.append((c, p, f, srv, _shadow_slice_key(child)))
         except Exception:
             logger.exception("shadow batch prepare failed (candidate %s)", tracker.version)
             tracker.record_error()
@@ -800,13 +819,13 @@ class MLEvaluator(Evaluator):
             scorer = bundle.thread_scorer()
             cands: list[np.ndarray | None]
             if len(sampled) > 1 and hasattr(scorer, "score_rounds"):
-                widths = [len(c) for c, _p, _f, _s in sampled]
+                widths = [len(c) for c, _p, _f, _s, _k in sampled]
                 B = max(widths)
                 fp = sampled[0][2].shape[1]
                 mf = np.zeros((len(sampled), B, fp), np.float32)
                 mc = np.zeros((len(sampled), B), np.int32)
                 mp = np.zeros((len(sampled), B), np.int32)
-                for m, (c, p, f, _s) in enumerate(sampled):
+                for m, (c, p, f, _s, _k) in enumerate(sampled):
                     mf[m, : widths[m]] = f
                     mc[m, : widths[m]] = c
                     mp[m, : widths[m]] = p
@@ -821,7 +840,7 @@ class MLEvaluator(Evaluator):
                     cands = [None] * len(sampled)
             else:
                 cands = [None] * len(sampled)
-            for m, (c, p, f, srv) in enumerate(sampled):
+            for m, (c, p, f, srv, skey) in enumerate(sampled):
                 cand = cands[m]
                 if cand is None:
                     try:
@@ -842,7 +861,7 @@ class MLEvaluator(Evaluator):
                 if not np.isfinite(srv).all():
                     tracker.record_uncovered()
                     continue
-                tracker.record(srv, cand)
+                tracker.record(srv, cand, slice_key=skey)
         finally:
             bundle.end()
 

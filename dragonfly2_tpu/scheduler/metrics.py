@@ -77,6 +77,32 @@ NATIVE_ROUND_FALLBACK_TOTAL = _r.counter(
     "driver_error = the drive call itself failed)",
     subsystem="scheduler", labels=("reason",),
 )
+# Native mirrored peer table (ISSUE 19): rounds where sampling, filtering,
+# feature gather, scoring AND top-k all ran against the C-side mirror — no
+# snapshot-under-lock, no Python peer-pool walk. mirror/native ratio says how
+# often the incremental delta stream kept the mirror current; stale rounds
+# are the lazy-revalidation path (serial score once, rows re-pushed, next
+# drive native); the fallback reasons name why a round left the mirror.
+NATIVE_MIRROR_ROUNDS_TOTAL = _r.counter(
+    "native_mirror_rounds_total",
+    "Scheduling rounds resolved end-to-end against the native mirrored "
+    "peer table (no Python snapshot leg)",
+    subsystem="scheduler",
+)
+NATIVE_MIRROR_STALE_ROUNDS_TOTAL = _r.counter(
+    "native_mirror_stale_rounds_total",
+    "Mirror rounds whose cached feature rows were version-stale: survivors "
+    "scored on the serial leg once, refreshed rows pushed back",
+    subsystem="scheduler",
+)
+NATIVE_MIRROR_FALLBACK_TOTAL = _r.counter(
+    "native_mirror_fallback_total",
+    "Rounds routed off the mirror (mirror_miss = object not yet mirrored "
+    "or deleted mid-drive, driver_error = the mirror drive call failed, "
+    "slot_race = survivor slot remapped between drive and commit, "
+    "poisoned = a mutation hook failed and the mirror detached itself)",
+    subsystem="scheduler", labels=("reason",),
+)
 PEERS_GAUGE = _r.gauge("peers", "Live peers in the resource pool", subsystem="scheduler")
 TASKS_GAUGE = _r.gauge("tasks", "Live tasks in the resource pool", subsystem="scheduler")
 HOSTS_GAUGE = _r.gauge("hosts", "Live hosts in the resource pool", subsystem="scheduler")

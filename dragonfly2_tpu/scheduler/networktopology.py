@@ -123,6 +123,9 @@ class NetworkTopology:
         # pops): a host id recycled after GC must not collide a fresh count
         # with a stale cached row keyed on the same small number.
         self._pair_vers: dict[tuple[str, str], int] = {}
+        # Native-mirror client (scheduler.mirror.MirrorClient): pair bumps
+        # forward to the C-side mirror so its cached rows stale correctly
+        self._mirror = None
         # Federation delta clock (shared semantics: utils/deltaclock.py):
         # every LOCAL mutation (enqueue/forget) stamps its directed edge key
         # with the post-bump coarse `version`, so local_edges_since(w) can
@@ -158,7 +161,12 @@ class NetworkTopology:
 
     def _bump_pair(self, a: str, b: str) -> None:
         key = self._pair_key(a, b)
-        self._pair_vers[key] = self._pair_vers.get(key, 0) + 1
+        ver = self._pair_vers[key] = self._pair_vers.get(key, 0) + 1
+        m = self._mirror
+        if m is not None:
+            # native-mirror delta (ISSUE 19): the mirror's row staleness
+            # check compares against this post-bump pair version
+            m.on_topo_pair(a, b, ver)
 
     def enqueue(self, src_host_id: str, dst_host_id: str, rtt_ms: float) -> None:
         key = (src_host_id, dst_host_id)

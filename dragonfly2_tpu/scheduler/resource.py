@@ -137,11 +137,20 @@ class Host:
         # by (peer.feat_version, host.feat_version) to hit its 10k-rounds/s
         # serving budget (see evaluator.build_pair_features).
         self.feat_version = 0
+        # Native-mirror hook (ISSUE 19): when a MirrorClient is attached the
+        # version bump ALSO pushes this host's filter fields (free slots,
+        # feat version) into the C-side mirror as an incremental delta; None
+        # keeps the bump a bare int increment
+        self._mirror = None
+        self._mirror_slot = -1
         self.created_at = self._clock.monotonic()
         self.updated_at = self.created_at
 
     def bump_feat(self) -> None:
         self.feat_version += 1
+        m = self._mirror
+        if m is not None:
+            m.on_host_feat(self)
 
     @property
     def free_upload_slots(self) -> int:
@@ -202,11 +211,18 @@ class Peer:
         # the depth memo also carries its timestamp (TTL, see depth())
         self._depth_memo = (-1, 0, 0.0)
         self._bad_memo = (-1, False)
+        # see Host._mirror: set by MirrorClient registration; every feature
+        # bump and FSM transition then mirrors natively as a delta
+        self._mirror = None
+        self._mirror_slot = -1
         self.created_at = self._clock.monotonic()
         self.updated_at = self.created_at
 
     def bump_feat(self) -> None:
         self.feat_version += 1
+        m = self._mirror
+        if m is not None:
+            m.on_peer_feat(self)
 
     def _on_transition(self, fsm: FSM, event: str, src: str, dst: str) -> None:
         # int bumps under the FSM's own RLock (and the GIL): exact even when
@@ -217,6 +233,9 @@ class Peer:
             self.task._back_to_source_active = max(
                 0, self.task._back_to_source_active - 1
             )
+        m = self._mirror
+        if m is not None:
+            m.on_peer_state(self, dst)
 
     @property
     def state(self) -> str:
@@ -303,6 +322,10 @@ class Task:
         # FSM callback (Peer._on_transition) + delete_peer below — the O(1)
         # read can_back_to_source() takes on the per-candidate hot path
         self._back_to_source_active = 0
+        # see Host._mirror: DAG edge mutations push the child's full ordered
+        # parent list as a native delta when a MirrorClient is attached
+        self._mirror = None
+        self._mirror_slot = -1
         self.created_at = self._clock.monotonic()
         self.updated_at = self.created_at
 
@@ -368,6 +391,9 @@ class Task:
         child = self.peer(child_id)
         if child:
             child.bump_feat()  # depth changed
+        m = self._mirror
+        if m is not None:
+            m.on_edges(self, child_id)
 
     def can_add_edge(self, parent_id: str, child_id: str) -> bool:
         return self.dag.can_add_edge(parent_id, child_id)
@@ -384,6 +410,9 @@ class Task:
             child = self.peer(child_id)
             if child:
                 child.bump_feat()  # depth changed
+            m = self._mirror
+            if m is not None:
+                m.on_edges(self, child_id)
         except VertexNotFound:
             pass
 
@@ -463,6 +492,9 @@ class ResourcePool:
         # and freshness windows run in simulated time. Hosts/tasks created
         # here carry it; peers inherit their host's.
         self.clock = clock or clockmod.SYSTEM
+        # Native-mirror client (scheduler.mirror.MirrorClient) — set by
+        # MirrorClient.attach; object lifecycle events then mirror natively
+        self._mirror = None
 
     # hosts
     def load_or_create_host(self, host_id: str, ip: str, hostname: str, **kw: Any) -> Host:
@@ -472,6 +504,8 @@ class ResourcePool:
             self.hosts[host_id] = host
             if self._host_list is not None:
                 self._host_list.append(host)
+            if self._mirror is not None:
+                self._mirror.on_host_feat(host)  # registers + first upsert
         host.touch()
         return host
 
@@ -483,8 +517,11 @@ class ResourcePool:
         return self._host_list
 
     def delete_host(self, host_id: str) -> None:
-        if self.hosts.pop(host_id, None) is not None:
+        host = self.hosts.pop(host_id, None)
+        if host is not None:
             self._host_list = None
+            if self._mirror is not None:
+                self._mirror.on_host_remove(host)
 
     # tasks
     def load_or_create_task(self, task_id: str, url: str, **kw: Any) -> Task:
@@ -492,6 +529,8 @@ class ResourcePool:
         if task is None:
             task = Task(task_id, url, clock=self.clock, **kw)
             self.tasks[task_id] = task
+            if self._mirror is not None:
+                self._mirror.on_task_create(task)
         task.touch()
         return task
 
@@ -503,6 +542,8 @@ class ResourcePool:
         peer = Peer(peer_id, task, host)
         task.add_peer(peer)
         self._peer_index[peer_id] = peer
+        if self._mirror is not None:
+            self._mirror.on_peer_create(peer)
         return peer
 
     def peer(self, peer_id: str) -> Peer | None:
@@ -521,6 +562,11 @@ class ResourcePool:
                 child.bump_feat()  # its depth chain changed
             peer.host.bump_feat()
             peer.task.delete_peer(peer_id)
+            # AFTER the DAG detach: the native remove drops the slot from
+            # every adjacency list in place (sibling order preserved, same
+            # as the DAG's set-discard semantics)
+            if self._mirror is not None:
+                self._mirror.on_peer_delete(peer)
 
     def gc(self) -> dict[str, int]:
         """TTL sweep; returns counts removed (wired into utils.gcreg)."""
@@ -534,6 +580,8 @@ class ResourcePool:
         for tid, task in list(self.tasks.items()):
             if task.peer_count() == 0 and now - task.updated_at > self.gc_policy.task_ttl:
                 del self.tasks[tid]
+                if self._mirror is not None:
+                    self._mirror.on_task_remove(task)
                 removed["tasks"] += 1
         for hid, host in list(self.hosts.items()):
             if not host.peer_ids and now - host.updated_at > self.gc_policy.host_ttl:

@@ -300,6 +300,13 @@ class ShadowTracker:
         # per-round delta p99 from the bucketed histogram at snapshot time
         self._min_overlap: float | None = None
         self._delta_counts = [0] * (len(DELTA_BUCKETS) + 1)
+        # population slicing (ISSUE 19 satellite): the same aggregate means
+        # also hide a candidate that diverges ONLY for one child population —
+        # a region whose topology features the candidate re-weights, or the
+        # small-pool regime where one rank flip swings the whole top-k.
+        # Callers pass a slice key ("region|peer-band"); per-slice overlap /
+        # rank-corr accumulate here and the worst slice surfaces in dfmodel.
+        self._slices: dict[str, list[float]] = {}  # key -> [n, ov, corr, delta, min_ov]
 
     def should_sample(self) -> bool:
         """Claim the next round for shadow scoring iff the sampler picks it."""
@@ -311,7 +318,8 @@ class ShadowTracker:
                 return True
             return False
 
-    def record(self, served: np.ndarray, candidate: np.ndarray) -> dict:
+    def record(self, served: np.ndarray, candidate: np.ndarray,
+               slice_key: str | None = None) -> dict:
         d = round_divergence(served, candidate, topk=self.topk)
         delta = d["abs_delta_mean"]
         bucket = len(DELTA_BUCKETS)
@@ -328,6 +336,15 @@ class ShadowTracker:
             ov = d["topk_overlap"]
             self._min_overlap = ov if self._min_overlap is None else min(self._min_overlap, ov)
             self._delta_counts[bucket] += 1
+            if slice_key is not None:
+                s = self._slices.get(slice_key)
+                if s is None:
+                    s = self._slices[slice_key] = [0, 0.0, 0.0, 0.0, ov]
+                s[0] += 1
+                s[1] += ov
+                s[2] += d["rank_corr"]
+                s[3] += delta
+                s[4] = min(s[4], ov)
         self._export_metrics(d)
         return d
 
@@ -373,6 +390,20 @@ class ShadowTracker:
                     "buckets": list(DELTA_BUCKETS),
                     "counts": list(self._delta_counts),
                 },
+                "slices": {
+                    k: {
+                        "rounds": s[0],
+                        "topk_overlap_mean": s[1] / s[0],
+                        "rank_corr_mean": s[2] / s[0],
+                        "abs_delta_mean": s[3] / s[0],
+                        "topk_overlap_min": s[4],
+                    }
+                    for k, s in self._slices.items()
+                },
+                "worst_slice": min(
+                    self._slices, key=lambda k: self._slices[k][1] / self._slices[k][0],
+                    default=None,
+                ),
             }
 
 
@@ -407,7 +438,9 @@ def merge_reports(reports: list[dict]) -> dict:
         "abs_delta_mean": 0.0, "abs_delta_p99": None, "abs_delta_max": 0.0,
         "delta_hist": {"buckets": list(DELTA_BUCKETS),
                        "counts": [0] * (len(DELTA_BUCKETS) + 1)},
+        "slices": {}, "worst_slice": None,
     }
+    slices: dict[str, list[float]] = {}  # key -> [n, ov*n, corr*n, delta*n, min]
     for r in reports:
         n = int(r.get("rounds", 0))
         out["rounds"] += n
@@ -428,6 +461,20 @@ def merge_reports(reports: list[dict]) -> dict:
             out["delta_hist"]["counts"] = [
                 a + int(b) for a, b in zip(out["delta_hist"]["counts"], counts)
             ]
+        # population slices merge like the aggregates: rounds-weighted
+        # means per key, min-of-mins for the worst round within the slice
+        for key, sv in (r.get("slices") or {}).items():
+            sn = int(sv.get("rounds", 0))
+            if sn <= 0:
+                continue
+            acc = slices.setdefault(key, [0, 0.0, 0.0, 0.0, 1.0])
+            acc[0] += sn
+            acc[1] += sv.get("topk_overlap_mean", 0.0) * sn
+            acc[2] += sv.get("rank_corr_mean", 0.0) * sn
+            acc[3] += sv.get("abs_delta_mean", 0.0) * sn
+            mn = sv.get("topk_overlap_min")
+            if mn is not None:
+                acc[4] = min(acc[4], mn)
     n = out["rounds"]
     if n:
         out["topk_overlap_mean"] /= n
@@ -436,6 +483,19 @@ def merge_reports(reports: list[dict]) -> dict:
     # per-round p99 recomputed from the MERGED histogram, not averaged from
     # members' p99s (a quantile of quantiles is not a quantile)
     out["abs_delta_p99"] = delta_hist_quantile(out["delta_hist"]["counts"], 0.99)
+    out["slices"] = {
+        k: {
+            "rounds": a[0],
+            "topk_overlap_mean": a[1] / a[0],
+            "rank_corr_mean": a[2] / a[0],
+            "abs_delta_mean": a[3] / a[0],
+            "topk_overlap_min": a[4],
+        }
+        for k, a in slices.items()
+    }
+    out["worst_slice"] = min(
+        slices, key=lambda k: slices[k][1] / slices[k][0], default=None
+    )
     return out
 
 

@@ -19,6 +19,7 @@ are pure functions over the resource model so they unit-test without mocks.
 from __future__ import annotations
 
 import asyncio
+import ctypes
 import logging
 import os
 import random
@@ -115,16 +116,21 @@ class _RoundArena:
     __slots__ = (
         "rows_cap", "rounds_cap", "k", "feats", "filt", "parent_idx",
         "out_scores", "offsets", "child_idx", "round_cols", "sel", "n_sel",
-        "status", "binding",
+        "status", "binding", "task_slot", "child_slot", "child_host",
+        "blocked_off", "blocked", "blocked_cap", "mbinding",
     )
 
     def __init__(self):
         self.rows_cap = 0
         self.rounds_cap = 0
         self.k = -1
+        self.blocked_cap = 0
         # cached ctypes pointer tuple for drive_rounds (bind_drive); buffers
         # only move on growth, so per-call re-marshalling would be pure waste
         self.binding = None
+        # same contract for the mirror drive's pointer tuple (df_mirror_drive
+        # binds descriptor + blocked + rng buffers on top of the shared ones)
+        self.mbinding = None
 
     def ensure(self, rounds: int, rows: int, k: int) -> None:
         if rows > self.rows_cap:
@@ -135,6 +141,7 @@ class _RoundArena:
             self.out_scores = np.zeros(cap, np.float32)
             self.rows_cap = cap
             self.binding = None
+            self.mbinding = None
         if rounds > self.rounds_cap or k != self.k:
             rcap = max(rounds, 2 * self.rounds_cap, 64)
             self.offsets = np.zeros(rcap + 1, np.int32)
@@ -144,9 +151,27 @@ class _RoundArena:
             self.sel = np.zeros((rcap, max(k, 1)), np.int32)
             self.n_sel = np.zeros(rcap, np.int32)
             self.status = np.zeros(rcap, np.int32)
+            # mirror-drive round descriptors (task/child/child-host slots +
+            # the blocked fence) share the rounds capacity
+            self.task_slot = np.zeros(rcap, np.int32)
+            self.child_slot = np.zeros(rcap, np.int32)
+            self.child_host = np.zeros(rcap, np.int32)
+            self.blocked_off = np.zeros(rcap + 1, np.int32)
             self.rounds_cap = rcap
             self.k = k
             self.binding = None
+            self.mbinding = None
+        if self.blocked_cap == 0:
+            self.blocked = np.zeros(256, np.int32)
+            self.blocked_cap = 256
+            self.mbinding = None
+
+    def ensure_blocked(self, n: int) -> None:
+        if n > self.blocked_cap:
+            cap = max(n, 2 * self.blocked_cap, 256)
+            self.blocked = np.zeros(cap, np.int32)
+            self.blocked_cap = cap
+            self.mbinding = None
 
 
 class Scheduling:
@@ -180,6 +205,28 @@ class Scheduling:
         # instance-local twin of NATIVE_ROUNDS_TOTAL (the global family mixes
         # every service in the process; sim/bench A/Bs need THIS scheduler's)
         self.native_rounds_served = 0
+        # Native mirrored peer table (ISSUE 19): set by MirrorClient wiring
+        # (SchedulerService.enable_native_mirror). When ready, dispatched
+        # batches sample/filter/score against the C-side mirror and Python
+        # only enqueues round descriptors + commits parents.
+        self._mirror = None
+        self.mirror_rounds_served = 0
+        self.mirror_stale_rounds = 0
+        # The candidate-sampling rng stream has ONE authority at a time:
+        # `_rng` (Python truth) or the 625-word MT buffer the native drive
+        # advances in place. `_rng_ahead` says the buffer is ahead; any
+        # serial draw first folds it back (_rng_serial). Steady-state native
+        # batches therefore marshal NOTHING per drive — the getstate/setstate
+        # round-trip (~40 µs) happens only when the serving shape flips.
+        self._rng_lock = threading.Lock()
+        self._rng_buf = (ctypes.c_uint32 * 625)()
+        self._rng_ahead = False
+        # per-stage wall-clock accumulators (ns) for dfstress's round-loop
+        # attribution: snapshot/delta-apply leg, the native drive itself, and
+        # the event-loop commit block (satellite: stage decomposition)
+        self.stage_snapshot_ns = 0
+        self.stage_drive_ns = 0
+        self.stage_commit_ns = 0
         self.dispatcher: RoundDispatcher | None = None
         if self.config.dispatch_workers > 0:
             self.attach_dispatcher(self.config.dispatch_workers)
@@ -244,11 +291,38 @@ class Scheduling:
             or self.evaluator.is_bad_node(p)
         )
 
+    def _rng_serial(self) -> random.Random:
+        """The sampling rng for SERIAL draw sites: folds the native drive's
+        in-place MT advancement back into `_rng` first, so serial and native
+        rounds consume one coherent stream (bit-exact with an all-serial run
+        when the interleaving is quiesced). Callers hold state_lock; the
+        nested rng-lock acquisition is uncontended except across the
+        serving-shape flip itself."""
+        if self._rng_ahead:
+            with self._rng_lock:
+                if self._rng_ahead:
+                    self._rng.setstate((3, tuple(self._rng_buf), None))
+                    self._rng_ahead = False
+        return self._rng
+
+    def rng_state(self):
+        """Current MT19937 state regardless of which side (Python rng or the
+        native drive buffer) last advanced it."""
+        return self._rng_serial().getstate()
+
+    def set_rng_state(self, state) -> None:
+        """Install an rng state, revoking the native buffer's authority —
+        the raw `self._rng.setstate(...)` idiom silently loses the write
+        when a mirror drive left `_rng_ahead` set."""
+        with self._rng_lock:
+            self._rng.setstate(state)
+            self._rng_ahead = False
+
     def _sample_candidates(self, child: Peer, blocklist: set[str]) -> list[Peer]:
         """Sample ≤40 random DAG peers and keep those passing the flattened
         filter pass (one predicate call per candidate, context hoisted)."""
         task = child.task
-        sample = task.dag.random_vertices(self.config.filter_parent_limit, self._rng)
+        sample = task.dag.random_vertices(self.config.filter_parent_limit, self._rng_serial())
         ctx = self._filter_ctx(child, blocklist)
         passes = self._passes
         return [v.value for v in sample if passes(v.value, ctx)]
@@ -321,6 +395,232 @@ class Scheduling:
             return self.find_candidate_parents_batch
         return self.find_candidate_parents_batch_native
 
+    def _find_batch_mirror(
+        self, reqs: list[tuple[Peer, set[str]]], bundle, mirror
+    ) -> list[list[Peer]] | None:
+        """A batch of find rounds against the native mirrored peer table
+        (ISSUE 19): Python's per-round work shrinks to an O(1) descriptor
+        (task/child/child-host slots, blocked-peer slots, the three
+        round-constant feature scalars) — the sample draw, the 8-condition
+        filter, the feature-row gather, scoring, and stable top-k all run
+        inside ONE df_mirror_drive call with the GIL released, against state
+        the mutation hooks keep incrementally synced. No state-lock hold, no
+        peer-pool walk, no snapshot copy.
+
+        Bit-exactness: the C side reproduces `rng.sample`'s draw sequence on
+        the same MT19937 stream (`_rng`'s state lives in the shared 625-word
+        buffer between drives), the mirror's vlist is DAG insertion order,
+        and cached rows carry the same 5-version keys `_export_pair_rows`
+        computes — a stale row flips its round to the UNCHANGED evaluate_many
+        leg (identical scores, records, shadow sampling) and the refreshed
+        rows make the next drive native. Returns None when the batch cannot
+        ride the mirror (pre-drive miss, poisoned client, driver error); the
+        caller falls back to the PR-18 snapshot leg, counted by reason."""
+        from dragonfly2_tpu.scheduler import metrics
+
+        cfg = self.config
+        ev = self.evaluator
+        k = cfg.candidate_parent_limit
+        sample_n = cfg.filter_parent_limit
+        max_depth = cfg.max_tree_depth
+        M = len(reqs)
+        t_snap0 = time.perf_counter_ns()
+        arena = self._arena()
+        arena.ensure(M, M * sample_n, k)
+        task_slot = arena.task_slot
+        child_slot = arena.child_slot
+        child_host = arena.child_host
+        blocked_off = arena.blocked_off
+        round_cols = arena.round_cols
+        peer_slot = mirror.peer_slot
+        blocked_list: list[int] = []
+        for r, (child, blocklist) in enumerate(reqs):
+            cs = child._mirror_slot
+            ts = child.task._mirror_slot
+            hs = child.host._mirror_slot
+            if cs < 0 or ts < 0 or hs < 0:
+                # an unmirrored object would consume no native rng for its
+                # round, reordering the stream vs the serial leg — bail on
+                # the WHOLE batch pre-drive so the fallback stays bit-exact
+                metrics.NATIVE_MIRROR_FALLBACK_TOTAL.inc(
+                    float(M), reason="mirror_miss"
+                )
+                return None
+            child_slot[r] = cs
+            task_slot[r] = ts
+            child_host[r] = hs
+            round_cols[r] = _round_col_values(child)
+            blocked_off[r] = len(blocked_list)
+            for pid in blocklist | child.block_parents:
+                s = peer_slot(pid)
+                if s >= 0:  # unmirrored ids cannot be drawn natively anyway
+                    blocked_list.append(s)
+        blocked_off[M] = len(blocked_list)
+        arena.ensure_blocked(len(blocked_list))
+        if blocked_list:
+            arena.blocked[: len(blocked_list)] = blocked_list
+        self.stage_snapshot_ns += time.perf_counter_ns() - t_snap0
+
+        status = arena.status
+        t_drv0 = time.perf_counter_ns()
+        bundle.begin()
+        try:
+            scorer = bundle.thread_scorer()
+            # drives serialize on the rng lock: there is ONE sampling stream,
+            # and holding it across sync_bundle + drive also guarantees a
+            # concurrent hot-swap can never mix two bundles' node indices
+            # inside one batch
+            with self._rng_lock:
+                if not mirror.sync_bundle(bundle):
+                    return None  # poisoned mid-sync (counted)
+                mb = arena.mbinding
+                if mb is None:
+                    mb = arena.mbinding = mirror.native.bind_drive(
+                        arena.task_slot, arena.child_slot, arena.child_host,
+                        arena.blocked_off, arena.blocked, arena.round_cols,
+                        self._rng_buf, arena.offsets, arena.parent_idx,
+                        arena.feats, arena.out_scores, arena.sel,
+                        arena.n_sel, arena.status,
+                    )
+                if not self._rng_ahead:
+                    self._rng_buf[:] = self._rng.getstate()[1]
+                    self._rng_ahead = True
+                try:
+                    mirror.native.drive_bound(
+                        scorer, mb, rounds=M, sample_n=sample_n, k=k,
+                        max_depth=max_depth, row_cap=arena.rows_cap,
+                    )
+                except Exception:
+                    # the C side validates arguments BEFORE any rng draw, so
+                    # a rejected batch leaves the stream untouched and the
+                    # snapshot leg replays it bit-exactly
+                    logger.exception(
+                        "native mirror drive failed; batch re-runs on the "
+                        "snapshot leg"
+                    )
+                    metrics.NATIVE_MIRROR_FALLBACK_TOTAL.inc(
+                        float(M), reason="driver_error"
+                    )
+                    return None
+        finally:
+            bundle.end()
+        self.stage_drive_ns += time.perf_counter_ns() - t_drv0
+
+        t_out0 = time.perf_counter_ns()
+        sel = arena.sel
+        n_sel = arena.n_sel
+        offsets = arena.offsets
+        cand_slots = arena.parent_idx
+        out_scores = arena.out_scores
+        feats = arena.feats
+        peer_by_slot = mirror.peer_by_slot
+        outs: list[list[Peer]] = [[] for _ in reqs]
+        native_items = []
+        native_count = 0
+        stale_rounds: list[tuple[int, list[Peer]]] = []  # status 2: push rows
+        serial_rounds: list[tuple[int, list[Peer]]] = []  # status 1: no push
+        miss_rounds: list[int] = []  # status 3: full serial re-run
+        dropped = 0
+        rounds_cands: list[tuple[list, bool]] = []
+        with self.state_lock:
+            # one lock hold maps every survivor slot back to its Peer; a
+            # slot whose peer was deleted (and possibly recycled) mid-drive
+            # is dropped here — commit re-validation bounds anything that
+            # slips through the tiny drive→map window
+            for r in range(M):
+                lo, hi = int(offsets[r]), int(offsets[r + 1])
+                cands: list = []
+                holes = False
+                for j in range(lo, hi):
+                    s = int(cand_slots[j])
+                    p = peer_by_slot(s)
+                    if p is None or p._mirror_slot != s:
+                        cands.append(None)
+                        holes = True
+                        dropped += 1
+                    else:
+                        cands.append(p)
+                rounds_cands.append((cands, holes))
+        for r in range(M):
+            st = int(status[r])
+            cands, holes = rounds_cands[r]
+            if st == 3:
+                miss_rounds.append(r)
+                continue
+            if not cands:
+                continue  # sampled empty: outs[r] stays [] (serial-identical)
+            if st == 0:
+                sel_r = sel[r]
+                chosen = [cands[sel_r[j]] for j in range(int(n_sel[r]))]
+                outs[r] = [p for p in chosen if p is not None]
+                native_count += 1
+                if not holes:
+                    lo, hi = int(offsets[r]), int(offsets[r + 1])
+                    native_items.append(
+                        (reqs[r][0], cands, feats[lo:hi], out_scores[lo:hi])
+                    )
+            else:
+                live = [p for p in cands if p is not None]
+                if not live:
+                    continue
+                if st == 2:
+                    stale_rounds.append((r, live))
+                else:
+                    serial_rounds.append((r, live))
+        if miss_rounds:
+            # a mirrored object vanished between the pre-check and its round
+            # (concurrent delete): the native drive drew no rng for it, so
+            # the full serial find replays it — the stream reorders across
+            # the batch boundary, which only a quiesced equivalence run
+            # could observe (and there this path cannot trigger)
+            metrics.NATIVE_MIRROR_FALLBACK_TOTAL.inc(
+                float(len(miss_rounds)), reason="mirror_miss"
+            )
+            fb = self.find_candidate_parents_batch(
+                [reqs[r] for r in miss_rounds]
+            )
+            for r, o in zip(miss_rounds, fb):
+                outs[r] = o
+        score_list = sorted(stale_rounds + serial_rounds)
+        if score_list:
+            # stale/unknown-child rounds score on the UNCHANGED serial leg —
+            # same survivors the drive produced, same scores, records,
+            # shadow sampling and fallback taxonomy as evaluate_many always
+            scores = ev.evaluate_many(
+                [(reqs[r][0], cands) for r, cands in score_list]
+            )
+            for (r, cands), s in zip(score_list, scores):
+                outs[r] = self._top_parents(reqs[r][0], cands, s)
+        if stale_rounds and ev.feature_builder is build_pair_features:
+            # refresh the mirror's rows from the Python cache the serial
+            # scoring just (re)built: the next drive on unchanged versions
+            # goes fully native — O(changed entries), never a full re-export.
+            # A NON-default feature builder (the sim's uncached override, the
+            # bench's rowwise A/B) must never seed the native cache: a later
+            # native round would score default-builder rows where the serial
+            # leg would call the override — so those deployments stay on the
+            # stale leg (native sample/filter, serial scoring) by design.
+            for r, cands in stale_rounds:
+                mirror.push_round_rows(reqs[r][0], cands)
+        self.stage_snapshot_ns += time.perf_counter_ns() - t_out0
+        if native_count:
+            metrics.NATIVE_ROUNDS_TOTAL.inc(float(native_count))
+            metrics.NATIVE_MIRROR_ROUNDS_TOTAL.inc(float(native_count))
+            self.native_rounds_served += native_count
+            self.mirror_rounds_served += native_count
+        if stale_rounds:
+            metrics.NATIVE_MIRROR_STALE_ROUNDS_TOTAL.inc(float(len(stale_rounds)))
+            self.mirror_stale_rounds += len(stale_rounds)
+        if dropped:
+            metrics.NATIVE_MIRROR_FALLBACK_TOTAL.inc(
+                float(dropped), reason="slot_race"
+            )
+        if native_items:
+            # observability tail: drift folds, mode-honest sampled decision
+            # records (copy-on-record — these are arena views), batched shadow
+            ev.finish_native_rounds(native_items, bundle)
+        return outs
+
     def find_candidate_parents_batch_native(
         self, reqs: list[tuple[Peer, set[str]]]
     ) -> list[list[Peer]]:
@@ -350,6 +650,21 @@ class Scheduling:
             # ready, or brownout rung 3) — the whole batch is the serial leg
             metrics.NATIVE_ROUND_FALLBACK_TOTAL.inc(len(reqs), reason="no_native")
             return self.find_candidate_parents_batch(reqs)
+        mirror = self._mirror
+        if mirror is not None:
+            if mirror.ready:
+                out = self._find_batch_mirror(reqs, bundle, mirror)
+                if out is not None:
+                    return out
+                # mirror refused the batch (pre-drive miss, driver error) —
+                # fall through to the snapshot-under-lock leg below; the
+                # refusal was counted with its reason
+            elif mirror.poisoned:
+                # a poisoned mirror is never silent: every batch that would
+                # have ridden it counts its Python fallback until re-attach
+                metrics.NATIVE_MIRROR_FALLBACK_TOTAL.inc(
+                    float(len(reqs)), reason="poisoned"
+                )
         cfg = self.config
         node_index = bundle.node_index
         k = cfg.candidate_parent_limit
@@ -373,6 +688,7 @@ class Scheduling:
         cands_per_round: list[list[Peer]] = []
         t = 0
         offsets[0] = 0
+        t_snap0 = time.perf_counter_ns()
         for r, (child, blocklist) in enumerate(reqs):
             with self.state_lock:
                 # identical rng consumption and filter semantics to
@@ -380,7 +696,7 @@ class Scheduling:
                 # fields (state code, free slots, depth) snapshotted in the
                 # same pass — same lock scope as the serial leg
                 sample = child.task.dag.random_vertices(
-                    cfg.filter_parent_limit, self._rng
+                    cfg.filter_parent_limit, self._rng_serial()
                 )
                 child_id, child_host_id, block, lineage = self._filter_ctx(
                     child, blocklist
@@ -432,9 +748,11 @@ class Scheduling:
                         child, cands, ev.topology, ev.bandwidth
                     )
             offsets[r + 1] = t
+        self.stage_snapshot_ns += time.perf_counter_ns() - t_snap0
 
         status = arena.status
         driver_failed = False
+        t_drv0 = time.perf_counter_ns()
         if t > 0:
             bundle.begin()
             try:
@@ -463,6 +781,7 @@ class Scheduling:
                 bundle.end()
         else:
             status[:M] = 0  # every round sampled empty — nothing to score
+        self.stage_drive_ns += time.perf_counter_ns() - t_drv0
 
         outs: list[list[Peer]] = [[] for _ in reqs]
         native_items = []
@@ -578,6 +897,7 @@ class Scheduling:
                 # either none or all of this round's edges, never half.
                 task = child.task
                 committed = []
+                t_commit0 = time.perf_counter_ns()
                 with self.state_lock:
                     task.delete_parents(child.id)
                     for p in parents:
@@ -588,6 +908,7 @@ class Scheduling:
                         except DAGError:
                             continue  # raced into a cycle/duplicate; skip
                         committed.append(p)
+                self.stage_commit_ns += time.perf_counter_ns() - t_commit0
                 if committed:
                     child.schedule_rounds += 1
                     return ScheduleOutcome(parents=committed, rounds=attempt + 1)

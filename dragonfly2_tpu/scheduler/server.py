@@ -42,6 +42,7 @@ async def run_scheduler(
     location: str = "",
     scheduling_config=None,
     gc_policy=None,
+    degradation_budgets: dict | None = None,
     ready_event: asyncio.Event | None = None,
 ) -> None:
     from dragonfly2_tpu.scheduler.evaluator import new_evaluator
@@ -72,7 +73,9 @@ async def run_scheduler(
     # shedding modes under sustained pressure instead of timing out opaquely
     from dragonfly2_tpu.scheduler.degradation import DegradationController
 
-    degradation = DegradationController()
+    # pressure budgets come from the `degradation:` YAML section (ISSUE 19
+    # satellite — no longer hard-coded here); None = the section defaults
+    degradation = DegradationController(**(degradation_budgets or {}))
     degradation.attach_loop_monitor(loop_monitor)
     if service.scheduling.dispatcher is not None:
         degradation.attach_dispatcher(service.scheduling.dispatcher)
@@ -295,6 +298,7 @@ def main() -> None:
             location=args.location,
             scheduling_config=cfg.scheduling_config(),
             gc_policy=cfg.gc_policy(),
+            degradation_budgets=cfg.degradation.controller_kwargs(),
         )
     )
 

@@ -197,8 +197,43 @@ class SchedulerService:
         self.degradation = controller
         self.evaluator.degradation = controller
 
+    def enable_native_mirror(self):
+        """Opt in to the native mirrored peer table (ISSUE 19): build a
+        MirrorClient over the serving bundle's C++ scorer, full-sync it from
+        the current pool under the state lock, and wire the mutation hooks
+        (resource pool, topology, bandwidth) so every version bump streams an
+        incremental delta to the C side. Subsequent native batches sample,
+        filter, gather and score without the snapshot-under-lock leg.
+
+        Explicit opt-in (sim native legs, dfstress, the check.sh smoke,
+        tests) rather than default-on: the mirror changes no results, but a
+        deployment that never measured it shouldn't silently grow a C-side
+        copy of its peer table. Returns the MirrorClient, or None when the
+        evaluator has no eligible native bundle (base evaluator, jax
+        fallback, brownout at base_only)."""
+        entry = getattr(self.evaluator, "native_round_entry", None)
+        bundle = entry() if entry is not None else None
+        if bundle is None:
+            return None
+        old = self.scheduling._mirror
+        if old is not None:
+            old.close()
+            self.scheduling._mirror = None  # dflint: disable=DF036 lifecycle owner: unwiring the replaced client before attaching its successor
+        from dragonfly2_tpu.scheduler.mirror import MirrorClient
+
+        client = MirrorClient(bundle.scorer)
+        with self.state_lock:
+            client.attach(self.pool, self.evaluator)
+        self.scheduling._mirror = client  # dflint: disable=DF036 lifecycle owner: the one designated attach site (client just full-synced under the state lock)
+        return client
+
     def close(self) -> None:
-        """Release dispatcher worker threads (no-op in serial mode)."""
+        """Release dispatcher worker threads (no-op in serial mode) and the
+        native mirror, when one was enabled."""
+        m = self.scheduling._mirror
+        if m is not None:
+            self.scheduling._mirror = None  # dflint: disable=DF036 lifecycle owner: deliberate unwiring at service close
+            m.close()
         self.scheduling.close()
 
     # ---- registration (ref handleRegisterPeerRequest → schedule()) ----

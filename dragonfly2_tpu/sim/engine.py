@@ -158,6 +158,13 @@ class SimReport:
     sched_s: float = 0.0
     sched_rounds_per_s: float = 0.0
     native_rounds: int = 0
+    # ISSUE 19: rounds the mirrored peer table drove (cached-row fast path /
+    # stale-revalidated — both run sample+filter+score in C and count toward
+    # native_rounds coverage) and the full-export counter, which must stay at
+    # 1 per scheduler (the attach): steady state is deltas or it's a bug
+    mirror_rounds: int = 0
+    mirror_stale_rounds: int = 0
+    mirror_full_syncs: int = 0
 
 
 class _SimPeer:
@@ -471,6 +478,15 @@ class Simulation:
         svc.evaluator.attach_scorer(
             scorer, _ModNodeIndex(scorer.num_nodes), version="sim-synthetic"
         )
+        if self._scoring == "ml-native":
+            # ISSUE 19: the native leg rides the mirrored peer table — the
+            # sim's registration/departure churn streams deltas through the
+            # resource-pool hooks, and rounds sample+filter natively. Row
+            # caching stays cold here (each (parent, child-host) pair is
+            # scheduled at most once AND the uncached builder is active, so
+            # the stale leg's serial scoring is the steady state) — the win
+            # is the snapshot/sample/filter leg leaving Python.
+            svc.enable_native_mirror()
 
     def _for_host(self, host_id: str):
         return self.clients[self.ring.pick(host_id)]
@@ -1022,9 +1038,21 @@ class Simulation:
         rep.sched_s = round(sum(c.total for c in sched_child), 3)
         if rep.sched_s > 0:
             rep.sched_rounds_per_s = round(rep.sched_rounds / rep.sched_s, 1)
-        rep.native_rounds = sum(
-            svc.scheduling.native_rounds_served for svc in self.services.values()
-        )
+        for svc in self.services.values():
+            sched = svc.scheduling
+            rep.mirror_rounds += sched.mirror_rounds_served
+            rep.mirror_stale_rounds += sched.mirror_stale_rounds
+            rep.native_rounds += (
+                sched.native_rounds_served
+                + sched.mirror_rounds_served
+                + sched.mirror_stale_rounds
+            )
+            client = sched._mirror
+            if client is not None and client.ready:
+                try:
+                    rep.mirror_full_syncs += int(client.stats()["full_syncs"])
+                except Exception:  # noqa: BLE001  # dflint: disable=DF031 teardown best-effort: a stats read must not clobber the finished report
+                    pass
         for scorer in self._scorers:
             try:
                 scorer.close()

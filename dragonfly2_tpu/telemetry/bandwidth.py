@@ -46,6 +46,9 @@ class BandwidthHistory:
         # parent. Monotonic, never deleted (see NetworkTopology._pair_vers
         # for the id-recycling rationale).
         self._parent_vers: dict[str, int] = {}
+        # Native-mirror client (scheduler.mirror.MirrorClient): parent bumps
+        # forward to the C-side mirror so its cached rows stale correctly
+        self._mirror = None
         # Federation delta clock + merged remote view (same contract as
         # NetworkTopology — shared semantics in utils/deltaclock.py): local
         # observes stamp their pair key with the post-bump coarse version;
@@ -73,7 +76,12 @@ class BandwidthHistory:
         return self._parent_vers.get(parent_host_id, 0)
 
     def _bump_parent(self, parent_host_id: str) -> None:
-        self._parent_vers[parent_host_id] = self._parent_vers.get(parent_host_id, 0) + 1
+        ver = self._parent_vers[parent_host_id] = self._parent_vers.get(parent_host_id, 0) + 1
+        m = self._mirror
+        if m is not None:
+            # native-mirror delta (ISSUE 19): post-bump version keys the
+            # mirror's row staleness check for every pair this parent serves
+            m.on_bw_parent(parent_host_id, ver)
 
     def observe(self, parent_host_id: str, child_host_id: str, bps: float) -> None:
         if not parent_host_id or not np.isfinite(bps) or bps <= 0:

@@ -66,6 +66,44 @@ def test_field_precise_rejection(tmp_path, yaml_text, path_frag):
     assert path_frag in str(ei.value)
 
 
+def test_degradation_budgets_from_yaml(tmp_path):
+    """Brownout pressure budgets (ISSUE 19 satellite): the ladder's lag /
+    utilization / queue budgets load from the `degradation:` section,
+    validate their ranges, and reach a DegradationController verbatim."""
+    from dragonfly2_tpu.scheduler.degradation import DegradationController
+
+    cfg = load_config(SchedulerYaml)
+    assert cfg.degradation.lag_budget_ms == 250.0
+    assert cfg.degradation.utilization_budget == 0.95
+    assert cfg.degradation.queue_budget == 64.0
+
+    f = tmp_path / "s.yaml"
+    f.write_text(
+        """
+degradation:
+  lag_budget_ms: 500
+  utilization_budget: 0.8
+  queue_budget: 256
+"""
+    )
+    cfg = load_config(SchedulerYaml, f)
+    ctl = DegradationController(**cfg.degradation.controller_kwargs())
+    assert ctl.lag_budget_ms == 500.0
+    assert ctl.utilization_budget == pytest.approx(0.8)
+    assert ctl.queue_budget == 256.0
+
+    for bad, frag in [
+        ("degradation:\n  lag_budget_ms: 0\n", "degradation.lag_budget_ms"),
+        ("degradation:\n  utilization_budget: 1.5\n", "degradation.utilization_budget"),
+        ("degradation:\n  queue_budget: -4\n", "degradation.queue_budget"),
+        ("degradation:\n  typo_budget: 1\n", "degradation.typo_budget"),
+    ]:
+        f.write_text(bad)
+        with pytest.raises(ConfigError) as ei:
+            load_config(SchedulerYaml, f)
+        assert frag in str(ei.value)
+
+
 def test_daemon_schema_sections(tmp_path):
     f = tmp_path / "d.yaml"
     f.write_text(

@@ -1025,6 +1025,88 @@ def test_df035_suppression_with_reason():
 
 
 # ---------------------------------------------------------------------------
+# DF036 mirrored state mutated outside its invalidation hooks
+
+
+def test_df036_fires_on_direct_feat_version_write():
+    src = """
+    def refresh(peer):
+        peer.feat_version += 1
+        peer.host.feat_version = 7
+    """
+    path = "dragonfly2_tpu/scheduler/service.py"
+    assert ids(src, path) == ["DF036"]
+    assert lines(src, path) == [3, 4]
+
+
+def test_df036_fires_on_dag_adjacency_mutators():
+    src = """
+    def rewire(task, child, pid):
+        task.dag.vertex(child).parents.add(pid)
+        task.dag.vertex(child).children.discard(pid)
+    """
+    assert ids(src, "dragonfly2_tpu/scheduler/service.py") == ["DF036"]
+
+
+def test_df036_fires_on_mirror_registration_write():
+    src = """
+    def hijack(peer):
+        peer._mirror_slot = 3
+    """
+    assert ids(src, "dragonfly2_tpu/scheduler/service.py") == ["DF036"]
+
+
+def test_df036_silent_on_init_declaration_and_bump_feat():
+    # the __init__-scope None declaration and the hook-firing mutator are
+    # the sanctioned shapes
+    src = """
+    class Host:
+        def __init__(self):
+            self._mirror = None
+            self._mirror_slot = -1
+
+        def bump_feat(self):
+            touch(self.feat_version)
+    """
+    assert ids(src, "dragonfly2_tpu/scheduler/service.py") == []
+
+
+def test_df036_silent_on_list_shaped_parents():
+    # ScheduleResult.parents / record["parents"] are lists: append/extend
+    # are not set mutators and Name-rooted accesses are not adjacency
+    src = """
+    def collect(out, parents):
+        out.parents.append(parents[0])
+        parents.clear()
+    """
+    assert ids(src, "dragonfly2_tpu/scheduler/service.py") == []
+
+
+def test_df036_exempt_paths():
+    src = """
+    def surgical(v, pid):
+        v.parents.discard(pid)
+        v.feat_version = 1
+    """
+    for path in (
+        "dragonfly2_tpu/scheduler/resource.py",
+        "dragonfly2_tpu/scheduler/mirror.py",
+        "dragonfly2_tpu/utils/dag.py",
+        "dragonfly2_tpu/native/scorer.py",
+        "tests/test_mirror.py",
+    ):
+        assert ids(src, path) == [], path
+
+
+def test_df036_suppression_with_reason():
+    src = """
+    def toggle(sched, client):
+        sched._mirror = client  # dflint: disable=DF036 A/B leg toggle of the one attached client
+    """
+    assert ids(src, "dragonfly2_tpu/cli/dfstress.py") == []
+
+
+# ---------------------------------------------------------------------------
 # DF028 dead metric family (cross-file: run_sources, not lint_source)
 
 

@@ -1,0 +1,376 @@
+"""Native mirrored peer table (ISSUE 19): delta-fed bit-exactness, the
+sample-draw reproduction contract, chaos under concurrent mutation with a
+mid-round hot-swap, and the poison discipline (a broken hook is never silent).
+
+The mirror's whole claim is "the C side IS the scheduler's candidate state":
+every test here compares against the unchanged serial Python leg on an
+identical twin service, from the same MT19937 state, so any drift in the
+mirror's deltas, sampling, filtering, row cache, or top-k shows up as a
+parent-list mismatch — not a statistic.
+"""
+
+from __future__ import annotations
+
+import array
+import ctypes
+import random
+
+import numpy as np
+import pytest
+
+from dragonfly2_tpu.scheduler import metrics
+from dragonfly2_tpu.scheduler.service import SchedulerService
+
+from test_round_driver import _artifact, _close, _ml_pair, build_pool
+
+pytestmark = pytest.mark.concurrency
+
+needs_gxx = pytest.mark.skipif(
+    __import__("shutil").which("g++") is None, reason="g++ not available"
+)
+
+
+@pytest.fixture(autouse=True)
+def _exact_depth(monkeypatch):
+    """Quiesced equivalence wants truth, not a ≤1s-stale depth memo: the
+    mirror recomputes depth from live adjacency on every drive, so the
+    Python leg must too."""
+    from dragonfly2_tpu.scheduler import resource
+
+    monkeypatch.setattr(resource.Peer, "_DEPTH_MEMO_TTL_S", 0.0)
+
+
+def _run_matched_mirror(sched_a, sched_b, reqs_a, reqs_b):
+    """Serial batch on A, mirror-backed native batch on B, same rng state.
+    Uses the public rng accessors: the mirror leg advances the shared
+    native rng buffer, and set_rng_state is the only write that cannot be
+    silently lost to a later buffer fold."""
+    sched_b.set_rng_state(sched_a.rng_state())
+    serial = sched_a.find_candidate_parents_batch(reqs_a)
+    native = sched_b.find_candidate_parents_batch_native(reqs_b)
+    return (
+        [[p.id for p in out] for out in serial],
+        [[p.id for p in out] for out in native],
+    )
+
+
+def _mutate_pool(svc, children):
+    """The same deterministic mutation storm on either twin: feature bumps,
+    state transitions, NEW hosts + peers (outside the 64-entry node index,
+    so serial and mirror must take the unknown-host fallback identically),
+    and topology/bandwidth version bumps."""
+    task = next(iter(svc.pool.tasks.values()))
+    peers = sorted(task.dag.values(), key=lambda p: p.id)
+    r = random.Random(1234)
+    for p in r.sample(peers, 10):
+        p.add_piece_cost(r.uniform(1.0, 20.0))
+        p.bump_feat()
+    for p in r.sample(peers, 4):
+        if p.fsm.can("download_succeeded"):
+            p.fsm.fire("download_succeeded")
+    for i in range(8):
+        h = svc.pool.load_or_create_host(
+            f"hx-{i}", f"10.9.9.{i}", f"hostx{i}", download_port=8000,
+        )
+        h.upload_limit = 1000
+        p = svc.pool.create_peer(f"peerx-{i}", task, h)
+        for evn in ("register", "download"):
+            if p.fsm.can(evn):
+                p.fsm.fire(evn)
+        for idx in range(3):
+            p.finished_pieces.set(idx)
+        p.bump_feat()
+    for c in children:
+        svc.topology.enqueue(c.host.id, "hx-0", r.uniform(0.2, 30.0))
+        svc.bandwidth.observe("hx-1", c.host.id, r.uniform(1e8, 1e9))
+
+
+@needs_gxx
+class TestMirrorEquivalence:
+    @pytest.mark.parametrize("seed", [3, 7])
+    def test_live_deltas_bit_identical(self, tmp_path, seed):
+        """Create → drive → mutate → drive → delete → drive, with exactly
+        ONE full sync at attach: every later round runs against hook-fed
+        deltas, and per-round parent lists stay identical to the serial
+        leg through all three phases."""
+        svc_a, svc_b, ch_a, ch_b, scs = _ml_pair(tmp_path, seed=seed)
+        sched_a, sched_b = svc_a.scheduling, svc_b.scheduling
+        client = svc_b.enable_native_mirror()
+        assert client is not None and client.ready, client and client.poison_reason
+
+        for _trial in range(6):
+            ids_s, ids_n = _run_matched_mirror(
+                sched_a, sched_b,
+                [(c, set()) for c in ch_a], [(c, set()) for c in ch_b],
+            )
+            assert ids_s == ids_n
+        st = client.stats()
+        # the first drive finds no cached rows (stale → evaluate_many →
+        # push), later drives go fully native; never a second full sync
+        assert st["full_syncs"] == 1
+        assert st["native_rounds"] > 0
+        assert sched_b.mirror_rounds_served > 0
+
+        _mutate_pool(svc_a, ch_a)
+        _mutate_pool(svc_b, ch_b)
+        assert client.ready, client.poison_reason
+        for _trial in range(4):
+            ids_s, ids_n = _run_matched_mirror(
+                sched_a, sched_b,
+                [(c, set()) for c in ch_a], [(c, set()) for c in ch_b],
+            )
+            assert ids_s == ids_n
+
+        for svc in (svc_a, svc_b):
+            for pid in [f"peerx-{i}" for i in range(4)]:
+                svc.pool.delete_peer(pid)
+        assert client.ready, client.poison_reason
+        ids_s, ids_n = _run_matched_mirror(
+            sched_a, sched_b,
+            [(c, set()) for c in ch_a], [(c, set()) for c in ch_b],
+        )
+        assert ids_s == ids_n
+        assert client.stats()["full_syncs"] == 1  # still: deltas only
+        _close(*scs, svc_a, svc_b)
+
+    def test_explain_replays_mirror_round_bit_exact(self, tmp_path):
+        """Decision records from mirror-driven rounds are mode-honest and
+        replay bit-exact through dfml's explain path — the audit trail
+        survives the snapshot leg's removal."""
+        from dragonfly2_tpu.cli import dfml
+
+        svc_a, svc_b, _ch_a, ch_b, scs = _ml_pair(
+            tmp_path, seed=8, decision_sample_rate=1.0
+        )
+        client = svc_b.enable_native_mirror()
+        assert client is not None
+        sched_b = svc_b.scheduling
+        # warm batches: each drive samples a different candidate subset, so
+        # the row cache fills over a few rounds (stale leg pushes refreshed
+        # rows) until drives go fully native against the mirror
+        for _ in range(6):
+            sched_b.find_candidate_parents_batch_native(
+                [(c, set()) for c in ch_b]
+            )
+        assert sched_b.mirror_rounds_served > 0
+        doc = svc_b.decision_records()
+        assert doc["records"], doc["recorder"]
+        for r in doc["records"]:
+            assert r["serving_mode"] == "native"
+            assert r["model_version"] == "rd-8"
+            replayed = [
+                r["parents"][i]["peer"]
+                for i in dfml.replay_topk(r["scores"], r["topk"])
+            ]
+            assert replayed == r["chosen"]
+            assert dfml.explain_record(r) is True
+        _close(*scs, svc_a, svc_b)
+
+
+@needs_gxx
+class TestSampleReproduction:
+    def test_native_draw_matches_random_sample(self, tmp_path):
+        """The mirror's sampler reproduces `random.Random.sample`'s draw
+        sequence bit-for-bit across BOTH CPython strategies — pool
+        partial-shuffle (small n) and selection-set rejection (large n) —
+        and leaves the rng buffer exactly where Python's rng would be."""
+        from dragonfly2_tpu.models.features import FEATURE_DIM
+        from dragonfly2_tpu.native.scorer import NativeMirror, NativeScorer
+
+        sc = NativeScorer(_artifact(tmp_path, seed=3))
+        try:
+            # setsize for k=40 is 277: n=277 partial-shuffles, n=278 rejects
+            for n in (5, 41, 277, 278, 600):
+                for sn in (2, 20, 40):
+                    if sn >= n:
+                        continue
+                    mm = NativeMirror(sc)
+                    try:
+                        assert mm.task_upsert_fn(mm.handle, 0) == 0
+                        one = ctypes.c_int64(1)
+                        assert mm.host_upsert_fn(mm.handle, 0, one, 1, 0) == 0
+                        assert mm.host_upsert_fn(mm.handle, 1, one, 1, 1) == 0
+                        # child = peer 0 on host 1; candidates 1..n-1 on
+                        # host 0 all pass the filter
+                        assert mm.peer_add_fn(mm.handle, 0, 0, 1, 0, 0, one) == 0
+                        for i in range(1, n):
+                            assert mm.peer_add_fn(
+                                mm.handle, i, 0, 0, 0, 0, one
+                            ) == 0
+                        seed = n * 1000 + sn
+                        r_ref = random.Random(seed)
+                        r_drv = random.Random(seed)
+                        buf = (ctypes.c_uint32 * 625)(
+                            *array.array("I", r_drv.getstate()[1])
+                        )
+                        off = np.zeros(2, np.int32)
+                        cand = np.zeros(sn, np.int32)
+                        stt = np.zeros(1, np.int32)
+                        b = mm.bind_drive(
+                            np.zeros(1, np.int32), np.zeros(1, np.int32),
+                            np.ones(1, np.int32), np.array([0, 0], np.int32),
+                            np.array([0], np.int32),
+                            np.zeros((1, 3), np.float32), buf,
+                            off, cand, np.zeros((sn, FEATURE_DIM), np.float32),
+                            np.zeros(sn, np.float32),
+                            np.zeros((1, sn), np.int32),
+                            np.zeros(1, np.int32), stt,
+                        )
+                        mm.drive_bound(sc, b, rounds=1, sample_n=sn, k=sn,
+                                       max_depth=4, row_cap=sn)
+                        draw = r_ref.sample(list(range(n)), sn)
+                        want = [p for p in draw if p != 0]  # child excluded
+                        # no cached rows → stale unless nothing survived
+                        assert stt[0] == (2 if want else 0), (n, sn, stt[0])
+                        assert list(cand[: off[1]]) == want, (n, sn)
+                        after = random.Random()
+                        after.setstate((3, tuple(int(x) for x in buf), None))
+                        assert after.getstate() == r_ref.getstate(), (n, sn)
+                    finally:
+                        mm.close()
+        finally:
+            sc.close()
+
+
+@needs_gxx
+class TestMirrorChaos:
+    def test_hammer_with_hot_swap_preserves_serial_semantics(self, tmp_path, run):
+        """Dispatcher workers drive mirror-backed batches while probe syncs,
+        piece reports, and failure reports stream deltas — and a serving
+        hot-swap lands mid-run (new bundle identity → node-index re-push on
+        the next drive, serialized with drives by the rng lock). Quiesced,
+        every child's next round is bit-identical between the serial leg
+        and the mirror, on the same pool state, from the same rng state."""
+        import asyncio
+
+        from dragonfly2_tpu.native import NativeScorer
+        from dragonfly2_tpu.scheduler.evaluator import new_evaluator
+        from dragonfly2_tpu.scheduler.scheduling import SchedulingConfig
+
+        async def body():
+            ev = new_evaluator("ml")
+            svc = SchedulerService(
+                evaluator=ev,
+                scheduling_config=SchedulingConfig(dispatch_workers=2),
+            )
+            task, children, parents = build_pool(svc, n_hosts=40, n_children=6)
+            sc = NativeScorer(_artifact(tmp_path, seed=12))
+            sc2 = NativeScorer(_artifact(tmp_path, seed=13))
+            ni = {p.host.id: i % 64 for i, p in enumerate(parents + children)}
+            ev.attach_scorer(sc, ni, version="rd-hammer")
+            client = svc.enable_native_mirror()
+            assert client is not None and client.ready
+            sched = svc.scheduling
+            rng = random.Random(7)
+            stop = asyncio.Event()
+
+            async def round_driver(child):
+                while not stop.is_set():
+                    out = await sched.schedule_candidate_parents(child)
+                    for p in out.parents:
+                        assert p.id != child.id and p.host.id != child.host.id
+                    await asyncio.sleep(0)
+
+            async def mutator():
+                for i in range(120):
+                    kind = i % 3
+                    if kind == 0:
+                        svc.sync_probes(
+                            rng.choice(children).host.id,
+                            [{"dst_host_id": rng.choice(parents).host.id,
+                              "rtt_ms": rng.uniform(0.2, 40.0)}],
+                        )
+                    elif kind == 1:
+                        svc.report_pieces(
+                            rng.choice(children).id,
+                            [(rng.randrange(0, 256), rng.uniform(1, 30),
+                              rng.choice(parents).id)],
+                        )
+                    else:
+                        svc.report_piece_result(
+                            rng.choice(children).id, rng.randrange(0, 256),
+                            success=False, parent_id=rng.choice(parents).id,
+                        )
+                    if i == 60:
+                        # mid-round rollout hot-swap: new scorer + bundle
+                        ev.attach_scorer(sc2, ni, version="rd-hammer-2")
+                    await asyncio.sleep(0)
+                stop.set()
+
+            await asyncio.gather(mutator(), *(round_driver(c) for c in children))
+            # the hammer RODE the mirror: every mutation invalidates some
+            # candidate's cached row for every child, so under the storm
+            # rounds land on the counted stale leg — but through mirror
+            # drives (native sample/filter), never the snapshot loop
+            st = client.stats()
+            assert st["drives"] > 0
+            assert sched.mirror_rounds_served + sched.mirror_stale_rounds > 0
+            assert client.ready, client.poison_reason
+            assert st["full_syncs"] == 1
+
+            # quiesced, the cache converges: one stale batch refreshes the
+            # rows, the next drives fully native
+            for _ in range(2):
+                sched.find_candidate_parents_batch_native(
+                    [(c, c.block_parents) for c in children]
+                )
+            assert sched.mirror_rounds_served > 0
+
+            # quiesced rng-state-replay: serial == mirror per child
+            for c in children:
+                state = sched.rng_state()
+                serial = [p.id for p in
+                          sched.find_candidate_parents(c, c.block_parents)]
+                sched.set_rng_state(state)
+                native = [p.id for p in sched.find_candidate_parents_batch_native(
+                    [(c, c.block_parents)]
+                )[0]]
+                assert serial == native
+            sc.close()
+            sc2.close()
+            svc.close()
+
+        run(body())
+
+    def test_poisoned_mirror_falls_back_counted_never_silent(self, tmp_path):
+        """Kill mid-delta: a hook failure while a delta is being pushed
+        poisons the client; every subsequent batch takes the Python leg,
+        counted per batch under reason=poisoned — and stays bit-identical
+        to the serial twin (the fallback IS the PR-18 snapshot leg)."""
+        svc_a, svc_b, ch_a, ch_b, scs = _ml_pair(tmp_path, seed=5)
+        sched_a, sched_b = svc_a.scheduling, svc_b.scheduling
+        client = svc_b.enable_native_mirror()
+        assert client is not None and client.ready
+        ids_s, ids_n = _run_matched_mirror(
+            sched_a, sched_b,
+            [(c, set()) for c in ch_a], [(c, set()) for c in ch_b],
+        )
+        assert ids_s == ids_n
+
+        # kill the FFI surface mid-delta: the next feature bump's hook
+        # fails inside the push and must poison, not raise into the mutator
+        def boom(*a, **kw):
+            raise RuntimeError("injected delta failure")
+
+        client.native.peer_feat_fn = boom
+        ch_b[0].bump_feat()  # fires on_peer_feat → poison
+        assert client.poisoned and client.poison_reason == "peer_feat"
+        assert not client.ready
+
+        fb0 = metrics.NATIVE_MIRROR_FALLBACK_TOTAL.labels(
+            reason="poisoned"
+        ).value
+        mirror0 = sched_b.mirror_rounds_served
+        # twin A mirrors the mutation so the pools stay identical
+        ch_a[0].bump_feat()
+        for _trial in range(2):
+            ids_s, ids_n = _run_matched_mirror(
+                sched_a, sched_b,
+                [(c, set()) for c in ch_a], [(c, set()) for c in ch_b],
+            )
+            assert ids_s == ids_n
+        assert metrics.NATIVE_MIRROR_FALLBACK_TOTAL.labels(
+            reason="poisoned"
+        ).value == fb0 + 2 * len(ch_b)
+        assert sched_b.mirror_rounds_served == mirror0  # mirror out of the loop
+        _close(*scs, svc_a, svc_b)

@@ -191,6 +191,55 @@ class TestDivergence:
         m2 = R.merge_reports([a, {"rounds": 5}])
         assert m2["topk_overlap_min"] == 0.75
 
+    def test_tracker_population_slices_and_worst_slice(self):
+        """ISSUE 19 satellite: divergence bucketed per child population
+        (region × peer-count band). A candidate that only mis-ranks one
+        slice is invisible in the global mean but shows as worst_slice."""
+        t = R.ShadowTracker("v1", topk=3)
+        agree = np.array([0.9, 0.5, 0.7, 0.1])
+        for _ in range(20):
+            t.record(agree, agree + 0.001, slice_key="us-east|p<1e3")
+        # one region's flash-crowd band disagrees hard every round
+        for _ in range(5):
+            t.record(np.array([4.0, 3.0, 2.0, 1.0]),
+                     np.array([1.0, 2.0, 3.0, 4.0]),
+                     slice_key="eu-west|p>=1e4")
+        t.record(agree, agree)  # unsliced rounds still count globally
+        snap = t.snapshot()
+        assert snap["rounds"] == 26
+        assert set(snap["slices"]) == {"us-east|p<1e3", "eu-west|p>=1e4"}
+        good = snap["slices"]["us-east|p<1e3"]
+        bad = snap["slices"]["eu-west|p>=1e4"]
+        assert good["rounds"] == 20 and good["topk_overlap_mean"] == 1.0
+        assert bad["rounds"] == 5 and bad["topk_overlap_mean"] == pytest.approx(2.0 / 3.0)
+        assert bad["topk_overlap_min"] <= bad["topk_overlap_mean"]
+        assert snap["worst_slice"] == "eu-west|p>=1e4"
+        assert snap["topk_overlap_mean"] > 0.7  # the global mean hid it
+
+    def test_merge_reports_merges_population_slices(self):
+        a = {"rounds": 10,
+             "slices": {"us-east|p<1e3": {
+                 "rounds": 10, "topk_overlap_mean": 1.0, "rank_corr_mean": 1.0,
+                 "abs_delta_mean": 0.0, "topk_overlap_min": 1.0}}}
+        b = {"rounds": 30,
+             "slices": {
+                 "us-east|p<1e3": {
+                     "rounds": 10, "topk_overlap_mean": 0.5, "rank_corr_mean": 0.0,
+                     "abs_delta_mean": 0.2, "topk_overlap_min": 0.25},
+                 "eu-west|p>=1e4": {
+                     "rounds": 20, "topk_overlap_mean": 0.1, "rank_corr_mean": -1.0,
+                     "abs_delta_mean": 0.5, "topk_overlap_min": 0.0}}}
+        m = R.merge_reports([a, b])
+        us = m["slices"]["us-east|p<1e3"]
+        assert us["rounds"] == 20
+        assert us["topk_overlap_mean"] == pytest.approx(0.75)  # rounds-weighted
+        assert us["topk_overlap_min"] == 0.25  # min-of-mins
+        assert m["worst_slice"] == "eu-west|p>=1e4"
+        # members that predate slicing (rolling upgrade) merge cleanly
+        m2 = R.merge_reports([{"rounds": 5}, a])
+        assert m2["worst_slice"] == "us-east|p<1e3"
+        assert R.merge_reports([{"rounds": 5}])["worst_slice"] is None
+
     def test_health_sample_is_registry_scoped_per_service(self):
         """ISSUE 12 satellite (ROADMAP #4 follow-up): two SchedulerServices
         in ONE process must not share health baselines — rounds and

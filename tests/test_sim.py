@@ -10,6 +10,7 @@ cost — ~4 min on this box)."""
 from __future__ import annotations
 
 import asyncio
+import shutil
 import time
 
 import pytest
@@ -393,3 +394,26 @@ def test_dfsim_json_contract(tmp_path):
     assert out["origin_egress"]["max_region_fetches"] > 0
     assert out["violations"]["departed_parent_rounds"] == 0
     assert out["telemetry"]["nodes"] > 0 and out["telemetry"]["edges"] > 0
+
+
+@pytest.mark.skipif(shutil.which("g++") is None,
+                    reason="no C++ toolchain for the native scorer")
+def test_dfsim_ml_native_mirror_coverage(tmp_path):
+    """The ml-native leg rides the mirrored peer table (ISSUE 19): the JSON
+    coverage contract must fold mirror-driven rounds into native_rounds
+    (each scheduling round runs sample+filter in C even when the sim's
+    uncached builder keeps scoring on the stale leg), and full_syncs must
+    equal the scheduler count — one attach export each, pure deltas after.
+    This pin exists because the mirror superseding PR 18's counter silently
+    zeroed the sim's native_rounds until the JSON was re-checked live."""
+    from dragonfly2_tpu.cli.dfsim import run_scenario
+
+    out = run_scenario("flash-crowd", peers=300, seed=1,
+                       telemetry_dir=str(tmp_path), scoring="ml-native")
+    s = out["scheduler"]
+    assert s["scoring"] == "ml-native"
+    assert s["rounds"] > 0
+    # full coverage: at most a handful of pre-attach rounds may run serial
+    assert s["native_rounds"] >= s["rounds"] - out["schedulers"]
+    assert s["mirror_rounds"] + s["mirror_stale_rounds"] == s["native_rounds"]
+    assert s["mirror_full_syncs"] == out["schedulers"]

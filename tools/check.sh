@@ -132,6 +132,53 @@ if out.get('native_rounds_per_s') is not None:
 print('round_loop smoke ok:', {k: out[k] for k in ('native_rounds_per_s', 'speedup', 'ffi_calls_per_round', 'native_coverage')})
 "
 
+# mirror-smoke: the native mirrored peer table (ISSUE 19) — serial-vs-mirror
+# bit-exact equivalence with live deltas (create/mutate/delete), the MT19937
+# sample-draw reproduction contract, the chaos hammer with a mid-round
+# hot-swap, and the poison discipline (tests/test_mirror.py). Then a REAL
+# scheduler service boots with the mirror enabled and drives rounds while
+# deltas flow: steady state must show EXACTLY ONE full sync (the attach) —
+# zero per-round re-exports — and quiesced drives must go fully native.
+run_stage "mirror-smoke" env JAX_PLATFORMS=cpu python -m pytest tests/test_mirror.py -q \
+    -m 'concurrency and not slow' \
+    -p no:cacheprovider -p no:xdist -p no:randomly
+run_stage "mirror-sync-smoke" env JAX_PLATFORMS=cpu python -c "
+import logging; logging.disable(logging.WARNING)
+import pathlib, random, sys, tempfile
+sys.path.insert(0, 'tests')
+from dragonfly2_tpu.scheduler import resource
+resource.Peer._DEPTH_MEMO_TTL_S = 0.0
+from test_round_driver import build_pool, _artifact
+from dragonfly2_tpu.native import NativeScorer
+from dragonfly2_tpu.scheduler.evaluator import new_evaluator
+from dragonfly2_tpu.scheduler.service import SchedulerService
+with tempfile.TemporaryDirectory() as td:
+    ev = new_evaluator('ml')
+    svc = SchedulerService(evaluator=ev)
+    task, children, parents = build_pool(svc, seed=3)
+    sc = NativeScorer(_artifact(pathlib.Path(td), seed=3))
+    ni = {p.host.id: i % 64 for i, p in enumerate(parents + children)}
+    ev.attach_scorer(sc, ni, version='mirror-smoke')
+    client = svc.enable_native_mirror()
+    assert client is not None and client.ready, 'mirror failed to attach'
+    sched = svc.scheduling
+    r = random.Random(5)
+    pool_peers = sorted(task.dag.values(), key=lambda p: p.id)
+    for _ in range(8):  # deltas flow between batches (hook-fed feat bumps)
+        for p in r.sample(pool_peers, 4):
+            p.add_piece_cost(r.uniform(1.0, 20.0)); p.bump_feat()
+        sched.find_candidate_parents_batch_native([(c, set()) for c in children])
+    for _ in range(2):  # quiesced: cache converges, drives go fully native
+        sched.find_candidate_parents_batch_native([(c, set()) for c in children])
+    st = client.stats()
+    assert client.ready, client.poison_reason
+    assert st['full_syncs'] == 1, st  # ZERO steady-state re-exports
+    assert st['drives'] >= 10, st
+    assert sched.mirror_rounds_served > 0, (st, sched.mirror_stale_rounds)
+    svc.close(); sc.close()
+print('mirror smoke ok:', {k: st[k] for k in ('full_syncs', 'drives', 'native_rounds', 'stale_rounds', 'deltas')})
+"
+
 # federation-smoke: the cluster-in-a-box boots manager + 2 federated
 # schedulers + 2 daemons + origin as REAL subprocesses, runs a real dfget
 # through the federation (seed + P2P, bit-exact), then asserts from the

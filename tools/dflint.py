@@ -90,6 +90,19 @@ Checks (see README.md "Static analysis" for the catalog):
          throughput wall (ISSUE 18); each such loop re-introduces
          O(candidates) Python work per round. Suppress with reason for a
          deliberately-kept serial reference leg.
+  DF036  direct mutation of mirrored scheduler state outside the registered
+         invalidation hooks (ISSUE 19): the native peer-table mirror stays
+         correct ONLY because every version-bumping mutation flows through
+         the hook-firing mutators — bump_feat() for feat_version, Task
+         add_edge/delete_edge for DAG adjacency, the pool's create/delete
+         for membership, MirrorClient registration for _mirror/_mirror_slot.
+         A raw `x.feat_version += 1`, a `vertex.parents.add(...)`, or an
+         `obj._mirror_slot = ...` outside scheduler/resource.py and
+         scheduler/mirror.py bypasses the delta stream: the mirror keeps
+         serving the OLD state with no stale-key tripwire (the version
+         never moved), which is the one silent-wrongness hole the
+         versioned-invalidation design has. Suppress with the reason the
+         site cannot desynchronize the mirror.
 
 Suppression:
   - same line:   <code>  # dflint: disable=DF023 <reason>   (comma-separate ids;
@@ -133,6 +146,7 @@ CHECKS: dict[str, str] = {
     "DF033": "per-row numpy array construction inside a for loop (vectorize)",
     "DF034": "unbounded asyncio.Queue/deque in service code (overload memory bomb)",
     "DF035": "per-candidate Python loop on the scoring hot path (drive it natively)",
+    "DF036": "mirrored peer/DAG/feature state mutated outside its invalidation hooks",
 }
 
 # numpy constructors whose per-row use inside a loop marks an unvectorized
@@ -1151,6 +1165,102 @@ def check_py_loop_on_scoring_hot_path(
                 )
 
 
+# DF036: attributes whose mutation MUST ride the mirror's invalidation hooks
+# (ISSUE 19). feat_version writes belong in bump_feat(); DAG adjacency sets
+# (vertex .parents/.children) belong in Task.add_edge/delete_edge; the mirror
+# registration fields belong to MirrorClient. The owning modules are exempt —
+# they ARE the hooks.
+_MIRRORED_VERSION_ATTRS = {"feat_version"}
+_MIRROR_REG_ATTRS = {"_mirror", "_mirror_slot"}
+_DAG_ADJ_ATTRS = {"parents", "children"}
+# set/dict mutators only: DAG adjacency is sets; list-shaped .parents fields
+# (ScheduleResult, decision records) mutate via append/extend and stay clean
+_SET_MUTATORS = {"add", "discard", "remove", "clear", "update", "pop"}
+# resource.py/mirror.py ARE the hooks; utils/dag.py is the adjacency
+# primitive the hooked mutators (Task.add_edge/delete_edge, delete_peer)
+# call INTO — its internal set surgery is below the mirror's abstraction
+_DF036_EXEMPT = (
+    "scheduler/resource.py", "scheduler/mirror.py", "utils/dag.py",
+)
+
+
+def check_mirrored_state_mutation(
+    tree: ast.Module, path: str
+) -> Iterator[Violation]:
+    """DF036: mirrored peer/DAG/feature state mutated outside its hooks.
+
+    Fires on (a) assignment or augmented assignment to a `feat_version`
+    attribute — the version the mirror's row keys and delta stream hang off;
+    (b) set-mutator calls on a `.parents`/`.children` attribute — DAG
+    adjacency the mirror replays from the edge hooks; (c) assignment to
+    `_mirror`/`_mirror_slot` — registration state only MirrorClient owns.
+    The hook-owning modules (scheduler/resource.py, scheduler/mirror.py),
+    the native layer, and tests are exempt."""
+    p = path.replace("\\", "/")
+    if (
+        any(p.endswith(e) for e in _DF036_EXEMPT)
+        or "/native/" in p or p.startswith("native/")
+        or "tests/" in p or p.rsplit("/", 1)[-1].startswith("test_")
+    ):
+        return
+    # `self._mirror = None` / `self._mirror_slot = -1` inside __init__ is
+    # the field DECLARATION every mirrorable object carries (unregistered
+    # until MirrorClient attaches) — not a mutation of live registration
+    # state. Any constant-valued __init__ assignment qualifies.
+    init_decls: set[int] = set()
+    for fn in ast.walk(tree):
+        if isinstance(fn, ast.FunctionDef) and fn.name == "__init__":
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Assign):
+                    continue
+                v = node.value
+                if isinstance(v, ast.UnaryOp):  # -1 is UnaryOp(USub, Constant)
+                    v = v.operand
+                if isinstance(v, ast.Constant):
+                    init_decls.add(id(node))
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign) else [node.target]
+            )
+            for t in targets:
+                if not isinstance(t, ast.Attribute):
+                    continue
+                if t.attr in _MIRRORED_VERSION_ATTRS:
+                    yield Violation(
+                        path, node.lineno, node.col_offset, "DF036",
+                        f"direct write to .{t.attr} bypasses the mirror's "
+                        "delta stream — the native peer table keeps serving "
+                        "stale state with no version tripwire; go through "
+                        "bump_feat() (or suppress with the reason this site "
+                        "cannot desynchronize the mirror)",
+                    )
+                elif t.attr in _MIRROR_REG_ATTRS and id(node) not in init_decls:
+                    yield Violation(
+                        path, node.lineno, node.col_offset, "DF036",
+                        f"direct write to .{t.attr} — mirror registration "
+                        "state is owned by MirrorClient attach/detach; a "
+                        "stray write orphans the slot mapping (suppress with "
+                        "the reason if this is deliberate unwiring)",
+                    )
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if (
+                isinstance(f, ast.Attribute)
+                and f.attr in _SET_MUTATORS
+                and isinstance(f.value, ast.Attribute)
+                and f.value.attr in _DAG_ADJ_ATTRS
+            ):
+                yield Violation(
+                    path, node.lineno, node.col_offset, "DF036",
+                    f"direct {f.attr}() on .{f.value.attr} mutates DAG "
+                    "adjacency behind the mirror's back — edges must go "
+                    "through Task.add_edge/delete_edge so the edge hook "
+                    "pushes the child's new parent list (suppress with the "
+                    "reason this set is not mirrored adjacency)",
+                )
+
+
 _MUTABLE_CTORS = {
     "list", "dict", "set", "bytearray", "collections.defaultdict",
     "defaultdict", "collections.deque", "deque", "collections.OrderedDict",
@@ -1466,6 +1576,7 @@ ALL_CHECKS = (
     check_mutable_defaults,
     check_np_ctor_in_row_loop,
     check_py_loop_on_scoring_hot_path,
+    check_mirrored_state_mutation,
     check_unbounded_queue,
 )
 
